@@ -1,0 +1,138 @@
+"""Accelerator abstraction.
+
+Parity with reference ``accelerator/abstract_accelerator.py:10`` (``DeepSpeedAccelerator``
+ABC): one seam through which every subsystem queries devices, memory, dtype support,
+RNG and the communication backend, so the same engine code runs on real TPU chips or
+on a virtual CPU-device mesh (the test seam, standing in for the reference's
+``DS_ACCELERATOR=cpu`` path).
+
+Differences by design: no stream/event surface (XLA owns scheduling; synchronization
+maps to ``block_until_ready``) and no op-builder JIT-compile machinery for device code
+(Pallas kernels are JIT-compiled by XLA). A light ``create_op_builder`` remains for
+host-side native libraries (C++ CPU Adam, AIO).
+"""
+
+import abc
+from typing import Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # ------------------------- identity -------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool:
+        ...
+
+    def device_name(self, device_index=None) -> str:
+        if device_index is None:
+            return self._name
+        return f"{self._name}:{device_index}"
+
+    @property
+    def name(self):
+        return self._name
+
+    # ------------------------- devices -------------------------
+    @abc.abstractmethod
+    def devices(self):
+        """All addressable jax devices for this accelerator."""
+
+    def device(self, device_index=0):
+        return self.devices()[device_index]
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def current_device(self):
+        return self.devices()[0]
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        """Devices across all processes (``jax.device_count()``)."""
+
+    def synchronize(self, device_index=None):
+        import jax
+
+        jax.effects_barrier()
+
+    # ------------------------- RNG -------------------------
+    def default_rng(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    # ------------------------- memory -------------------------
+    def _stats(self, device_index=0) -> dict:
+        try:
+            return self.devices()[device_index].memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=0) -> int:
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=0) -> int:
+        return self._stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_peak_memory_stats(self, device_index=0):
+        ...
+
+    def total_memory(self, device_index=0) -> int:
+        return self._stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=0) -> int:
+        s = self._stats(device_index)
+        return max(0, s.get("bytes_limit", 0) - s.get("bytes_in_use", 0))
+
+    def empty_cache(self):
+        ...
+
+    # ------------------------- dtype support -------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+
+        dtypes = [jnp.float32]
+        if self.is_fp16_supported():
+            dtypes.append(jnp.float16)
+        if self.is_bf16_supported():
+            dtypes.append(jnp.bfloat16)
+        return dtypes
+
+    # ------------------------- comm -------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    # ------------------------- host memory -------------------------
+    def pin_memory(self, array):
+        """Host arrays in JAX are already transfer-staged; identity by contract."""
+        return array
+
+    def is_pinned(self, array) -> bool:
+        return True
+
+    # ------------------------- op builders (host-side native) -------------------------
+    def create_op_builder(self, op_name: str):
+        from ..ops.op_builder import get_builder
+
+        cls = get_builder(op_name)
+        return cls() if cls is not None else None
+
+    def get_op_builder(self, op_name: str):
+        from ..ops.op_builder import get_builder
+
+        return get_builder(op_name)

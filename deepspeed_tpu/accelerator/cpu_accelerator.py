@@ -1,0 +1,38 @@
+"""CPU accelerator — the deterministic N-device simulation seam.
+
+Reference analogue: ``accelerator/cpu_accelerator.py`` + the ``DS_ACCELERATOR=cpu``
+override. On JAX, an N-device CPU mesh comes from
+``--xla_force_host_platform_device_count=N``; tests run the full engine, collectives
+included, on it (SURVEY.md §4 implication).
+"""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def is_synchronized_device(self) -> bool:
+        return True
+
+    def devices(self):
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "cpu"]
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name

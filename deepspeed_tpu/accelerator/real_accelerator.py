@@ -1,0 +1,57 @@
+"""Accelerator selection (reference ``accelerator/real_accelerator.py:51``).
+
+``DS_ACCELERATOR`` env var overrides; otherwise pick TPU when a TPU-like platform is
+visible to JAX, else CPU.
+"""
+
+import os
+from typing import Optional
+
+from ..utils.logging import logger
+from .abstract_accelerator import DeepSpeedAccelerator
+
+SUPPORTED_ACCELERATOR_LIST = ["tpu", "cpu"]
+
+_ds_accelerator: Optional[DeepSpeedAccelerator] = None
+
+
+def _validate_accelerator_name(name: str):
+    if name not in SUPPORTED_ACCELERATOR_LIST:
+        raise ValueError(
+            f"accelerator name '{name}' not in supported list {SUPPORTED_ACCELERATOR_LIST}"
+        )
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ds_accelerator
+    if _ds_accelerator is not None:
+        return _ds_accelerator
+
+    name = os.environ.get("DS_ACCELERATOR")
+    if name is not None:
+        _validate_accelerator_name(name)
+    else:
+        import jax
+
+        platforms = {d.platform for d in jax.local_devices()}
+        name = "cpu" if platforms <= {"cpu"} else "tpu"
+
+    if name == "tpu":
+        from .tpu_accelerator import TPU_Accelerator
+
+        _ds_accelerator = TPU_Accelerator()
+    else:
+        from .cpu_accelerator import CPU_Accelerator
+
+        _ds_accelerator = CPU_Accelerator()
+    logger.info(f"Setting ds_accelerator to {name}")
+    return _ds_accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator):
+    global _ds_accelerator
+    _ds_accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator().name in SUPPORTED_ACCELERATOR_LIST

@@ -1,0 +1,44 @@
+"""TPU accelerator (the production backend).
+
+Fills the role ``cuda_accelerator.py`` plays in the reference: the concrete
+accelerator every subsystem talks to through ``get_accelerator()``.
+"""
+
+import functools
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    def is_synchronized_device(self) -> bool:
+        return False
+
+    @functools.lru_cache(None)
+    def _local_devices(self):
+        import jax
+
+        devs = [d for d in jax.local_devices()]
+        return devs
+
+    def devices(self):
+        return self._local_devices()
+
+    def global_device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        # fp16 compute is supported but bf16 is native on the MXU
+        return True
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name

@@ -1,0 +1,156 @@
+"""Mesh topology — process groups become named mesh axes.
+
+Reference analogue: ``deepspeed/utils/groups.py`` (``_create_model_parallel:64``,
+``_create_expert_and_data_parallel:113``, ``_get_sequence_parallel_group:468``) and
+``runtime/pipe/topology.py`` (``ProcessTopology:12``). On TPU the device grid is a
+``jax.sharding.Mesh`` with axes ``(pipe, data, expert, seq, model)``; a "process
+group" over axis X is simply a collective over mesh axis X, and a rank's coordinates
+are its mesh position. The total data-parallel degree (what ZeRO shards over) is
+``data * expert`` — expert parallelism is carved out of the DP group exactly like the
+reference's expert-parallel groups are subsets of DP ranks.
+
+Axis order is outermost-first = slowest-varying-first: ``pipe`` outermost so pipeline
+stages map to contiguous device blocks (DCN-friendly for multi-slice), ``model``
+innermost so tensor-parallel collectives ride the fastest ICI links.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+
+# sharding-rule aliases
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+# ZeRO shards parameters/optimizer state over the full DP degree = data×expert
+ZERO_AXES = ("data", "expert")
+
+
+class MeshTopology:
+    """Logical device grid for one training job."""
+
+    def __init__(
+        self,
+        data: int = 0,
+        model: int = 1,
+        pipe: int = 1,
+        seq: int = 1,
+        expert: int = 1,
+        devices=None,
+    ):
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        denom = model * pipe * seq * expert
+        if data in (0, None):
+            if n % denom != 0:
+                raise ValueError(
+                    f"device count {n} not divisible by model*pipe*seq*expert={denom}"
+                )
+            data = n // denom
+        if data * denom != n:
+            raise ValueError(
+                f"mesh {dict(pipe=pipe, data=data, expert=expert, seq=seq, model=model)} "
+                f"needs {data * denom} devices, have {n}"
+            )
+        self.axis_sizes: Dict[str, int] = dict(
+            pipe=pipe, data=data, expert=expert, seq=seq, model=model
+        )
+        shape = tuple(self.axis_sizes[a] for a in MESH_AXES)
+        dev_array = np.asarray(devices).reshape(shape)
+        from jax.sharding import Mesh
+
+        self.mesh = Mesh(dev_array, MESH_AXES)
+        logger.info(f"MeshTopology: {self.axis_sizes} over {n} devices")
+
+    # ----------------------- sizes -----------------------
+    def get_dim(self, axis: str) -> int:
+        return self.axis_sizes[axis]
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Full ZeRO/DP degree (data × expert), reference ``groups._get_data_parallel_world_size``."""
+        return self.axis_sizes["data"] * self.axis_sizes["expert"]
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.axis_sizes["model"]
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.axis_sizes["pipe"]
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.axis_sizes["seq"]
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.axis_sizes["expert"]
+
+    # ----------------------- coordinates -----------------------
+    def coord_of_device(self, device) -> Dict[str, int]:
+        idx = np.argwhere(self.mesh.devices == device)
+        if idx.size == 0:
+            raise ValueError(f"device {device} not in mesh")
+        return {a: int(i) for a, i in zip(MESH_AXES, idx[0])}
+
+    def filter_match(self, **coords) -> list:
+        """Devices whose coordinates match (reference ``ProcessTopology.filter_match``)."""
+        sel = [slice(None)] * len(MESH_AXES)
+        for a, v in coords.items():
+            sel[MESH_AXES.index(a)] = v
+        return list(np.asarray(self.mesh.devices[tuple(sel)]).flatten())
+
+    # ----------------------- sharding helpers -----------------------
+    def named_sharding(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+
+_topology: Optional[MeshTopology] = None
+
+
+def initialize_topology(mesh_config=None, devices=None, **kwargs) -> MeshTopology:
+    """Build (or rebuild) the global topology (reference ``groups.initialize``)."""
+    global _topology
+    if mesh_config is not None:
+        kwargs = dict(
+            data=mesh_config.data,
+            model=mesh_config.model,
+            pipe=mesh_config.pipe,
+            seq=mesh_config.seq,
+            expert=mesh_config.expert,
+        )
+    _topology = MeshTopology(devices=devices, **kwargs)
+    return _topology
+
+
+def get_topology(required: bool = True) -> Optional[MeshTopology]:
+    global _topology
+    if _topology is None and required:
+        _topology = MeshTopology()  # all-data default
+    return _topology
+
+
+def reset_topology():
+    global _topology
+    _topology = None

@@ -1,0 +1,65 @@
+"""Environment report CLI (reference ``deepspeed/env_report.py`` / ``ds_report``).
+
+Prints JAX/platform versions, visible devices, and host-side native op
+compatibility (the TPU build's analogue of the CUDA op compatibility matrix).
+"""
+
+import shutil
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report():
+    from .ops.op_builder import builder_names, get_builder
+
+    print("-" * 60)
+    print("native op compatibility")
+    print("-" * 60)
+    names = builder_names()
+    if not names:
+        print("no native op builders registered")
+    for name in names:
+        builder = get_builder(name)()
+        status = OKAY if builder.is_compatible(verbose=False) else NO
+        print(f"{name:<24} {status}")
+
+
+def debug_report():
+    import jax
+
+    print("-" * 60)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 60)
+    rows = [
+        ("python version", sys.version.split()[0]),
+        ("jax version", jax.__version__),
+        ("platform", jax.default_backend()),
+        ("local devices", len(jax.local_devices())),
+        ("global devices", jax.device_count()),
+        ("process index", f"{jax.process_index()}/{jax.process_count()}"),
+        ("g++ available", shutil.which("g++") is not None),
+    ]
+    try:
+        import jaxlib
+
+        rows.insert(2, ("jaxlib version", jaxlib.__version__))
+    except ImportError:
+        pass
+    for name, value in rows:
+        print(f"{name:<24} {value}")
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+if __name__ == "__main__":
+    main()

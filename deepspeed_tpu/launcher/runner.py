@@ -1,0 +1,158 @@
+"""Launcher CLI (reference ``deepspeed/launcher/runner.py:388`` + ``launch.py:132``).
+
+On TPU pods the process model differs from the reference's one-process-per-GPU: JAX
+is single-controller-per-host, so the launcher spawns ONE process per host and lets
+``jax.distributed.initialize`` rendezvous across hosts. Hostfile syntax
+(``hostname slots=N``) is kept for familiarity; on a single host the script is
+exec'd directly with the environment prepared.
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+from collections import OrderedDict
+
+from ..utils.logging import logger
+
+DSTPU_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU launcher: start a training script on this host "
+        "(and, with a hostfile, on every host of a pod slice over ssh)."
+    )
+    parser.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="host[:slot] inclusion filter, e.g. 'worker-0:0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="host[:slot] exclusion filter")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--no_ssh_check", action="store_true")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str, help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse 'hostname slots=N' lines (reference ``runner.py:200``)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool = OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd:
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                logger.error(f"Hostfile is not formatted correctly, line: '{line}'")
+                raise ValueError(f"Hostfile is not formatted correctly: {line}")
+            if hostname in resource_pool:
+                raise ValueError(f"Hostfile contains duplicate hosts, found: {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_filter(spec):
+    """'host1:0,1@host2' → {host: [slots] or None}."""
+    mapping = {}
+    if not spec:
+        return mapping
+    for part in spec.split("@"):
+        if ":" in part:
+            host, slots = part.split(":")
+            mapping[host] = [int(s) for s in slots.split(",")]
+        else:
+            mapping[part] = None
+    return mapping
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Apply include/exclude filters (reference ``runner.py:255``)."""
+    active = OrderedDict()
+    inc, exc = _parse_filter(inclusion), _parse_filter(exclusion)
+    for host, slots in resource_pool.items():
+        slot_list = list(range(slots))
+        if inc:
+            if host not in inc:
+                continue
+            if inc[host] is not None:
+                slot_list = [s for s in slot_list if s in inc[host]]
+        if host in exc:
+            if exc[host] is None:
+                continue
+            slot_list = [s for s in slot_list if s not in exc[host]]
+        if slot_list:
+            active[host] = slot_list
+    return active
+
+
+def encode_world_info(world_info: dict) -> str:
+    return base64.urlsafe_b64encode(json.dumps(world_info).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    env = os.environ.copy()
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+
+    if not resource_pool or len(resource_pool) == 1:
+        # single-host: exec in place, one controller process for all local chips
+        env.setdefault("DSTPU_NUM_PROCESSES", "1")
+        logger.info(f"launching (single host): {' '.join(map(shlex.quote, cmd))}")
+        result = subprocess.Popen(cmd, env=env)
+        try:
+            result.wait()
+        except KeyboardInterrupt:
+            result.send_signal(signal.SIGINT)
+            result.wait()
+        sys.exit(result.returncode)
+
+    # multi-host: one process per host over ssh, coordinator = first host
+    active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    hosts = list(active.keys())
+    if args.num_nodes > 0:
+        hosts = hosts[: args.num_nodes]
+    master_addr = args.master_addr or hosts[0]
+    world_info = encode_world_info({h: active[h] for h in hosts})
+
+    procs = []
+    for i, host in enumerate(hosts):
+        remote_env = (
+            f"DSTPU_NUM_PROCESSES={len(hosts)} DSTPU_PROCESS_ID={i} "
+            f"COORDINATOR_ADDRESS={master_addr}:{args.master_port} "
+            f"DSTPU_WORLD_INFO={world_info}"
+        )
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                   f"cd {os.getcwd()} && {remote_env} {' '.join(map(shlex.quote, cmd))}"]
+        logger.info(f"launching on {host}: {' '.join(ssh_cmd)}")
+        procs.append(subprocess.Popen(ssh_cmd))
+    rc = 0
+    try:
+        for p in procs:
+            rc |= p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        for p in procs:
+            p.wait()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Host-side native op builder registry.
+
+Role of the reference's ``op_builder/`` (``OpBuilder`` ABC, ``builder.py:108``),
+reduced to what a TPU build needs: device kernels are Pallas (JIT-compiled by XLA, no
+build step), so builders exist only for *host-side* C++ libraries — the SIMD CPU Adam
+used by ZeRO-Offload and the async-IO library used by the NVMe tier. Builders compile
+a shared library with the system toolchain on first use and expose it via ctypes.
+"""
+
+import os
+import shutil
+import subprocess
+import sysconfig
+import threading
+from typing import Dict, Optional, Type
+
+from ..utils.logging import logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_BUILD_DIR = os.path.join(_REPO_ROOT, ".dstpu_build")
+
+
+class OpBuilder:
+    """Compile-on-first-use builder for a host-side C++ shared library."""
+
+    NAME = "base"
+    _lock = threading.Lock()
+
+    def sources(self):
+        raise NotImplementedError
+
+    def include_paths(self):
+        return []
+
+    def cxx_args(self):
+        return ["-O3", "-std=c++17", "-fPIC", "-shared", "-march=native", "-fopenmp"]
+
+    def libraries_args(self):
+        return []
+
+    def is_compatible(self, verbose=True) -> bool:
+        return shutil.which("g++") is not None
+
+    def absolute_name(self) -> str:
+        return f"deepspeed_tpu.ops.{self.NAME}"
+
+    def lib_path(self) -> str:
+        return os.path.join(_BUILD_DIR, f"lib{self.NAME}.so")
+
+    def build(self, verbose: bool = False) -> str:
+        with OpBuilder._lock:
+            out = self.lib_path()
+            srcs = [os.path.join(_REPO_ROOT, s) for s in self.sources()]
+            if os.path.exists(out) and all(
+                os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+            ):
+                return out
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            cmd = (
+                ["g++"] + self.cxx_args()
+                + [f"-I{os.path.join(_REPO_ROOT, p)}" for p in self.include_paths()]
+                + [f"-I{sysconfig.get_paths()['include']}"]
+                + srcs + ["-o", out] + self.libraries_args()
+            )
+            if verbose:
+                logger.info("Building native op: " + " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            return out
+
+    def load(self, verbose: bool = False):
+        """Build if needed and return a ctypes CDLL handle."""
+        import ctypes
+
+        return ctypes.CDLL(self.build(verbose=verbose))
+
+    # parity alias
+    jit_load = load
+
+
+_REGISTRY: Dict[str, Type[OpBuilder]] = {}
+
+
+def register_builder(cls: Type[OpBuilder]) -> Type[OpBuilder]:
+    _REGISTRY[cls.NAME] = cls
+    return cls
+
+
+def get_builder(name: str) -> Optional[Type[OpBuilder]]:
+    if not _REGISTRY:
+        _populate()
+    return _REGISTRY.get(name)
+
+
+def builder_names():
+    if not _REGISTRY:
+        _populate()
+    return sorted(_REGISTRY)
+
+
+def _populate():
+    # import modules that register builders
+    try:
+        from .adam import cpu_adam_builder  # noqa: F401
+    except Exception as e:  # pragma: no cover
+        logger.debug(f"cpu_adam builder unavailable: {e}")
+    try:
+        from .aio import aio_builder  # noqa: F401
+    except Exception as e:  # pragma: no cover
+        logger.debug(f"aio builder unavailable: {e}")

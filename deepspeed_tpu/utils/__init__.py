@@ -1,0 +1,2 @@
+from .logging import log_dist, logger, print_rank_0, should_log_le, warning_once
+from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer, trim_mean

@@ -1,0 +1,82 @@
+"""Rank-aware logging utilities.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (``logger``,
+``log_dist``, ``should_log_le``), re-designed for a JAX multi-process runtime where
+"rank" is ``jax.process_index()`` rather than a torch.distributed rank.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _FormatterFactory:
+    fmt = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "DeepSpeedTPU", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(fmt=_FormatterFactory.fmt))
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pre-init or jax unavailable in tooling contexts
+        return int(os.environ.get("RANK", "0"))
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: all).
+
+    ``ranks=[-1]`` or ``None`` logs everywhere; ``ranks=[0]`` logs on the lead
+    process only — mirrors the reference ``log_dist`` contract.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in LOG_LEVELS:
+        raise ValueError(f"{max_log_level_str} is not one of the logging levels")
+    return logger.getEffectiveLevel() <= LOG_LEVELS[max_log_level_str]
+
+
+def warning_once(message: str) -> None:
+    _warning_once_impl(message)
+
+
+@functools.lru_cache(None)
+def _warning_once_impl(message: str) -> None:
+    logger.warning(message)

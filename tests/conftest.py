@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+The reference's distributed test harness (``tests/unit/common.py:113 DistributedExec``)
+forks N processes with fake ranks over gloo/nccl. The TPU-native equivalent (per
+SURVEY.md §4) is a deterministic virtual device mesh: 8 CPU devices via
+``--xla_force_host_platform_device_count``, so every test runs real XLA collectives
+single-process. Env vars must be set before the first jax import.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+# The environment may pre-register a hardware platform plugin (and force it via
+# JAX_PLATFORMS); tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a fresh topology (mesh) — mirrors per-test process groups."""
+    yield
+    from deepspeed_tpu.comm import topology
+
+    topology.reset_topology()

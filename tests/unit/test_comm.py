@@ -1,0 +1,181 @@
+"""Comm/topology tests (modeled on reference tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.comms_logging import calc_bw_log
+from deepspeed_tpu.comm.topology import MeshTopology, initialize_topology
+
+
+def test_mesh_default_all_data():
+    topo = MeshTopology()
+    assert topo.data_parallel_size == 8
+    assert topo.world_size == 8
+
+
+def test_mesh_axes_product_validation():
+    with pytest.raises(ValueError):
+        MeshTopology(model=3)  # 8 % 3 != 0
+
+
+def test_mesh_2d():
+    topo = MeshTopology(model=2)
+    assert topo.model_parallel_size == 2
+    assert topo.data_parallel_size == 4
+    assert topo.mesh.shape["model"] == 2
+
+
+def test_expert_subset_of_dp():
+    topo = MeshTopology(expert=2)
+    assert topo.expert_parallel_size == 2
+    assert topo.data_parallel_size == 8  # data(4) × expert(2)
+
+
+def test_init_distributed_and_world_size():
+    dist.init_distributed()
+    assert dist.get_world_size() == 8
+    assert dist.get_rank() == 0
+
+
+def test_inprog_all_reduce_shard_map():
+    topo = initialize_topology(data=8)
+    x = jnp.arange(8.0)
+
+    f = shard_map(
+        lambda s: dist.inprog_all_reduce(s, "data"),
+        mesh=topo.mesh,
+        in_specs=P(("data",)),
+        out_specs=P(("data",)),
+    )
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_inprog_all_gather_and_reduce_scatter():
+    topo = initialize_topology(data=8)
+    x = jnp.arange(8.0)
+
+    def body(s):
+        g = dist.inprog_all_gather(s, "data")  # every shard sees full vector
+        rs = dist.inprog_reduce_scatter(g, "data")  # sum over ranks, scatter back
+        return rs
+
+    f = shard_map(body, mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"))
+    out = f(x)
+    # all_gather makes [0..7] on each rank; psum_scatter sums 8 copies and shards
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 8)
+
+
+def test_inprog_ppermute_ring():
+    topo = initialize_topology(data=8)
+    x = jnp.arange(8.0)
+    f = shard_map(
+        lambda s: dist.inprog_send_forward(s, "data", 8),
+        mesh=topo.mesh,
+        in_specs=P("data"),
+        out_specs=P("data"),
+    )
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_broadcast_replicates():
+    dist.init_distributed()
+    x = jnp.ones((4, 4))
+    y = dist.broadcast(x, src=0)
+    assert y.sharding.is_fully_replicated
+
+
+def test_barrier_noop_single_process():
+    dist.init_distributed()
+    dist.barrier()
+
+
+def test_calc_bw_log_allreduce():
+    size, algbw, busbw = calc_bw_log("all_reduce", 1024, 1e-3, 8)
+    assert size == 1024
+    assert algbw == pytest.approx(1024 * 2 / 1e-3 / 1e9)
+    assert busbw == pytest.approx(1024 / 1e-3 * (2 * 7 / 8) / 1e9)
+
+
+def test_comms_logger_records():
+    dist.configure(enabled=True)
+    try:
+        x = jnp.ones((16,))
+        dist.broadcast(x)
+        results = dist.comms_logger.log_all(print_log=False)
+        assert "broadcast" in results
+    finally:
+        dist.configure(enabled=False)
+        dist.comms_logger.comms_dict.clear()
+
+
+def test_eager_all_reduce_sharded_sums_contributions():
+    dist.init_distributed()
+    topo = dist.get_topology()
+    x = jax.device_put(jnp.arange(8.0), topo.named_sharding("data"))
+    y = dist.all_reduce(x, op="sum", group=("data",))
+    assert y.sharding.is_fully_replicated
+    np.testing.assert_allclose(np.asarray(y), [28.0])
+
+
+def test_eager_all_reduce_replicated_product():
+    dist.init_distributed()
+    r = dist.all_reduce(jnp.full((2,), 2.0), op="prod")
+    np.testing.assert_allclose(np.asarray(r), [256.0, 256.0])  # 2^8
+
+
+def test_inprog_all_reduce_product():
+    topo = initialize_topology(data=8)
+    x = jnp.full((8,), 2.0)
+    f = jax.shard_map(
+        lambda s: dist.inprog_all_reduce(s, "data", op="prod"),
+        mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 256.0))
+
+
+def test_timed_op_positional_group_counts_right_n(monkeypatch):
+    dist.init_distributed()
+    dist.configure(enabled=True)
+    try:
+        recorded = {}
+        orig_append = dist.comms_logger.append
+
+        def spy(raw_name, record_name, latency, msg_size, n):
+            recorded["n"] = n
+            return orig_append(raw_name, record_name, latency, msg_size, n)
+
+        monkeypatch.setattr(dist.comms_logger, "append", spy)
+        dist.broadcast(jnp.ones(4), 0, ("data",))  # group passed positionally
+        assert recorded["n"] == 8
+    finally:
+        dist.configure(enabled=False)
+        dist.comms_logger.comms_dict.clear()
+
+
+def test_launcher_hostfile_parsing(tmp_path):
+    from deepspeed_tpu.launcher.runner import fetch_hostfile, parse_inclusion_exclusion
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n# comment\n")
+    pool = fetch_hostfile(str(hf))
+    assert pool == {"worker-0": 4, "worker-1": 4}
+    active = parse_inclusion_exclusion(pool, "worker-0:0,1", "")
+    assert active == {"worker-0": [0, 1]}
+    active = parse_inclusion_exclusion(pool, "", "worker-1")
+    assert list(active) == ["worker-0"]
+
+
+def test_launcher_bad_hostfile(tmp_path):
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(hf))

@@ -1,0 +1,142 @@
+"""Config-system tests (modeled on reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": False},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_batch_triple_resolution_full():
+    cfg = DeepSpeedConfig(base_config(train_micro_batch_size_per_gpu=2), world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_infer_micro():
+    cfg = DeepSpeedConfig(
+        base_config(gradient_accumulation_steps=2), world_size=8
+    )
+    assert cfg.train_micro_batch_size_per_gpu == 1
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_infer_train():
+    d = base_config()
+    del d["train_batch_size"]
+    d["train_micro_batch_size_per_gpu"] = 4
+    d["gradient_accumulation_steps"] = 3
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_batch_size == 4 * 3 * 8
+
+
+def test_batch_triple_invalid():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            base_config(train_micro_batch_size_per_gpu=3, gradient_accumulation_steps=1),
+            world_size=8,
+        )
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"optimizer": {"type": "Adam"}}, world_size=8)
+
+
+def test_zero_config_defaults():
+    z = DeepSpeedZeroConfig.from_dict({})
+    assert z.stage == 0
+    assert z.allgather_partitions is True
+
+
+def test_zero_config_stage3_aliases():
+    z = DeepSpeedZeroConfig.from_dict(
+        {"stage": 3, "stage3_prefetch_bucket_size": 123, "stage3_max_live_parameters": 7}
+    )
+    assert z.stage == 3
+    assert z.prefetch_bucket_size == 123
+    assert z.max_live_parameters == 7
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(ValueError):
+        DeepSpeedZeroConfig.from_dict({"stage": 5})
+
+
+def test_zero_offload_configs():
+    cfg = DeepSpeedConfig(
+        base_config(
+            zero_optimization={
+                "stage": 2,
+                "offload_optimizer": {"device": "cpu", "ratio": 0.3},
+            }
+        ),
+        world_size=8,
+    )
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.offload_optimizer.ratio == 0.3
+
+
+def test_fp16_bf16_mutually_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(
+            base_config(fp16={"enabled": True}, bf16={"enabled": True}), world_size=8
+        )
+
+
+def test_fp16_dynamic_loss_scale():
+    cfg = DeepSpeedConfig(base_config(fp16={"enabled": True}), world_size=8)
+    assert cfg.fp16_enabled
+    assert cfg.fp16_config.dynamic_loss_scale
+    cfg2 = DeepSpeedConfig(
+        base_config(fp16={"enabled": True, "loss_scale": 128}), world_size=8
+    )
+    assert not cfg2.fp16_config.dynamic_loss_scale
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(base_config()))
+    cfg = DeepSpeedConfig(str(p), world_size=8)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 1e-3
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_mesh_config():
+    cfg = DeepSpeedConfig(
+        base_config(mesh={"model": 2, "data": 4}), world_size=8
+    )
+    assert cfg.mesh_config.model == 2
+    assert cfg.dp_world_size == 4
+
+
+def test_unknown_key_warns_not_raises():
+    DeepSpeedConfig(base_config(zero_optimization={"stage": 1, "bogus_knob": 1}), world_size=8)
+
+
+def test_scheduler_params():
+    cfg = DeepSpeedConfig(
+        base_config(scheduler={"type": "WarmupLR", "params": {"warmup_num_steps": 10}}),
+        world_size=8,
+    )
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
