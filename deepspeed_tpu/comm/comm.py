@@ -67,6 +67,10 @@ def init_distributed(
     """
     global _initialized
     if _initialized:
+        # runtime rendezvous happens once, but the logical mesh can be rebuilt
+        # (a later initialize() with a different mesh config)
+        if mesh_config is not None:
+            initialize_topology(mesh_config=mesh_config)
         return
     n_expected = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
     if n_expected > 1 and jax.process_count() == 1:

@@ -1,0 +1,34 @@
+"""Checkpoint engine ABC (reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``).
+
+Engines persist pytrees of (numpy-convertible) arrays plus JSON-able metadata.
+Checkpoints are **topology-independent by construction**: values are saved as full
+global arrays keyed by tree path, so reload under any mesh/ZeRO layout just re-shards
+— this is the property the reference needs its offline "universal checkpoint"
+conversion (``checkpoint/ds_to_universal.py``) to recover.
+"""
+
+import abc
+
+
+class CheckpointEngine(abc.ABC):
+    def __init__(self, config_params=None):
+        self.config_params = config_params
+
+    def create(self, tag: str):
+        """Hook called once per checkpoint tag before saves (logging/placeholders)."""
+
+    @abc.abstractmethod
+    def save(self, state_dict: dict, path: str):
+        ...
+
+    @abc.abstractmethod
+    def load(self, path: str, map_location=None) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def makedirs(self, path: str, exist_ok: bool = True):
+        ...
+
+    def commit(self, tag: str) -> bool:
+        """Mark a tag durable (async engines flush here)."""
+        return True

@@ -1,0 +1,91 @@
+"""Default checkpoint engine: flattened-pytree npz + JSON metadata.
+
+Fills the role of the reference's ``TorchCheckpointEngine`` (torch.save/load).
+Arrays are written as full (unsharded) global values — see the ABC docstring for why
+that makes every checkpoint "universal". An Orbax-based async engine is the Nebula
+analogue and can be selected via config.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+from .checkpoint_engine import CheckpointEngine
+
+_SEP = "||"
+
+
+def _flatten(state_dict):
+    """Flatten nested dict/list/tuple structure into (path, leaf) pairs."""
+    flat = {}
+    meta = {}
+
+    def walk(obj, path):
+        if isinstance(obj, dict):
+            meta[path or "<root>"] = {"kind": "dict", "keys": list(obj.keys())}
+            for k, v in obj.items():
+                walk(v, f"{path}{_SEP}{k}" if path else str(k))
+        elif isinstance(obj, (list, tuple)):
+            meta[path or "<root>"] = {"kind": type(obj).__name__, "len": len(obj)}
+            for i, v in enumerate(obj):
+                walk(v, f"{path}{_SEP}{i}" if path else str(i))
+        elif obj is None:
+            meta[path] = {"kind": "none"}
+        elif isinstance(obj, (str, bool)):
+            meta[path] = {"kind": "scalar", "value": obj}
+        elif isinstance(obj, (int, float)):
+            meta[path] = {"kind": "scalar", "value": obj}
+        else:
+            flat[path] = np.asarray(jax.device_get(obj))
+            meta[path] = {"kind": "array"}
+
+    walk(state_dict, "")
+    return flat, meta
+
+
+def _unflatten(flat, meta):
+    def build(path):
+        info = meta.get(path if path else "<root>")
+        if info is None:
+            raise KeyError(f"checkpoint missing metadata for '{path}'")
+        kind = info["kind"]
+        if kind == "dict":
+            return {
+                k: build(f"{path}{_SEP}{k}" if path else str(k)) for k in info["keys"]
+            }
+        if kind in ("list", "tuple"):
+            items = [build(f"{path}{_SEP}{i}" if path else str(i)) for i in range(info["len"])]
+            return items if kind == "list" else tuple(items)
+        if kind == "none":
+            return None
+        if kind == "scalar":
+            return info["value"]
+        return flat[path]
+
+    return build("")
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    def makedirs(self, path, exist_ok=True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict, path):
+        flat, meta = _flatten(state_dict)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        np.savez(tmp, **flat)
+        # numpy appends .npz to the name it writes
+        os.replace(tmp + ".npz", path)
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+        logger.debug(f"[NativeCheckpointEngine] saved {path} ({len(flat)} arrays)")
+
+    def load(self, path, map_location=None):
+        with open(path + ".meta.json") as f:
+            meta = json.load(f)
+        with np.load(path, allow_pickle=False) as z:
+            flat = {k: z[k] for k in z.files}
+        return _unflatten(flat, meta)

@@ -170,7 +170,10 @@ class DeepSpeedConfig:
         self.world_size = world_size
 
         # --- mesh / parallel topology ---
-        self.mesh_config = MeshConfig.from_dict(pd.get(C.MESH, mesh_shape or {}))
+        # explicit mesh_shape argument (programmatic) overrides the config block
+        self.mesh_config = MeshConfig.from_dict(
+            mesh_shape if mesh_shape is not None else pd.get(C.MESH, {})
+        )
 
         # --- precision ---
         self.fp16_config = FP16Config.from_dict(pd.get(C.FP16, {}))

@@ -1,0 +1,130 @@
+"""Data loading.
+
+Parity with reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader``,
+``RepeatingLoader``; built by ``deepspeed_io``, ``engine.py:1697``). The loader
+yields *global* batches as sharded ``jax.Array``s: leading dim = micro_batch ×
+DP-degree, placed with the batch PartitionSpec so each data-parallel mesh slice
+holds its shard — the single-controller equivalent of per-rank DistributedSampler
+shards.
+"""
+
+import math
+from typing import Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..comm.topology import MeshTopology
+from .zero.partition import batch_spec
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference ``RepeatingLoader``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _to_numpy_batch(samples):
+    """Collate a list of samples (tuples/dicts/arrays) into stacked numpy arrays."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """Batches a map-style or iterable dataset onto the mesh.
+
+    ``batch_size`` here is the GLOBAL micro-batch (micro_batch_per_replica × DP),
+    computed by the engine. Deterministic shuffling via numpy RNG seeded per epoch
+    (``set_epoch`` keeps the DistributedSampler-compatible surface).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        topology: MeshTopology,
+        collate_fn=None,
+        shuffle: bool = False,
+        seed: int = 1234,
+        drop_last: bool = True,
+        pin_memory: bool = False,  # accepted for config parity; host staging is XLA's
+        num_local_io_workers: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.topology = topology
+        self.collate_fn = collate_fn or _to_numpy_batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self._sharding = NamedSharding(topology.mesh, batch_spec(topology))
+        try:
+            self._len = len(dataset)
+        except TypeError:
+            self._len = None
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self._len is None:
+            raise TypeError("underlying dataset has no __len__")
+        if self.drop_last:
+            return self._len // self.batch_size
+        return math.ceil(self._len / self.batch_size)
+
+    def _device_put(self, batch):
+        def put(x):
+            x = np.asarray(x)
+            if x.ndim == 0 or x.shape[0] % self._zero_degree() != 0:
+                return jax.device_put(x, NamedSharding(self.topology.mesh, jax.sharding.PartitionSpec()))
+            return jax.device_put(x, self._sharding)
+
+        return jax.tree.map(put, batch)
+
+    def _zero_degree(self):
+        return self.topology.data_parallel_size
+
+    def __iter__(self) -> Iterator:
+        if self._len is not None:
+            order = np.arange(self._len)
+            if self.shuffle:
+                rng = np.random.default_rng(self.seed + self.epoch)
+                rng.shuffle(order)
+            nb = len(self)
+            for b in range(nb):
+                idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+                if len(idx) < self.batch_size and self.drop_last:
+                    return
+                samples = [self.dataset[int(i)] for i in idx]
+                yield self._device_put(self.collate_fn(samples))
+        else:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self._device_put(self.collate_fn(buf))
+                    buf = []
+            if buf and not self.drop_last:
+                yield self._device_put(self.collate_fn(buf))

@@ -1,0 +1,125 @@
+"""Loss scaling for fp16 training.
+
+Parity with reference ``runtime/fp16/loss_scaler.py`` (``LossScaler``,
+``DynamicLossScaler``). The scaler state is a small pytree carried through the
+jitted step so scale updates happen on-device with no host sync; ``has_overflow``
+is computed from the global gradient pytree (any inf/nan) exactly like the
+reference's ``CHECK_OVERFLOW`` path.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerState(NamedTuple):
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iter_: jnp.ndarray  # i32 scalar
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True if any grad element is inf/nan (reference ``_has_inf_or_nan``)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+class BaseLossScaler:
+    dynamic = False
+
+    def __init__(self, scale: float = 1.0):
+        self.init_scale = float(scale)
+
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(
+            cur_scale=jnp.asarray(self.init_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(1, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            iter_=jnp.asarray(0, jnp.int32),
+        )
+
+    def update(self, state: LossScalerState, overflow: jnp.ndarray) -> LossScalerState:
+        return state._replace(iter_=state.iter_ + 1)
+
+
+class LossScaler(BaseLossScaler):
+    """Static scale (config ``fp16.loss_scale`` > 0)."""
+
+
+class DynamicLossScaler(BaseLossScaler):
+    """Dynamic scale with growth window + hysteresis (reference semantics):
+    overflow → consume hysteresis, then halve the scale; ``scale_window`` clean
+    iterations → double the scale."""
+
+    dynamic = True
+
+    def __init__(self, init_scale=2**32, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init_state(self) -> LossScalerState:
+        return LossScalerState(
+            cur_scale=jnp.asarray(self.init_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            iter_=jnp.asarray(0, jnp.int32),
+        )
+
+    def update(self, state: LossScalerState, overflow: jnp.ndarray) -> LossScalerState:
+        def on_overflow(s):
+            shrink = s.cur_hysteresis <= 1
+            new_scale = jnp.where(
+                shrink,
+                jnp.maximum(s.cur_scale / self.scale_factor, self.min_scale),
+                s.cur_scale,
+            )
+            new_hyst = jnp.where(shrink, s.cur_hysteresis, s.cur_hysteresis - 1)
+            return s._replace(
+                cur_scale=new_scale,
+                cur_hysteresis=new_hyst,
+                last_overflow_iter=s.iter_,
+            )
+
+        def on_clean(s):
+            grow = (s.iter_ - s.last_overflow_iter) % self.scale_window == self.scale_window - 1
+            new_scale = jnp.where(grow, s.cur_scale * self.scale_factor, s.cur_scale)
+            new_hyst = (
+                jnp.asarray(self.delayed_shift, jnp.int32)
+                if not self.consecutive_hysteresis
+                else s.cur_hysteresis
+            )
+            return s._replace(cur_scale=new_scale, cur_hysteresis=new_hyst)
+
+        new_state = jax.lax.cond(overflow, on_overflow, on_clean, state)
+        return new_state._replace(iter_=state.iter_ + 1)
+
+
+def CreateLossScaler(fp16_config, dtype_is_fp16: bool) -> BaseLossScaler:
+    """Factory mirroring reference ``loss_scaler.CreateLossScaler``."""
+    if not dtype_is_fp16:
+        return LossScaler(scale=1.0)
+    if fp16_config.dynamic_loss_scale:
+        return DynamicLossScaler(
+            init_scale=2**fp16_config.initial_scale_power,
+            scale_window=fp16_config.loss_scale_window,
+            min_scale=fp16_config.min_loss_scale,
+            delayed_shift=fp16_config.hysteresis,
+            consecutive_hysteresis=fp16_config.consecutive_hysteresis,
+        )
+    return LossScaler(scale=fp16_config.loss_scale)

@@ -1,0 +1,136 @@
+"""ZeRO stage → GSPMD sharding rules.
+
+This module is the TPU-native answer to the reference's partitioning machinery
+(``stage_1_and_2.py`` flattened-group partitioning, ``stage3.py`` +
+``partition_parameters.py`` ds-tensor conversion, ``partitioned_param_coordinator``
+prefetching): instead of hook-driven gather/release, each ZeRO stage is a set of
+sharding rules over the parameter / gradient / optimizer-state pytrees. XLA's SPMD
+partitioner then schedules the same collectives the reference issues manually —
+stage-1 all-gather of updated partitions, stage-2 reduce-scatter of gradients,
+stage-3 just-in-time parameter all-gathers during fwd/bwd (with scheduling latitude
+the hook design cannot express).
+
+| stage | params      | grads            | optimizer state (incl. fp32 master) |
+|-------|-------------|------------------|--------------------------------------|
+| 0     | replicated* | replicated (psum)| replicated                           |
+| 1     | replicated* | replicated (psum)| sharded over ZeRO axes               |
+| 2     | replicated* | sharded (r-sctr) | sharded                              |
+| 3     | sharded     | sharded          | sharded                              |
+
+(*) after applying any tensor-parallel PartitionSpec from the model.
+
+Sharding rule for a leaf: keep the model's TP spec; for ZeRO sharding, assign the
+ZeRO axes to the largest dimension that is not already TP-sharded and is divisible
+by the ZeRO degree; leaves with no such dimension stay replicated (the same
+size-threshold escape hatch as the reference's ``param_persistence_threshold``).
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...comm.topology import ZERO_AXES, MeshTopology
+
+
+def _spec_axes(spec) -> set:
+    used = set()
+    if spec is None:
+        return used
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def _zero_degree(topo: MeshTopology) -> int:
+    return int(np.prod([topo.get_dim(a) for a in ZERO_AXES]))
+
+
+def shard_leaf_spec(shape, tp_spec: Optional[PartitionSpec], topo: MeshTopology,
+                    min_size: int = 1) -> PartitionSpec:
+    """Add ZeRO axes to a leaf's PartitionSpec (on top of its TP spec)."""
+    degree = _zero_degree(topo)
+    entries = list(tp_spec) if tp_spec is not None else []
+    entries += [None] * (len(shape) - len(entries))
+    if degree == 1 or int(np.prod(shape or (1,))) < min_size:
+        return PartitionSpec(*entries)
+    used = _spec_axes(tp_spec)
+    zero_axes = tuple(a for a in ZERO_AXES if topo.get_dim(a) > 1 and a not in used)
+    if not zero_axes:
+        return PartitionSpec(*entries)
+    zdeg = int(np.prod([topo.get_dim(a) for a in zero_axes]))
+    # choose the largest unsharded dim divisible by the zero degree
+    best = -1
+    best_size = 0
+    for i, d in enumerate(shape):
+        already = entries[i] is not None
+        if already:
+            # dim is TP-sharded; the per-shard size must still divide
+            continue
+        if d % zdeg == 0 and d > best_size:
+            best, best_size = i, d
+    if best < 0:
+        return PartitionSpec(*entries)
+    entries[best] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    return PartitionSpec(*entries)
+
+
+def stage_param_specs(params, stage: int, topo: MeshTopology, tp_specs=None,
+                      persistence_threshold: int = 0):
+    """PartitionSpec pytree for the (lp) parameters at a given ZeRO stage."""
+    def leaf_spec(path_leaf, tp):
+        if stage >= 3:
+            return shard_leaf_spec(path_leaf.shape, tp, topo, min_size=max(1, persistence_threshold))
+        return tp if tp is not None else PartitionSpec()
+
+    if tp_specs is None:
+        return jax.tree.map(lambda p: leaf_spec(p, None), params)
+    return jax.tree.map(leaf_spec, params, tp_specs)
+
+
+def stage_grad_specs(params, stage: int, topo: MeshTopology, tp_specs=None):
+    """Gradients: stages ≥2 are reduce-scattered ⇒ sharded like stage-3 params."""
+    def leaf_spec(p, tp):
+        if stage >= 2:
+            return shard_leaf_spec(p.shape, tp, topo)
+        return tp if tp is not None else PartitionSpec()
+
+    if tp_specs is None:
+        return jax.tree.map(lambda p: leaf_spec(p, None), params)
+    return jax.tree.map(leaf_spec, params, tp_specs)
+
+
+def stage_opt_specs(params, stage: int, topo: MeshTopology, tp_specs=None):
+    """Optimizer state (fp32 master + moments): stages ≥1 sharded over ZeRO axes."""
+    def leaf_spec(p, tp):
+        if stage >= 1:
+            return shard_leaf_spec(p.shape, tp, topo)
+        return tp if tp is not None else PartitionSpec()
+
+    if tp_specs is None:
+        return jax.tree.map(lambda p: leaf_spec(p, None), params)
+    return jax.tree.map(leaf_spec, params, tp_specs)
+
+
+def to_named(specs, topo: MeshTopology):
+    return jax.tree.map(
+        lambda s: NamedSharding(topo.mesh, s),
+        specs,
+        is_leaf=lambda s: isinstance(s, PartitionSpec),
+    )
+
+
+def batch_spec(topo: MeshTopology) -> PartitionSpec:
+    """Global batch sharded over the full DP degree on the leading dim; the seq
+    axis (if any) shards dim 1 (sequence parallelism)."""
+    dp_axes = tuple(a for a in ZERO_AXES if topo.get_dim(a) > 1)
+    dims = [dp_axes if len(dp_axes) > 1 else (dp_axes[0] if dp_axes else None)]
+    if topo.get_dim("seq") > 1:
+        dims.append("seq")
+    return PartitionSpec(*dims)

@@ -1,0 +1,47 @@
+"""Tiny model fixtures (reference ``tests/unit/simple_model.py``: ``SimpleModel:20``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_simple_model(hidden_dim=16, nlayers=2, seed=0):
+    """An MLP regression model: apply returns scalar MSE loss.
+
+    Returns (params, apply_fn) — the engine's model protocol.
+    """
+    rng = np.random.default_rng(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": jnp.asarray(rng.standard_normal((hidden_dim, hidden_dim)) * 0.1, jnp.float32),
+            "b": jnp.zeros((hidden_dim,), jnp.float32),
+        }
+
+    def apply_fn(params, batch, train=True, rng=None):
+        x, y = batch
+        h = x
+        for i in range(nlayers):
+            lyr = params[f"layer_{i}"]
+            h = h @ lyr["w"].astype(h.dtype) + lyr["b"].astype(h.dtype)
+            if i < nlayers - 1:
+                h = jax.nn.relu(h)
+        return jnp.mean(jnp.square(h - y).astype(jnp.float32))
+
+    return params, apply_fn
+
+
+def random_dataset(n=64, hidden_dim=16, seed=0):
+    """List of (x, y) sample pairs for the dataloader."""
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((n, hidden_dim)).astype(np.float32)
+    w_true = rng.standard_normal((hidden_dim, hidden_dim)).astype(np.float32) * 0.3
+    ys = xs @ w_true
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+def random_batch(batch_size=8, hidden_dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((batch_size, hidden_dim)).astype(np.float32)
+    y = rng.standard_normal((batch_size, hidden_dim)).astype(np.float32)
+    return (jnp.asarray(x), jnp.asarray(y))

@@ -1,0 +1,258 @@
+"""Engine tests (modeled on reference tests/unit/runtime/test_ds_initialize.py,
+test_zero.py loss-decreases patterns)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from tests.unit.simple_model import make_simple_model, random_batch, random_dataset
+
+HIDDEN = 16
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def train_steps(engine, steps=5, seed=0):
+    """Repeatedly fit the same micro-batches (per-GAS-slot fixed data), like the
+    reference's loss-decreases tests."""
+    losses = []
+    for _ in range(steps):
+        for k in range(engine.gradient_accumulation_steps):
+            batch = random_batch(
+                batch_size=engine.train_batch_size // engine.gradient_accumulation_steps,
+                hidden_dim=HIDDEN, seed=seed + k,
+            )
+            loss = engine(batch)
+            engine.backward(loss)
+            losses.append(float(loss))
+        engine.step()
+    return losses
+
+
+def test_initialize_returns_tuple():
+    model = make_simple_model(HIDDEN)
+    engine, opt, loader, sched = deepspeed_tpu.initialize(model=model, config=base_config())
+    assert opt is engine.optimizer
+    assert loader is None and sched is None
+    assert engine.zero_optimization_stage() == 0
+
+
+def test_fp32_loss_decreases():
+    model = make_simple_model(HIDDEN)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=base_config())
+    losses = train_steps(engine, steps=10)
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stages_match_stage0(stage):
+    """ZeRO resharding must not change the math: loss trajectories match stage 0."""
+    ref_losses = train_steps(
+        deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=base_config())[0],
+        steps=5,
+    )
+    from deepspeed_tpu.comm.topology import reset_topology
+
+    reset_topology()
+    cfg = base_config(zero_optimization={"stage": stage})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    losses = train_steps(engine, steps=5)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+
+
+def test_zero3_params_actually_sharded():
+    # persistence threshold 0: shard even tiny params (default keeps <100k replicated)
+    cfg = base_config(zero_optimization={"stage": 3, "stage3_param_persistence_threshold": 0})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    leaf = engine.params["layer_0"]["w"]
+    assert not leaf.sharding.is_fully_replicated
+    # optimizer moments shard with the same rule
+    assert not engine.opt_state.m["layer_0"]["w"].sharding.is_fully_replicated
+
+
+def test_gradient_accumulation():
+    cfg = base_config(train_batch_size=64, gradient_accumulation_steps=4)
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    assert engine.train_micro_batch_size_per_gpu == 2  # 64 / (8 dp × 4 gas)
+    train_steps(engine, steps=3)
+    assert engine.global_steps == 3
+    assert engine.micro_steps == 12
+
+
+def test_bf16_training():
+    cfg = base_config(bf16={"enabled": True})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    assert engine.params["layer_0"]["w"].dtype == jnp.bfloat16
+    assert engine.master_params["layer_0"]["w"].dtype == jnp.float32
+    losses = train_steps(engine, steps=10)
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_training_with_loss_scale():
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 8})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    assert engine.loss_scale() == 2**8
+    losses = train_steps(engine, steps=10)
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_overflow_skips_step_and_shrinks_scale():
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 1})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    params_before = jax.device_get(engine.params["layer_0"]["w"])
+    # poison a batch to produce inf loss → overflowed grads
+    x = jnp.full((16, HIDDEN), 1e30, jnp.float32)
+    y = jnp.zeros((16, HIDDEN), jnp.float32)
+    loss = engine((x, y))
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert engine.loss_scale() == 2**3  # halved
+    params_after = jax.device_get(engine.params["layer_0"]["w"])
+    np.testing.assert_array_equal(params_before, params_after)
+
+
+def test_gradient_clipping_applied():
+    # SGD so the update magnitude is proportional to the clipped gradient
+    # (Adam's normalization makes it scale-invariant)
+    cfg = base_config(
+        gradient_clipping=1e-6,
+        optimizer={"type": "SGD", "params": {"lr": 1e-2}},
+    )
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    before = jax.device_get(engine.params["layer_0"]["w"])
+    train_steps(engine, steps=1)
+    after = jax.device_get(engine.params["layer_0"]["w"])
+    # clipped to almost-zero update
+    assert np.max(np.abs(after - before)) < 1e-6
+
+
+def test_lr_scheduler_warmup():
+    cfg = base_config(
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                              "warmup_num_steps": 10, "warmup_type": "linear"}}
+    )
+    engine, _, _, sched = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    lrs = []
+    for _ in range(5):
+        train_steps(engine, steps=1)
+        lrs.append(sched.get_last_lr()[0])
+    assert lrs == sorted(lrs)  # monotone warmup
+    assert lrs[-1] < 1e-2
+
+
+def test_train_batch_with_dataloader():
+    ds = random_dataset(n=64, hidden_dim=HIDDEN)
+    cfg = base_config(train_batch_size=16, gradient_accumulation_steps=2)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=cfg, training_data=ds
+    )
+    assert loader is not None
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    it = iter(RepeatingLoader(loader))
+    l0 = float(engine.train_batch(it))
+    for _ in range(8):
+        l_final = float(engine.train_batch(it))
+    assert l_final < l0
+    assert engine.global_steps == 9
+
+
+def test_checkpoint_save_load_roundtrip(tmp_path):
+    cfg = base_config(bf16={"enabled": True})
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=cfg)
+    train_steps(engine, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="tag3")
+    w_saved = np.asarray(jax.device_get(engine.master_params["layer_0"]["w"]), np.float32)
+    ref_next = train_steps(engine, steps=2, seed=100)
+
+    from deepspeed_tpu.comm.topology import reset_topology
+
+    reset_topology()
+    engine2, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN, seed=7), config=cfg)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine2.global_steps == 3
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(engine2.master_params["layer_0"]["w"]), np.float32),
+        w_saved,
+    )
+    next_losses = train_steps(engine2, steps=2, seed=100)
+    np.testing.assert_allclose(next_losses, ref_next, rtol=1e-5)
+
+
+def test_checkpoint_latest_tag(tmp_path):
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=base_config())
+    train_steps(engine, steps=1)
+    engine.save_checkpoint(str(tmp_path))
+    assert (tmp_path / "latest").read_text() == "global_step1"
+
+
+def test_checkpoint_resharding_across_stages(tmp_path):
+    """A stage-0 checkpoint loads into a stage-3 engine (universal by construction)."""
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN), config=base_config())
+    train_steps(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="x")
+    w = jax.device_get(engine.params["layer_0"]["w"])
+
+    from deepspeed_tpu.comm.topology import reset_topology
+
+    reset_topology()
+    cfg3 = base_config(zero_optimization={"stage": 3, "stage3_param_persistence_threshold": 0})
+    engine3, *_ = deepspeed_tpu.initialize(model=make_simple_model(HIDDEN, seed=9), config=cfg3)
+    engine3.load_checkpoint(str(tmp_path), tag="x")
+    np.testing.assert_allclose(np.asarray(jax.device_get(engine3.params["layer_0"]["w"])), w, rtol=1e-6)
+    assert not engine3.params["layer_0"]["w"].sharding.is_fully_replicated
+
+
+def test_train_batch_advances_through_dataset():
+    """Successive train_batch() calls must consume successive batches, not restart."""
+    ds = random_dataset(n=64, hidden_dim=HIDDEN)
+    cfg = base_config(train_batch_size=16, gradient_accumulation_steps=1)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=cfg, training_data=ds
+    )
+    seen = []
+    orig_forward = engine.forward
+
+    def spy(batch, **kw):
+        seen.append(np.asarray(jax.device_get(batch[0]))[0, 0])
+        return orig_forward(batch, **kw)
+
+    engine.forward = spy
+    for _ in range(3):
+        engine.train_batch()
+    assert len(set(seen)) == 3  # three distinct batches
+
+
+def test_warmup_cosine_does_not_compound():
+    from deepspeed_tpu.runtime.lr_schedules import WarmupCosineLR
+
+    class Opt:
+        lr = 1e-2
+
+    sched = WarmupCosineLR(Opt(), total_num_steps=100, warmup_num_steps=10)
+    for _ in range(11):
+        sched.step()
+    # at end of warmup the lr must be ~the configured peak, not collapsed
+    assert sched.get_last_lr()[0] == pytest.approx(1e-2, rel=0.05)
+
+
+def test_mesh_config_argument_honored():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=base_config(),
+        mesh_config={"model": 2},
+    )
+    assert engine.topology.model_parallel_size == 2
