@@ -1,0 +1,10 @@
+"""Model families (reference deepspeed/model_implementations + inference v2 model impls)."""
+
+from .transformer import (  # noqa: F401
+    MODEL_PRESETS,
+    TransformerConfig,
+    TransformerLM,
+    build_model,
+    gpt2_config,
+    llama_config,
+)

@@ -1,0 +1,496 @@
+"""Decoder-only transformer LM — the flagship model family.
+
+The reference ships models as torch ``nn.Module`` graphs that the engine wraps
+(e.g. the hand-fused BERT layer ``deepspeed/ops/transformer/transformer.py:296``,
+the inference model implementations ``deepspeed/inference/v2/model_implementations/
+{llama_v2,mistral,...}``). The TPU-native design is one functional LM whose config
+spans both families:
+
+- GPT-2 style: learned positions, LayerNorm (with bias), GELU MLP, tied embeddings.
+- LLaMA style: rotary positions, RMSNorm, SwiGLU MLP, grouped-query attention.
+
+Architecture choices driven by XLA/TPU:
+- **scan over layers**: block weights are stacked along a leading layer axis and the
+  body is a single traced block → compile time is O(1) in depth, and
+  ``jax.checkpoint`` on the block gives per-layer rematerialisation (the analogue of
+  reference ``runtime/activation_checkpointing/checkpointing.py``).
+- **sharding by annotation**: tensor parallelism is a pytree of ``PartitionSpec``
+  (``tp_specs``) over the mesh's ``model`` axis — column-parallel QKV/up-proj,
+  row-parallel out/down-proj, vocab-parallel embedding. Sequence parallelism
+  (Ulysses, reference ``deepspeed/sequence/layer.py:60``) is expressed as sharding
+  constraints: activations live seq-sharded; inside attention heads are re-sharded
+  over the ``seq`` axis so XLA inserts the same all-to-alls the reference issues
+  manually.
+- bf16 compute / fp32 softmax+loss; static shapes throughout; causal masking via
+  iota comparison (no materialised (S,S) bool tensor at peak memory).
+"""
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer.attention import attention as _attention_op
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304  # padded to a multiple of 128 (MXU lane width)
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    intermediate_size: Optional[int] = None  # None → 4*H (gelu) or 8/3*H (swiglu)
+    max_seq_len: int = 1024
+    # family knobs
+    pos_embedding: str = "learned"  # "learned" | "rope" | "none"
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "swiglu"
+    tie_embeddings: bool = True
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    # training knobs
+    remat: bool = False  # per-block activation rematerialisation
+    remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
+    param_dtype: Any = jnp.float32
+    # fraction of attention logits softcapped (gemma-style); 0 = off
+    logit_softcap: float = 0.0
+    name: str = "transformer"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        if self.activation == "swiglu":
+            # llama convention: 2/3 * 4H rounded to a multiple of 256
+            d = int(8 * self.hidden_size / 3)
+            return ((d + 255) // 256) * 256
+        return 4 * self.hidden_size
+
+    @property
+    def num_parameters(self) -> int:
+        H, L, V, I = self.hidden_size, self.num_layers, self.vocab_size, self.mlp_dim
+        kvh = self.kv_heads * self.head_dim
+        attn = H * H + 2 * H * kvh + H * H  # q, k, v, o
+        mlp = (3 if self.activation == "swiglu" else 2) * H * I
+        norms = (2 if self.norm == "rmsnorm" else 4) * H
+        per_layer = attn + mlp + norms
+        emb = V * H + (0 if self.pos_embedding != "learned" else self.max_seq_len * H)
+        head = 0 if self.tie_embeddings else V * H
+        return L * per_layer + emb + head + H
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Model FLOPs per token for one fwd+bwd (6N + attention term)."""
+        S = seq_len or self.max_seq_len
+        n = self.num_parameters
+        attn_flops = 12 * self.num_layers * self.hidden_size * S  # fwd+bwd qk^T + av
+        return 6 * n + attn_flops
+
+
+# ----------------------------------------------------------------------------
+# presets (sizes follow the reference's benchmark configs, BASELINE.md)
+# ----------------------------------------------------------------------------
+
+def gpt2_config(size: str = "125m", **kw) -> TransformerConfig:
+    tbl = {
+        "125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+        "350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+        "760m": dict(hidden_size=1536, num_layers=24, num_heads=16),
+        "1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+        "2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+        "6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32),
+        "13b": dict(hidden_size=5120, num_layers=40, num_heads=40),
+    }
+    base = dict(
+        vocab_size=50304, max_seq_len=1024, pos_embedding="learned",
+        norm="layernorm", activation="gelu", tie_embeddings=True,
+        name=f"gpt2-{size}",
+    )
+    base.update(tbl[size])
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def llama_config(size: str = "7b", **kw) -> TransformerConfig:
+    tbl = {
+        "tiny": dict(hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=4,
+                     intermediate_size=688, max_seq_len=2048),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                   intermediate_size=11008, max_seq_len=4096),
+        "13b": dict(hidden_size=5120, num_layers=40, num_heads=40,
+                    intermediate_size=13824, max_seq_len=4096),
+        "70b": dict(hidden_size=8192, num_layers=80, num_heads=64, num_kv_heads=8,
+                    intermediate_size=28672, max_seq_len=4096),
+    }
+    base = dict(
+        vocab_size=32000, pos_embedding="rope", norm="rmsnorm",
+        activation="swiglu", tie_embeddings=False, norm_eps=1e-5,
+        name=f"llama-{size}",
+    )
+    base.update(tbl[size])
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+MODEL_PRESETS = {
+    "gpt2-125m": lambda **kw: gpt2_config("125m", **kw),
+    "gpt2-350m": lambda **kw: gpt2_config("350m", **kw),
+    "gpt2-760m": lambda **kw: gpt2_config("760m", **kw),
+    "gpt2-1.3b": lambda **kw: gpt2_config("1.3b", **kw),
+    "gpt2-2.7b": lambda **kw: gpt2_config("2.7b", **kw),
+    "gpt2-6.7b": lambda **kw: gpt2_config("6.7b", **kw),
+    "llama-tiny": lambda **kw: llama_config("tiny", **kw),
+    "llama-7b": lambda **kw: llama_config("7b", **kw),
+    "llama-13b": lambda **kw: llama_config("13b", **kw),
+    "llama-70b": lambda **kw: llama_config("70b", **kw),
+}
+
+
+# ----------------------------------------------------------------------------
+# functional pieces
+# ----------------------------------------------------------------------------
+
+def _norm(x, scale, bias, kind: str, eps: float):
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    else:
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _rope(q, k, positions, head_dim, theta):
+    """Rotary embedding applied to (B,S,h,d) q/k at integer positions (B,S)."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions[..., None].astype(jnp.float32) * freqs  # (B,S,half)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def _dropout(x, rate, rng, train):
+    if rate == 0.0 or not train or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+class TransformerLM:
+    """Functional decoder LM implementing the engine model protocol
+    (``init_params`` / ``apply`` / ``tp_specs``) plus inference entry points
+    (``logits`` / ``decode_step``) used by the inference engine."""
+
+    def __init__(self, config: TransformerConfig, mesh_axes: Tuple[str, str] = ("model", "seq")):
+        self.config = config
+        self.model_axis, self.seq_axis = mesh_axes
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng) -> Dict[str, Any]:
+        cfg = self.config
+        H, L, V, I = cfg.hidden_size, cfg.num_layers, cfg.vocab_size, cfg.mlp_dim
+        nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dt = cfg.param_dtype
+        k = jax.random.split(rng, 10)
+        init = jax.nn.initializers.normal(0.02)
+        # residual-branch projections get the depth-scaled init (GPT-2 paper)
+        resid_init = jax.nn.initializers.normal(0.02 / np.sqrt(2 * L))
+
+        def stacked(key, shape, initializer=init):
+            return initializer(key, (L,) + shape, dt)
+
+        params: Dict[str, Any] = {
+            "wte": init(k[0], (V, H), dt),
+            "blocks": {
+                "ln1_scale": jnp.ones((L, H), dt),
+                "wq": stacked(k[1], (H, nh * hd)),
+                "wk": stacked(k[2], (H, kvh * hd)),
+                "wv": stacked(k[3], (H, kvh * hd)),
+                "wo": stacked(k[4], (nh * hd, H), resid_init),
+                "ln2_scale": jnp.ones((L, H), dt),
+                "w_down": stacked(k[6], (I, H), resid_init),
+            },
+            "lnf_scale": jnp.ones((H,), dt),
+        }
+        blocks = params["blocks"]
+        if cfg.activation == "swiglu":
+            blocks["w_gate"] = stacked(k[5], (H, I))
+            blocks["w_up"] = stacked(k[7], (H, I))
+        else:
+            blocks["w_up"] = stacked(k[5], (H, I))
+        if cfg.norm == "layernorm":
+            blocks["ln1_bias"] = jnp.zeros((L, H), dt)
+            blocks["ln2_bias"] = jnp.zeros((L, H), dt)
+            blocks["attn_bias"] = jnp.zeros((L, H), dt)
+            blocks["mlp_bias"] = jnp.zeros((L, H), dt)
+            params["lnf_bias"] = jnp.zeros((H,), dt)
+        if cfg.pos_embedding == "learned":
+            params["wpe"] = init(k[8], (cfg.max_seq_len, H), dt)
+        if not cfg.tie_embeddings:
+            params["lm_head"] = init(k[9], (H, V), dt)
+        return params
+
+    # ------------------------------------------------------------------
+    @property
+    def tp_specs(self) -> Dict[str, Any]:
+        """PartitionSpec pytree: tensor parallelism over the ``model`` mesh axis.
+
+        Column-parallel wq/wk/wv/w_up/w_gate, row-parallel wo/w_down (Megatron
+        layout, reference ``module_inject/auto_tp.py`` sharding rules), vocab-
+        parallel embedding/lm_head. Leading dim of block leaves is the layer axis.
+        """
+        cfg = self.config
+        m = self.model_axis
+        specs: Dict[str, Any] = {
+            "wte": P(m, None),
+            "blocks": {
+                "ln1_scale": P(None, None),
+                "wq": P(None, None, m),
+                "wk": P(None, None, m),
+                "wv": P(None, None, m),
+                "wo": P(None, m, None),
+                "ln2_scale": P(None, None),
+                "w_down": P(None, m, None),
+                "w_up": P(None, None, m),
+            },
+            "lnf_scale": P(None),
+        }
+        blocks = specs["blocks"]
+        if cfg.activation == "swiglu":
+            blocks["w_gate"] = P(None, None, m)
+        if cfg.norm == "layernorm":
+            blocks["ln1_bias"] = P(None, None)
+            blocks["ln2_bias"] = P(None, None)
+            blocks["attn_bias"] = P(None, None)
+            blocks["mlp_bias"] = P(None, None)
+            specs["lnf_bias"] = P(None)
+        if cfg.pos_embedding == "learned":
+            specs["wpe"] = P(None, None)
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = P(None, m)
+        return specs
+
+    # ------------------------------------------------------------------
+    def _constraint(self, x, spec):
+        """Sharding constraint if we are under a mesh; no-op otherwise."""
+        try:
+            return jax.lax.with_sharding_constraint(x, spec)
+        except (ValueError, RuntimeError):
+            return x
+
+    def _act_spec(self, seq_sharded: bool):
+        # activations: batch over (data, expert); seq axis over "seq" when sharded
+        return P(("data", "expert"), self.seq_axis if seq_sharded else None, None)
+
+    def _heads_spec(self):
+        # Ulysses: inside attention, seq gathered, heads sharded over seq×model
+        return P(("data", "expert"), None, (self.seq_axis, self.model_axis), None)
+
+    # ------------------------------------------------------------------
+    def _block(self, x, blk, *, positions, rng, train, kv_cache=None, cache_index=None):
+        """One transformer block on (B, S, H). Returns (y, new_kv) where new_kv is
+        the updated (k, v) when decoding with a cache."""
+        cfg = self.config
+        nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        B, S, H = x.shape
+
+        h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        q = (h @ blk["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
+        kk = (h @ blk["wk"].astype(h.dtype)).reshape(B, S, kvh, hd)
+        v = (h @ blk["wv"].astype(h.dtype)).reshape(B, S, kvh, hd)
+        if cfg.pos_embedding == "rope":
+            q, kk = _rope(q, kk, positions, hd, cfg.rope_theta)
+
+        new_kv = None
+        if kv_cache is not None:
+            ck, cv = kv_cache  # (B, T, kvh, hd)
+            ck = jax.lax.dynamic_update_slice(ck, kk.astype(ck.dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+            new_kv = (ck, cv)
+            attn_out = _attention_op(
+                q, ck, cv, causal=True, q_offset=cache_index,
+                num_kv_groups=nh // kvh, softcap=cfg.logit_softcap,
+            )
+        else:
+            # Ulysses reshard: gather seq, shard heads (no-op when seq axis == 1)
+            q = self._constraint(q, self._heads_spec())
+            kk = self._constraint(kk, self._heads_spec())
+            v = self._constraint(v, self._heads_spec())
+            attn_out = _attention_op(
+                q, kk, v, causal=True, num_kv_groups=nh // kvh,
+                softcap=cfg.logit_softcap,
+            )
+        attn_out = attn_out.reshape(B, S, nh * hd)
+        attn_out = attn_out @ blk["wo"].astype(h.dtype)
+        if "attn_bias" in blk:
+            attn_out = attn_out + blk["attn_bias"].astype(h.dtype)
+        attn_out = self._constraint(attn_out, self._act_spec(kv_cache is None))
+        if rng is not None:
+            rng, r1 = jax.random.split(rng)
+            attn_out = _dropout(attn_out, cfg.dropout, r1, train)
+        x = x + attn_out
+
+        h = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.activation == "swiglu":
+            g = h @ blk["w_gate"].astype(h.dtype)
+            u = h @ blk["w_up"].astype(h.dtype)
+            inter = jax.nn.silu(g) * u
+        else:
+            inter = jax.nn.gelu(h @ blk["w_up"].astype(h.dtype), approximate=True)
+        mlp_out = inter @ blk["w_down"].astype(h.dtype)
+        if "mlp_bias" in blk:
+            mlp_out = mlp_out + blk["mlp_bias"].astype(h.dtype)
+        mlp_out = self._constraint(mlp_out, self._act_spec(kv_cache is None))
+        if rng is not None:
+            rng, r2 = jax.random.split(rng)
+            mlp_out = _dropout(mlp_out, cfg.dropout, r2, train)
+        return x + mlp_out, new_kv
+
+    # ------------------------------------------------------------------
+    def _embed(self, params, input_ids, positions, dtype):
+        cfg = self.config
+        x = jnp.take(params["wte"], input_ids, axis=0).astype(dtype)
+        if cfg.pos_embedding == "learned":
+            x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
+        return x
+
+    def _ckpt(self, fn):
+        if self.config.remat_policy == "dots":
+            return jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        return jax.checkpoint(fn)
+
+    def _trunk(self, params, x, positions, rng, train):
+        """Run all blocks via scan (remat optional)."""
+        cfg = self.config
+
+        if rng is not None and cfg.dropout > 0 and train:
+            rngs = jax.random.split(rng, cfg.num_layers)
+
+            def body(h, layer):
+                blk, rsub = layer
+                y, _ = self._block(h, blk, positions=positions, rng=rsub, train=train)
+                return y, None
+
+            block_fn = self._ckpt(body) if cfg.remat else body
+            x, _ = jax.lax.scan(block_fn, x, (params["blocks"], rngs))
+        else:
+            def body(h, blk):
+                y, _ = self._block(h, blk, positions=positions, rng=None, train=train)
+                return y, None
+
+            block_fn = self._ckpt(body) if cfg.remat else body
+            x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+        return x
+
+    def _head(self, params, x):
+        cfg = self.config
+        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm, cfg.norm_eps)
+        w = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+        return x @ w.astype(x.dtype)  # (B,S,V)
+
+    # ------------------------------------------------------------------
+    def logits(self, params, input_ids, positions=None, train=False, rng=None):
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        dtype = jax.tree.leaves(params)[0].dtype
+        x = self._embed(params, input_ids, positions, dtype)
+        x = self._constraint(x, self._act_spec(True))
+        x = self._trunk(params, x, positions, rng, train)
+        return self._head(params, x)
+
+    def apply(self, params, batch, train=True, rng=None):
+        """Next-token LM loss over the batch (engine protocol).
+
+        ``batch``: dict with ``input_ids`` (B,S) int32 and optional ``labels``
+        (shifted internally when absent; -100 = ignore), or a bare (B,S) array,
+        or an (input_ids, labels) tuple.
+        """
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+            positions = batch.get("positions")
+        elif isinstance(batch, (tuple, list)):
+            input_ids, labels = batch
+            positions = None
+        else:
+            input_ids, labels, positions = batch, None, None
+
+        lg = self.logits(params, input_ids, positions=positions, train=train, rng=rng)
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
+            )
+        lg = lg.astype(jnp.float32)
+        mask = labels != -100
+        safe = jnp.where(mask, labels, 0)
+        logz = jax.scipy.special.logsumexp(lg, axis=-1)
+        gold = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * mask
+        loss = jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1)
+        return loss
+
+    # ------------------------------------------------------------------
+    # inference: prefill + single-token decode with a static KV cache
+    # ------------------------------------------------------------------
+    def init_kv_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.config
+        shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
+        """Run a (possibly length-1) segment against the cache; returns
+        (logits_last, new_cache). Used by prefill (segment=prompt) and decode
+        (segment=1 token). Blocks iterate via scan carrying the cache."""
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = cache_index + jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32), (B, S)
+            )
+        dtype = kv_cache[0].dtype
+        x = self._embed(params, input_ids, positions, dtype)
+
+        def body(h, layer):
+            blk, ck, cv = layer
+            y, new_kv = self._block(
+                h, blk, positions=positions, rng=None, train=False,
+                kv_cache=(ck, cv), cache_index=cache_index,
+            )
+            return y, new_kv
+
+        x, (nk, nv) = jax.lax.scan(body, x, (params["blocks"], kv_cache[0], kv_cache[1]))
+        logits = self._head(params, x[:, -1:, :])
+        return logits[:, 0, :], (nk, nv)
+
+
+def build_model(preset: str, **overrides) -> TransformerLM:
+    if preset not in MODEL_PRESETS:
+        raise ValueError(f"unknown model preset '{preset}' (known: {sorted(MODEL_PRESETS)})")
+    return TransformerLM(MODEL_PRESETS[preset](**overrides))

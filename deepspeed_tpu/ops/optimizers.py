@@ -258,6 +258,7 @@ def build_optimizer(name: str, params_dict: Optional[dict] = None) -> Optimizer:
         raise ValueError(f"unknown optimizer type '{name}' (known: {sorted(OPTIMIZER_CLASSES)})")
     cls = OPTIMIZER_CLASSES[name]
     if cls is FusedAdam:
-        # DeepSpeed semantics: "Adam" = classic, "AdamW" = decoupled decay
-        params.setdefault("adam_w_mode", name == "adamw")
+        # reference semantics: "Adam" forces AdamW logic unless adam_w_mode is
+        # explicitly set (engine.py:1290, ADAM_W_MODE_DEFAULT=True)
+        params.setdefault("adam_w_mode", True)
     return cls(**params)

@@ -1,0 +1,3 @@
+"""Transformer ops: attention dispatch + Pallas kernels (reference deepspeed/ops/transformer)."""
+
+from .attention import attention, set_default_impl, xla_attention  # noqa: F401

@@ -1,0 +1,110 @@
+"""Attention ops — XLA reference implementation + Pallas kernel dispatch.
+
+Reference analogues: the fused CUDA attention kernels (training
+``csrc/transformer/softmax_kernels.cu`` and inference
+``csrc/transformer/inference/csrc/softmax.cu`` + KV-cache attention in
+``pt_binding.cpp softmax_context``). On TPU the hot path is a Pallas flash
+attention kernel (``ops/transformer/flash_attention.py``); the XLA einsum path
+below is the always-available fallback and the numerics oracle for kernel tests
+(mirroring the reference's kernel-vs-torch test strategy, SURVEY.md §4).
+
+Dispatch: ``attention()`` picks the registered implementation ("pallas" on real
+TPU when shapes allow, "xla" otherwise) — the op-builder registry seam
+(reference ``op_builder/builder.py`` + ``accelerator.create_op_builder``).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_IMPLS = {}
+_DEFAULT_IMPL = None
+
+
+def register_impl(name):
+    def deco(fn):
+        _IMPLS[name] = fn
+        return fn
+    return deco
+
+
+def set_default_impl(name: Optional[str]):
+    """Force an implementation (None = auto)."""
+    global _DEFAULT_IMPL
+    _DEFAULT_IMPL = name
+
+
+def _auto_impl(q) -> str:
+    if _DEFAULT_IMPL is not None:
+        return _DEFAULT_IMPL
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform == "tpu" and "pallas_flash" in _IMPLS:
+        # flash kernel needs seq multiple of its block size and head_dim ≤ lane width
+        S, hd = q.shape[1], q.shape[-1]
+        if S % 128 == 0 and hd in (64, 128, 256):
+            return "pallas_flash"
+    return "xla"
+
+
+@register_impl("xla")
+def xla_attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
+                  softcap=0.0, bias=None, scale=None):
+    """Plain einsum attention on (B, Sq, h, d) q and (B, Skv, hkv, d) k/v.
+
+    fp32 softmax; GQA handled by reshaping q into (hkv, groups); ``q_offset``
+    shifts the causal diagonal for KV-cache decode (query i attends to keys
+    ≤ i + q_offset).
+    """
+    B, Sq, nh, hd = q.shape
+    Skv, kvh = k.shape[1], k.shape[2]
+    groups = num_kv_groups
+    scale = scale if scale is not None else hd ** -0.5
+
+    qg = q.reshape(B, Sq, kvh, groups, hd)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if softcap and softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        qpos = jnp.arange(Sq)[:, None] + q_offset
+        kpos = jnp.arange(Skv)[None, :]
+        mask = qpos >= kpos  # (Sq, Skv)
+        logits = jnp.where(mask[None, None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, Sq, nh, hd).astype(q.dtype)
+
+
+def attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
+              softcap=0.0, bias=None, scale=None, impl: Optional[str] = None):
+    """Multi-head attention with optional GQA / causal offset / softcap.
+
+    q: (B, Sq, num_heads, head_dim); k/v: (B, Skv, kv_heads, head_dim).
+    Returns (B, Sq, num_heads, head_dim) in q.dtype.
+    """
+    name = impl or _auto_impl(q)
+    fn = _IMPLS.get(name, _IMPLS["xla"])
+    try:
+        return fn(q, k, v, causal=causal, q_offset=q_offset,
+                  num_kv_groups=num_kv_groups, softcap=softcap, bias=bias, scale=scale)
+    except NotImplementedError:
+        return _IMPLS["xla"](q, k, v, causal=causal, q_offset=q_offset,
+                             num_kv_groups=num_kv_groups, softcap=softcap,
+                             bias=bias, scale=scale)
+
+
+# register the Pallas kernel lazily (import cost + TPU-only lowering)
+def _try_register_pallas():
+    try:
+        from . import flash_attention  # noqa: F401  (registers itself)
+    except Exception:  # pragma: no cover - pallas unavailable
+        pass
+
+
+_try_register_pallas()

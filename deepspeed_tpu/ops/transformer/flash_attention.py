@@ -1,0 +1,295 @@
+"""Pallas flash attention for TPU (forward + backward).
+
+TPU-native replacement for the reference's fused attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu`` training softmax,
+``csrc/transformer/inference/csrc/softmax.cu`` and the blocked flash kernels in
+``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash``). Flash-attention-2
+style: online softmax over KV blocks, logsumexp residuals, separate dq and dk/dv
+backward kernels. Designed for the MXU: all matmuls are (128×hd)·(hd×128)-shaped
+with fp32 accumulation; causal blocks beyond the diagonal are skipped by bounding
+the KV loop with the query block's position (dynamic fori_loop trip count).
+
+Layout: kernels run on (B, heads, S, hd) so the trailing two block dims are the
+MXU-aligned (seq_block, head_dim); the public entry transposes from the model's
+(B, S, heads, hd). GQA is handled in the BlockSpec index maps (kv head =
+q head // groups) for forward/dq; dk/dv are produced per-q-head and group-summed
+by the caller.
+
+Falls back (NotImplementedError → XLA path in ``attention.py``) for: bias,
+softcap, q_offset (cache decode), or shapes not divisible by the block size.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .attention import register_impl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # (BQ, hd)
+    skv = k_ref.shape[2]
+    hd = q.shape[-1]
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, hd), jnp.float32)
+
+    q_start = qi * block_q
+    if causal:
+        # only KV blocks whose start is <= the last query row
+        num_kv = jnp.minimum((q_start + block_q + block_k - 1) // block_k,
+                             skv // block_k)
+    else:
+        num_kv = skv // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, *, causal, num_kv_groups, scale, block_q, block_k):
+    """q: (B, nh, Sq, hd); k/v: (B, kvh, Skv, hd) → out (B, nh, Sq, hd), lse (B, nh, Sq)."""
+    B, nh, Sq, hd = q.shape
+    Skv = k.shape[2]
+    grid = (B, nh, Sq // block_q)
+    g = num_kv_groups
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, nh, Sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_q, block_k, causal, scale):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+    skv = k_ref.shape[2]
+    hd = q.shape[-1]
+    q_start = qi * block_q
+
+    if causal:
+        num_kv = jnp.minimum((q_start + block_q + block_k - 1) // block_k,
+                             skv // block_k)
+    else:
+        num_kv = skv // block_k
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, hd), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, block_k, causal, scale):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)  # (BK, hd)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    sq = q_ref.shape[2]
+    hd = k.shape[-1]
+    k_start = ki * block_k
+
+    # first q block that can see this kv block
+    start_q = (k_start // block_q) if causal else 0
+    num_q = sq // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        # q is pre-scaled, so ds·q already carries the one factor of scale dk needs
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    init = (jnp.zeros((block_k, hd), jnp.float32), jnp.zeros((block_k, hd), jnp.float32))
+    dk, dv = jax.lax.fori_loop(start_q, num_q, body, init)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(causal, num_kv_groups, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res  # (B, nh, Sq, hd) layout
+    B, nh, Sq, hd = q.shape
+    kvh, Skv = k.shape[1], k.shape[2]
+    g = num_kv_groups
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None]  # (B,nh,Sq,1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(B, nh, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per q-head, reduced over the GQA group below
+    dkh, dvh = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(B, nh, Skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h // g, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h // g, i, 0)),
+            pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nh, Skv, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, nh, Skv, hd), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if g > 1:
+        dk = dkh.reshape(B, kvh, g, Skv, hd).astype(jnp.float32).sum(axis=2).astype(k.dtype)
+        dv = dvh.reshape(B, kvh, g, Skv, hd).astype(jnp.float32).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dkh.astype(k.dtype), dvh.astype(v.dtype)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------------
+# public entry
+# ----------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, num_kv_groups, scale, block_q, block_k):
+    out, _ = _fwd(q, k, v, causal=causal, num_kv_groups=num_kv_groups,
+                  scale=scale, block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, num_kv_groups, scale, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal=causal, num_kv_groups=num_kv_groups,
+                    scale=scale, block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, num_kv_groups, scale, block_q, block_k, res, do):
+    return _bwd(causal, num_kv_groups, scale, block_q, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@register_impl("pallas_flash")
+def flash_attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
+                    softcap=0.0, bias=None, scale=None, block_q=128, block_k=128):
+    """Flash attention entry (same (B,S,h,d) surface as ``attention.xla_attention``)."""
+    if bias is not None or (softcap and softcap > 0.0) or q_offset != 0:
+        raise NotImplementedError("flash kernel: bias/softcap/q_offset unsupported")
+    B, Sq, nh, hd = q.shape
+    Skv = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Skv)
+    if Sq % block_q or Skv % block_k or hd not in (64, 128, 256):
+        raise NotImplementedError("flash kernel: unsupported shape")
+    scale = scale if scale is not None else hd ** -0.5
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash(qt, kt, vt, causal, num_kv_groups, scale, block_q, block_k)
+    return jnp.transpose(out, (0, 2, 1, 3))

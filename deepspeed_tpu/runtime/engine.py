@@ -245,7 +245,12 @@ class DeepSpeedEngine:
         # over the global batch inside one compiled program, so both orderings are
         # the same operation — the flags are accepted as no-ops.
 
-        def fwd_bwd(lp_params, batch, scale, rng):
+        base_rng = self._rng
+
+        def fwd_bwd(lp_params, batch, scale, step_idx):
+            # per-micro-step rng derived on device (no host-side split dispatch)
+            rng = jax.random.fold_in(base_rng, step_idx)
+
             def loss_fn(p):
                 out = apply_fn(p, batch, train=True, rng=rng)
                 loss = self._loss_of(out)
@@ -259,6 +264,12 @@ class DeepSpeedEngine:
             fwd_bwd,
             out_shardings=(self._replicated, self._grad_shardings),
         )
+
+        def eval_loss(lp_params, batch):
+            out = apply_fn(lp_params, batch, train=False, rng=None)
+            return self._loss_of(out).astype(jnp.float32)
+
+        self._eval_fn = jax.jit(eval_loss, out_shardings=self._replicated)
 
         def acc(acc_grads, grads):
             return jax.tree.map(lambda a, g: a + g.astype(a.dtype), acc_grads, grads)
@@ -284,9 +295,7 @@ class DeepSpeedEngine:
             new_master, new_opt = opt.update(grads, opt_state, target, lr)
             # skip the update entirely on overflow
             new_master = _tree_select(overflow, target, new_master)
-            new_opt = jax.tree.map(
-                lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state
-            )
+            new_opt = _tree_select(overflow, opt_state, new_opt)
             new_lp = jax.tree.map(lambda p: p.astype(compute_dtype), new_master)
             new_scaler_state = scaler.update(scaler_state, overflow)
             if mixed:
@@ -322,36 +331,49 @@ class DeepSpeedEngine:
     def __call__(self, batch, **kwargs):
         return self.forward(batch, **kwargs)
 
-    def next_rng(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
-
     def forward(self, batch, **kwargs):
         """Compute loss AND cache gradients for the pending ``backward`` (see
-        module docstring). Returns the unscaled loss (a replicated jax scalar)."""
+        module docstring). Returns the unscaled loss (a replicated jax scalar).
+        After ``eval()``, runs loss-only with ``train=False`` (no dropout, no
+        gradient caching)."""
+        if kwargs:
+            raise TypeError(
+                f"forward() got unexpected kwargs {sorted(kwargs)}: pass model inputs "
+                "inside `batch` (the apply_fn receives it whole)"
+            )
         self.timers(FORWARD_MICRO_TIMER).start()
         batch = self._shard_batch(batch)
+        if not getattr(self, "_training", True):
+            loss = self._eval_fn(self.params, batch)
+            self.timers(FORWARD_MICRO_TIMER).stop()
+            return loss
         loss, grads = self._fwd_bwd(
-            self.params, batch, self.scaler_state.cur_scale, self.next_rng()
+            self.params, batch, self.scaler_state.cur_scale,
+            jnp.asarray(self.micro_steps, jnp.int32),
         )
         self._cached = (loss, grads)
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
 
     def backward(self, loss=None, retain_graph: bool = False):
-        """Fold the cached gradients into the accumulation buffer."""
+        """Fold the cached gradients into the accumulation buffer. With
+        gradient_accumulation_steps == 1 the buffer is the gradients themselves
+        (no extra full-tree read/write — matters at 2×model-size fp32)."""
         if self._cached is None:
             raise RuntimeError("backward() called without a preceding forward()")
         self.timers(BACKWARD_MICRO_TIMER).start()
         _, grads = self._cached
         self._cached = None
-        if self._acc_grads is None:
-            acc_dtype = self._grad_acc_dtype()
-            zeros = jax.tree.map(
-                lambda g: jnp.zeros(g.shape, acc_dtype), grads
-            )
-            self._acc_grads = jax.device_put(zeros, self._grad_shardings)
-        self._acc_grads = self._acc(self._acc_grads, grads)
+        if self.config.gradient_accumulation_steps == 1:
+            self._acc_grads = grads
+        else:
+            if self._acc_grads is None:
+                acc_dtype = self._grad_acc_dtype()
+                zeros = jax.tree.map(
+                    lambda g: jnp.zeros(g.shape, acc_dtype), grads
+                )
+                self._acc_grads = jax.device_put(zeros, self._grad_shardings)
+            self._acc_grads = self._acc(self._acc_grads, grads)
         self.micro_steps += 1
         self.timers(BACKWARD_MICRO_TIMER).stop()
         return loss
@@ -395,7 +417,9 @@ class DeepSpeedEngine:
         self._last_global_norm = gnorm
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
-        if bool(overflow):
+        # only fp16 can overflow; bool(overflow) is a host sync — never pay it
+        # on the bf16/fp32 paths (keeps the step loop free of round trips)
+        if self.config.fp16_enabled and bool(overflow):
             self.skipped_steps += 1
             log_dist(
                 f"[step {self.global_steps}] overflow: skipping step, "

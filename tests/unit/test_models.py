@@ -1,0 +1,141 @@
+"""Model family + attention kernel tests (reference test strategy: kernel-vs-torch
+numerics in ``tests/unit/ops/transformer``, model fixtures in ``tests/unit/simple_model.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerLM, build_model, gpt2_config, llama_config
+from deepspeed_tpu.ops.transformer.attention import attention, xla_attention
+from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+
+def tiny_gpt(**kw):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=32)
+    base.update(kw)
+    return TransformerLM(gpt2_config("125m", **base))
+
+
+def tiny_llama(**kw):
+    return build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=128,
+                       max_seq_len=32, **kw)
+
+
+def batch_of(model, B=4, seed=0):
+    S = model.config.max_seq_len
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, model.config.vocab_size, (B, S), dtype=np.int32)
+    return {"input_ids": jnp.asarray(ids)}
+
+
+class TestTransformerLM:
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_forward_and_grad_finite(self, family):
+        m = tiny_gpt() if family == "gpt" else tiny_llama()
+        p = m.init_params(jax.random.PRNGKey(0))
+        loss = m.apply(p, batch_of(m))
+        assert jnp.isfinite(loss)
+        g = jax.grad(lambda pp: m.apply(pp, batch_of(m)))(p)
+        assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
+
+    def test_remat_matches(self):
+        m1 = tiny_gpt()
+        m2 = TransformerLM(gpt2_config("125m", vocab_size=128, hidden_size=64,
+                                       num_layers=2, num_heads=4, max_seq_len=32, remat=True))
+        p = m1.init_params(jax.random.PRNGKey(0))
+        b = batch_of(m1)
+        assert np.allclose(m1.apply(p, b), m2.apply(p, b), atol=1e-5)
+        g1 = jax.grad(lambda pp: m1.apply(pp, b))(p)
+        g2 = jax.grad(lambda pp: m2.apply(pp, b))(p)
+        chex_close = lambda a, c: np.allclose(np.asarray(a), np.asarray(c), atol=1e-5)
+        assert all(chex_close(a, c) for a, c in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)))
+
+    def test_tp_specs_match_param_tree(self):
+        for m in (tiny_gpt(), tiny_llama()):
+            p = m.init_params(jax.random.PRNGKey(0))
+            specs = m.tp_specs
+            pt, st = jax.tree.structure(p), jax.tree.structure(
+                specs, is_leaf=lambda s: not isinstance(s, dict))
+            assert pt == st
+            for leaf, spec in zip(jax.tree.leaves(p),
+                                  jax.tree.leaves(specs, is_leaf=lambda s: not isinstance(s, dict))):
+                assert len(spec) <= leaf.ndim
+
+    def test_loss_decreases_under_engine(self):
+        m = tiny_gpt()
+        config = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=config)
+        b = batch_of(m, B=8)
+        losses = []
+        for _ in range(10):
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_kv_cache_decode_matches_full_forward(self):
+        m = tiny_llama()
+        p = m.init_params(jax.random.PRNGKey(0))
+        ids = batch_of(m, B=2)["input_ids"]
+        full = m.logits(p, ids)  # (B,S,V)
+        S = ids.shape[1]
+        cache = m.init_kv_cache(2, S, dtype=jnp.float32)
+        # prefill on the first S-4 tokens, then decode token-by-token
+        split = S - 4
+        lg, cache = m.forward_with_cache(p, ids[:, :split], cache, 0)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(full[:, split - 1]),
+                                   rtol=2e-3, atol=2e-3)
+        for t in range(split, S):
+            lg, cache = m.forward_with_cache(p, ids[:, t:t + 1], cache, t)
+            np.testing.assert_allclose(np.asarray(lg), np.asarray(full[:, t]),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_param_count(self):
+        cfg = gpt2_config("125m")
+        n = cfg.num_parameters
+        assert 115e6 < n < 180e6  # 125m class (padded vocab inflates it)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("kvh,hd", [(4, 64), (2, 64), (1, 128)])
+    def test_matches_xla(self, kvh, hd):
+        B, S, nh = 2, 256, 4
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(k1, (B, S, nh, hd), jnp.float32)
+        k = jax.random.normal(k2, (B, S, kvh, hd), jnp.float32)
+        v = jax.random.normal(k3, (B, S, kvh, hd), jnp.float32)
+        g = nh // kvh
+        ref = xla_attention(q, k, v, causal=True, num_kv_groups=g)
+        out = flash_attention(q, k, v, causal=True, num_kv_groups=g)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+    def test_backward_matches_xla(self):
+        B, S, nh, kvh, hd = 1, 256, 4, 2, 64
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(k1, (B, S, nh, hd), jnp.float32)
+        k = jax.random.normal(k2, (B, S, kvh, hd), jnp.float32)
+        v = jax.random.normal(k3, (B, S, kvh, hd), jnp.float32)
+        g = nh // kvh
+        gr = jax.grad(lambda *a: jnp.sum(xla_attention(*a, causal=True, num_kv_groups=g) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True, num_kv_groups=g) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            scale = float(jnp.max(jnp.abs(a))) + 1e-9
+            assert float(jnp.max(jnp.abs(a - b))) / scale < 3e-2
+
+    def test_fallback_on_unsupported(self):
+        # odd seq length → dispatch falls back to the XLA path without error
+        B, S, nh, hd = 1, 100, 2, 64
+        k1 = jax.random.PRNGKey(0)
+        q = jax.random.normal(k1, (B, S, nh, hd), jnp.float32)
+        out = attention(q, q, q, causal=True)
+        assert out.shape == q.shape
