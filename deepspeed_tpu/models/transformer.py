@@ -54,6 +54,11 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     dropout: float = 0.0
+    # MoE (0 experts = dense MLP; >0 replaces every MLP with a routed MoE FFN)
+    num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
     # training knobs
     remat: bool = False  # per-block activation rematerialisation
     remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
@@ -86,16 +91,29 @@ class TransformerConfig:
         kvh = self.kv_heads * self.head_dim
         attn = H * H + 2 * H * kvh + H * H  # q, k, v, o
         mlp = (3 if self.activation == "swiglu" else 2) * H * I
+        if self.num_experts > 0:
+            mlp = mlp * self.num_experts + H * self.num_experts  # experts + router
         norms = (2 if self.norm == "rmsnorm" else 4) * H
         per_layer = attn + mlp + norms
         emb = V * H + (0 if self.pos_embedding != "learned" else self.max_seq_len * H)
         head = 0 if self.tie_embeddings else V * H
         return L * per_layer + emb + head + H
 
+    @property
+    def num_active_parameters(self) -> int:
+        """Parameters touched per token (= num_parameters for dense; for MoE only
+        top-k of E experts are activated)."""
+        if self.num_experts == 0:
+            return self.num_parameters
+        H, L, I, E = self.hidden_size, self.num_layers, self.mlp_dim, self.num_experts
+        per_expert = (3 if self.activation == "swiglu" else 2) * H * I
+        inactive = L * (E - self.moe_top_k) * per_expert
+        return self.num_parameters - inactive
+
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
-        """Model FLOPs per token for one fwd+bwd (6N + attention term)."""
+        """Model FLOPs per token for one fwd+bwd (6·N_active + attention term)."""
         S = seq_len or self.max_seq_len
-        n = self.num_parameters
+        n = self.num_active_parameters
         attn_flops = 12 * self.num_layers * self.hidden_size * S  # fwd+bwd qk^T + av
         return 6 * n + attn_flops
 
@@ -215,7 +233,7 @@ class TransformerLM:
         H, L, V, I = cfg.hidden_size, cfg.num_layers, cfg.vocab_size, cfg.mlp_dim
         nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         dt = cfg.param_dtype
-        k = jax.random.split(rng, 10)
+        k = jax.random.split(rng, 12)
         init = jax.nn.initializers.normal(0.02)
         # residual-branch projections get the depth-scaled init (GPT-2 paper)
         resid_init = jax.nn.initializers.normal(0.02 / np.sqrt(2 * L))
@@ -232,16 +250,24 @@ class TransformerLM:
                 "wv": stacked(k[3], (H, kvh * hd)),
                 "wo": stacked(k[4], (nh * hd, H), resid_init),
                 "ln2_scale": jnp.ones((L, H), dt),
-                "w_down": stacked(k[6], (I, H), resid_init),
             },
             "lnf_scale": jnp.ones((H,), dt),
         }
         blocks = params["blocks"]
-        if cfg.activation == "swiglu":
-            blocks["w_gate"] = stacked(k[5], (H, I))
-            blocks["w_up"] = stacked(k[7], (H, I))
+        E = cfg.num_experts
+        if E > 0:
+            blocks["moe_wg"] = stacked(k[10], (H, E))
+            blocks["wi"] = stacked(k[5], (E, H, I))
+            blocks["w_down"] = stacked(k[6], (E, I, H), resid_init)
+            if cfg.activation == "swiglu":
+                blocks["w_gate"] = stacked(k[7], (E, H, I))
         else:
-            blocks["w_up"] = stacked(k[5], (H, I))
+            blocks["w_down"] = stacked(k[6], (I, H), resid_init)
+            if cfg.activation == "swiglu":
+                blocks["w_gate"] = stacked(k[5], (H, I))
+                blocks["w_up"] = stacked(k[7], (H, I))
+            else:
+                blocks["w_up"] = stacked(k[5], (H, I))
         if cfg.norm == "layernorm":
             blocks["ln1_bias"] = jnp.zeros((L, H), dt)
             blocks["ln2_bias"] = jnp.zeros((L, H), dt)
@@ -274,14 +300,23 @@ class TransformerLM:
                 "wv": P(None, None, m),
                 "wo": P(None, m, None),
                 "ln2_scale": P(None, None),
-                "w_down": P(None, m, None),
-                "w_up": P(None, None, m),
             },
             "lnf_scale": P(None),
         }
         blocks = specs["blocks"]
-        if cfg.activation == "swiglu":
-            blocks["w_gate"] = P(None, None, m)
+        if cfg.num_experts > 0:
+            # experts over the expert axis, expert-internal dims over model axis
+            e = "expert"
+            blocks["moe_wg"] = P(None, None, None)
+            blocks["wi"] = P(None, e, None, m)
+            blocks["w_down"] = P(None, e, m, None)
+            if cfg.activation == "swiglu":
+                blocks["w_gate"] = P(None, e, None, m)
+        else:
+            blocks["w_down"] = P(None, m, None)
+            blocks["w_up"] = P(None, None, m)
+            if cfg.activation == "swiglu":
+                blocks["w_gate"] = P(None, None, m)
         if cfg.norm == "layernorm":
             blocks["ln1_bias"] = P(None, None)
             blocks["ln2_bias"] = P(None, None)
@@ -355,20 +390,40 @@ class TransformerLM:
         x = x + attn_out
 
         h = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
-        if cfg.activation == "swiglu":
-            g = h @ blk["w_gate"].astype(h.dtype)
-            u = h @ blk["w_up"].astype(h.dtype)
-            inter = jax.nn.silu(g) * u
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.num_experts > 0:
+            mlp_out, aux = self._moe_ffn(h, blk, train)
         else:
-            inter = jax.nn.gelu(h @ blk["w_up"].astype(h.dtype), approximate=True)
-        mlp_out = inter @ blk["w_down"].astype(h.dtype)
+            if cfg.activation == "swiglu":
+                g = h @ blk["w_gate"].astype(h.dtype)
+                u = h @ blk["w_up"].astype(h.dtype)
+                inter = jax.nn.silu(g) * u
+            else:
+                inter = jax.nn.gelu(h @ blk["w_up"].astype(h.dtype), approximate=True)
+            mlp_out = inter @ blk["w_down"].astype(h.dtype)
         if "mlp_bias" in blk:
             mlp_out = mlp_out + blk["mlp_bias"].astype(h.dtype)
         mlp_out = self._constraint(mlp_out, self._act_spec(kv_cache is None))
         if rng is not None:
             rng, r2 = jax.random.split(rng)
             mlp_out = _dropout(mlp_out, cfg.dropout, r2, train)
-        return x + mlp_out, new_kv
+        return x + mlp_out, new_kv, aux
+
+    def _moe_ffn(self, h, blk, train):
+        """Routed expert FFN on (B,S,H) — delegates to the shared MoE core
+        (reference ``moe/sharded_moe.py MOELayer``); one group per sequence."""
+        from ..moe.layer import routed_ffn
+
+        cfg = self.config
+        return routed_ffn(
+            h, blk["moe_wg"], blk["wi"], blk["w_down"], blk.get("w_gate"),
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor if train else 1.0,
+            activation="swiglu" if cfg.activation == "swiglu" else "gelu",
+            # batch arrives sharded over (data, expert); inside the expert
+            # computation the expert axis moves to the expert dim (the all-to-all)
+            data_axes=("data",),
+        )
 
     # ------------------------------------------------------------------
     def _embed(self, params, input_ids, positions, dtype):
@@ -394,19 +449,19 @@ class TransformerLM:
 
             def body(h, layer):
                 blk, rsub = layer
-                y, _ = self._block(h, blk, positions=positions, rng=rsub, train=train)
-                return y, None
+                y, _, aux = self._block(h, blk, positions=positions, rng=rsub, train=train)
+                return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
-            x, _ = jax.lax.scan(block_fn, x, (params["blocks"], rngs))
+            x, auxes = jax.lax.scan(block_fn, x, (params["blocks"], rngs))
         else:
             def body(h, blk):
-                y, _ = self._block(h, blk, positions=positions, rng=None, train=train)
-                return y, None
+                y, _, aux = self._block(h, blk, positions=positions, rng=None, train=train)
+                return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
-            x, _ = jax.lax.scan(block_fn, x, params["blocks"])
-        return x
+            x, auxes = jax.lax.scan(block_fn, x, params["blocks"])
+        return x, jnp.sum(auxes)
 
     def _head(self, params, x):
         cfg = self.config
@@ -415,15 +470,18 @@ class TransformerLM:
         return x @ w.astype(x.dtype)  # (B,S,V)
 
     # ------------------------------------------------------------------
-    def logits(self, params, input_ids, positions=None, train=False, rng=None):
+    def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         dtype = jax.tree.leaves(params)[0].dtype
         x = self._embed(params, input_ids, positions, dtype)
         x = self._constraint(x, self._act_spec(True))
-        x = self._trunk(params, x, positions, rng, train)
-        return self._head(params, x)
+        x, aux = self._trunk(params, x, positions, rng, train)
+        return self._head(params, x), aux
+
+    def logits(self, params, input_ids, positions=None, train=False, rng=None):
+        return self._logits_aux(params, input_ids, positions, train, rng)[0]
 
     def apply(self, params, batch, train=True, rng=None):
         """Next-token LM loss over the batch (engine protocol).
@@ -442,7 +500,8 @@ class TransformerLM:
         else:
             input_ids, labels, positions = batch, None, None
 
-        lg = self.logits(params, input_ids, positions=positions, train=train, rng=rng)
+        lg, aux = self._logits_aux(params, input_ids, positions=positions,
+                                   train=train, rng=rng)
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
@@ -454,6 +513,8 @@ class TransformerLM:
         gold = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
         nll = (logz - gold) * mask
         loss = jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1)
+        if self.config.num_experts > 0:
+            loss = loss + self.config.moe_aux_loss_coef * aux
         return loss
 
     # ------------------------------------------------------------------
@@ -479,7 +540,7 @@ class TransformerLM:
 
         def body(h, layer):
             blk, ck, cv = layer
-            y, new_kv = self._block(
+            y, new_kv, _ = self._block(
                 h, blk, positions=positions, rng=None, train=False,
                 kv_cache=(ck, cv), cache_index=cache_index,
             )

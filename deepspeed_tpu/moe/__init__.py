@@ -1,0 +1,4 @@
+"""MoE / expert parallelism (reference deepspeed/moe/)."""
+
+from .layer import MoE  # noqa: F401
+from .sharded_moe import compute_capacity, topk_gating  # noqa: F401

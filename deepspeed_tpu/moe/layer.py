@@ -1,0 +1,146 @@
+"""MoE layer — experts sharded over the ``expert`` mesh axis.
+
+Reference: ``deepspeed/moe/layer.py`` (``MoE:17``, ``set_deepspeed_parallelism``),
+``experts.py:13 Experts``, dispatch via ``_AllToAll`` (``sharded_moe.py:95``).
+
+GShard-style **group-wise dense dispatch**: tokens keep a leading group dim
+(one group per sequence) sharded over the data axes, experts are sharded over
+the ``expert`` axis, and capacity is per-group — so the one-hot combine/dispatch
+tensors are O(S²·k·cf/E) per group instead of O((B·S)²) global, and the expert
+FFN is *not* replicated across data shards. XLA lowers the group→expert
+resharding between the dispatch einsum and the expert matmuls to the same token
+all-to-all the reference issues explicitly over its EP process group.
+"""
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import topk_gating
+
+
+def _constraint(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
+
+
+def routed_ffn(x, wg, wi, wo, wgate=None, *, k: int = 1,
+               capacity_factor: float = 1.25, min_capacity: int = 4,
+               drop_tokens: bool = True, activation: str = "gelu",
+               expert_axis: str = "expert", data_axes=("data",),
+               rng: Optional[jax.Array] = None, noise_eps: float = 0.0):
+    """Shared routed-FFN core (used by ``MoE`` and ``TransformerLM``).
+
+    x: (G, S, H) tokens grouped by leading dim (typically one group per
+    sequence). wg: (H, E); wi/wgate: (E, H, I); wo: (E, I, H).
+    Returns (y (G,S,H), l_aux scalar).
+    """
+    G, S, H = x.shape
+    logits = x.astype(jnp.float32) @ wg.astype(jnp.float32)  # (G, S, E)
+    gate = partial(topk_gating, k=k, capacity_factor=capacity_factor,
+                   min_capacity=min_capacity, drop_tokens=drop_tokens,
+                   noise_eps=noise_eps)
+    if noise_eps > 0.0 and rng is not None:
+        rngs = jax.random.split(rng, G)
+        combine, dispatch, l_aux, _ = jax.vmap(lambda l, r: gate(l, rng=r))(logits, rngs)
+    else:
+        combine, dispatch, l_aux, _ = jax.vmap(lambda l: gate(l, rng=None))(logits)
+    # combine/dispatch: (G, S, E, C); group dim rides the data axes, expert dim
+    # the expert axis — XLA inserts the token all-to-all at this boundary
+    expert_in = jnp.einsum("gsh,gsec->gech", x.astype(jnp.float32),
+                           dispatch.astype(jnp.float32)).astype(x.dtype)
+    expert_in = _constraint(expert_in, P(data_axes, expert_axis, None, None))
+    h = jnp.einsum("gech,ehi->geci", expert_in, wi.astype(x.dtype))
+    if activation == "swiglu":
+        g = jnp.einsum("gech,ehi->geci", expert_in, wgate.astype(x.dtype))
+        h = jax.nn.silu(g) * h
+    elif activation == "silu":
+        h = jax.nn.silu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=True)
+    expert_out = jnp.einsum("geci,eih->gech", h, wo.astype(x.dtype))
+    expert_out = _constraint(expert_out, P(data_axes, expert_axis, None, None))
+    y = jnp.einsum("gech,gsec->gsh", expert_out.astype(jnp.float32), combine)
+    return y.astype(x.dtype), jnp.mean(l_aux).astype(jnp.float32)
+
+
+class MoE:
+    """Functional MoE FFN: router + E experts (2-layer MLP, gelu/silu/swiglu).
+
+    Engine/model protocol: ``init_params(rng) -> params``, ``apply(params, x,
+    train, rng) -> (y, l_aux)``, ``tp_specs`` property.
+    """
+
+    def __init__(self, hidden_size: int, num_experts: int, expert_intermediate_size: int,
+                 k: int = 1, capacity_factor: float = 1.25,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 drop_tokens: bool = True, activation: str = "gelu",
+                 noisy_gate_policy: Optional[str] = None,
+                 expert_axis: str = "expert", model_axis: str = "model",
+                 data_axes=("data",)):
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.inter = expert_intermediate_size
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.drop_tokens = drop_tokens
+        self.activation = activation
+        self.noisy_gate_policy = noisy_gate_policy
+        self.expert_axis = expert_axis
+        self.model_axis = model_axis
+        self.data_axes = data_axes
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng) -> Dict[str, Any]:
+        H, E, I = self.hidden_size, self.num_experts, self.inter
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        init = jax.nn.initializers.normal(0.02)
+        p = {
+            "wg": init(k1, (H, E), jnp.float32),  # router
+            "wi": init(k2, (E, H, I), jnp.float32),
+            "wo": init(k3, (E, I, H), jnp.float32),
+        }
+        if self.activation == "swiglu":
+            p["wgate"] = init(k4, (E, H, I), jnp.float32)
+        return p
+
+    @property
+    def tp_specs(self) -> Dict[str, Any]:
+        e, m = self.expert_axis, self.model_axis
+        specs = {
+            "wg": P(None, None),
+            "wi": P(e, None, m),
+            "wo": P(e, m, None),
+        }
+        if self.activation == "swiglu":
+            specs["wgate"] = P(e, None, m)
+        return specs
+
+    # ------------------------------------------------------------------
+    def apply(self, params, x, train: bool = True, rng=None):
+        """x: (..., H) → (y (..., H), l_aux scalar). Leading dim is the dispatch
+        group; a 2-D input becomes a single group."""
+        orig_shape = x.shape
+        H = orig_shape[-1]
+        x3 = x.reshape((orig_shape[0], -1, H) if x.ndim >= 3 else (1, -1, H))
+        y, l_aux = routed_ffn(
+            x3, params["wg"], params["wi"], params["wo"], params.get("wgate"),
+            k=self.k,
+            capacity_factor=self.capacity_factor if train else self.eval_capacity_factor,
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
+            activation=self.activation, expert_axis=self.expert_axis,
+            data_axes=self.data_axes,
+            rng=rng if (train and self.noisy_gate_policy) else None,
+            noise_eps=1e-2 if self.noisy_gate_policy else 0.0,
+        )
+        return y.reshape(orig_shape), l_aux
+
+    def __call__(self, params, x, train=True, rng=None):
+        return self.apply(params, x, train=train, rng=rng)

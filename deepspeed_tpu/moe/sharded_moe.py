@@ -1,0 +1,85 @@
+"""Top-k gating + expert dispatch (reference ``deepspeed/moe/sharded_moe.py``:
+``top1gating:249``, ``top2gating:367 TopKGate``, ``_AllToAll:95``, ``MOELayer:444``).
+
+TPU-native design: GShard-style *dense dispatch*. Instead of the reference's
+boolean-index + all-to-all of token buffers, tokens are routed with one-hot
+combine/dispatch einsum tensors of static shape (tokens, experts, capacity) —
+XLA lowers the expert-axis resharding to the same all-to-all over ICI, but the
+whole layer stays static-shaped and fusible. Capacity overflow drops tokens
+exactly like the reference's capacity mechanism.
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def compute_capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+                     min_capacity: int = 4, k: int = 1) -> int:
+    """Static per-expert buffer size (reference ``_capacity``, sharded_moe.py:90)."""
+    cap = int(math.ceil(k * num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def topk_gating(
+    logits,
+    k: int = 1,
+    capacity_factor: float = 1.0,
+    min_capacity: int = 4,
+    drop_tokens: bool = True,
+    rng: Optional[jax.Array] = None,
+    noise_eps: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, dict]:
+    """Route each token to its top-k experts under a capacity limit.
+
+    logits: (T, E) router scores. Returns (combine (T,E,C) fp32, dispatch (T,E,C)
+    bool, l_aux scalar, metadata). Math follows the reference's top1/top2 gating:
+    softmax gates, per-expert position by arrival order with earlier-choice
+    priority, load-balancing aux loss ``E · Σ_e mean(gates_e) · mean(dispatch_e)``.
+    """
+    T, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    if noise_eps > 0.0 and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) * noise_eps
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    C = compute_capacity(T, E, capacity_factor, min_capacity, k) if drop_tokens else T
+
+    topv, topi = lax.top_k(gates, k)  # (T, k)
+    if k > 1:
+        denom = jnp.sum(topv, axis=-1, keepdims=True)
+        topv = topv / jnp.maximum(denom, 1e-9)
+
+    # choice-priority positions: all 1st choices claim slots before 2nd choices
+    masks = [_one_hot(topi[:, j], E) for j in range(k)]  # each (T, E)
+    prior = jnp.zeros((E,), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    dispatch = jnp.zeros((T, E, C), bool)
+    for j in range(k):
+        m = masks[j]
+        pos = jnp.cumsum(m, axis=0) - 1.0 + prior[None, :]  # slot per (token, expert)
+        prior = prior + jnp.sum(m, axis=0)
+        keep = m * (pos < C)
+        slot = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+        oh = _one_hot(slot, C) * keep[..., None]  # (T, E, C)
+        combine = combine + oh * topv[:, j][:, None, None]
+        dispatch = dispatch | (oh > 0)
+
+    # load-balancing loss on first-choice routing (reference top1/2 l_aux)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    meta = {
+        "tokens_per_expert": prior,
+        "dropped_fraction": 1.0 - jnp.sum(dispatch.astype(jnp.float32)) / (T * k),
+        "capacity": C,
+    }
+    return combine, dispatch, l_aux, meta

@@ -1,0 +1,3 @@
+"""Sequence parallelism (reference deepspeed/sequence/)."""
+
+from .layer import DistributedAttention, UlyssesAttention, ring_attention, single_all_to_all  # noqa: F401
