@@ -63,7 +63,15 @@ def initialize(
     comm.init_distributed(mesh_config=ds_config.mesh_config)
     comm.configure(config=ds_config)
 
-    engine = DeepSpeedEngine(
+    # engine dispatch (reference __init__.py:166-206: pipeline models get the
+    # PipelineEngine)
+    from .runtime.pipe.engine import PipelineEngine
+    from .runtime.pipe.module import PipelinedLM, PipelineModule
+
+    engine_cls = (
+        PipelineEngine if isinstance(model, (PipelinedLM, PipelineModule)) else DeepSpeedEngine
+    )
+    engine = engine_cls(
         model=model,
         config=ds_config,
         optimizer=optimizer,

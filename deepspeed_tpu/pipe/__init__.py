@@ -1,0 +1,3 @@
+"""Alias package (reference deepspeed/pipe/__init__.py re-exports PipelineModule)."""
+
+from ..runtime.pipe import LayerSpec, PipelinedLM, PipelineModule  # noqa: F401

@@ -238,7 +238,8 @@ class DeepSpeedEngine:
 
     def _build_compiled_fns(self):
         cfg = self.config
-        gas = cfg.gradient_accumulation_steps
+        # pipeline engines consume all microbatches in ONE apply → no loss division
+        gas = getattr(self, "_gas_divisor", cfg.gradient_accumulation_steps)
         apply_fn = self._apply_fn
         # prescale_gradients / gradient_predivide_factor order pre- vs post-divide
         # around the reference's allreduce; here the DP average is a single mean
@@ -366,13 +367,20 @@ class DeepSpeedEngine:
         self._cached = None
         if self.config.gradient_accumulation_steps == 1:
             self._acc_grads = grads
+        elif self._acc_grads is None:
+            # first micro-step: take the gradients as the buffer (cast if the
+            # accumulation dtype differs) — no zeros tree, no extra add
+            acc_dtype = self._grad_acc_dtype()
+            if all(g.dtype == acc_dtype for g in jax.tree.leaves(grads)):
+                self._acc_grads = grads
+            else:
+                if not hasattr(self, "_cast_acc"):
+                    self._cast_acc = jax.jit(
+                        lambda g: jax.tree.map(lambda x: x.astype(acc_dtype), g),
+                        out_shardings=self._grad_shardings,
+                    )
+                self._acc_grads = self._cast_acc(grads)
         else:
-            if self._acc_grads is None:
-                acc_dtype = self._grad_acc_dtype()
-                zeros = jax.tree.map(
-                    lambda g: jnp.zeros(g.shape, acc_dtype), grads
-                )
-                self._acc_grads = jax.device_put(zeros, self._grad_shardings)
             self._acc_grads = self._acc(self._acc_grads, grads)
         self.micro_steps += 1
         self.timers(BACKWARD_MICRO_TIMER).stop()

@@ -1,0 +1,141 @@
+"""Pipeline training engine.
+
+Reference: ``runtime/pipe/engine.py`` (``PipelineEngine:55``, ``train_batch:323``,
+``eval_batch:438``). The reference executes a 1F1B instruction schedule with torch
+P2P; here the whole schedule is one compiled program (``spmd.py``), so this
+engine's job is batch assembly: gather ``gradient_accumulation_steps``
+microbatches, run ONE fused fwd+bwd over the pipelined model, step.
+
+``forward``/``backward`` outside ``train_batch`` are disallowed exactly like the
+reference ("only bound to training a batch": engine.py:276-281 area).
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine import DeepSpeedEngine
+from .module import PipelinedLM, PipelineModule
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, model, config, **kwargs):
+        assert isinstance(model, (PipelinedLM, PipelineModule)), (
+            "PipelineEngine requires a PipelineModule/PipelinedLM model"
+        )
+        # all microbatches are consumed by ONE apply → loss is already the batch
+        # mean; don't divide by GAS in the compiled fwd_bwd
+        self._gas_divisor = 1
+        model.num_micro = config.gradient_accumulation_steps
+        # the model may have been built before initialize() created the real
+        # mesh — re-bind it to the current topology (stage count follows the
+        # pipe axis, reference PipelineModule takes the grid at engine init)
+        from ...comm.topology import get_topology
+
+        topo = kwargs.get("topology") or get_topology()
+        if model.topology is not topo:
+            model.topology = topo
+            if isinstance(model, PipelinedLM):
+                if model.config.num_layers % topo.pipe_parallel_size:
+                    raise ValueError(
+                        f"{model.config.num_layers} layers not divisible by "
+                        f"pipe={topo.pipe_parallel_size}"
+                    )
+                model.num_stages = topo.pipe_parallel_size
+            else:
+                if len(model.specs) % topo.pipe_parallel_size:
+                    raise ValueError(
+                        f"{len(model.specs)} layers not divisible by "
+                        f"pipe={topo.pipe_parallel_size}"
+                    )
+                model.num_stages = topo.pipe_parallel_size
+        super().__init__(model, config, **kwargs)
+        self._inside_train_batch = False
+
+    # ------------------------------------------------------------------
+    def forward(self, batch, **kwargs):
+        if not self._inside_train_batch:
+            raise RuntimeError(
+                "PipelineEngine does not support forward() outside train_batch/"
+                "eval_batch (parity with reference PipelineEngine)"
+            )
+        return super().forward(batch, **kwargs)
+
+    def backward(self, loss=None, **kwargs):
+        if not self._inside_train_batch:
+            raise RuntimeError("PipelineEngine.backward is driven by train_batch")
+        return super().backward(loss, **kwargs)
+
+    # ------------------------------------------------------------------
+    def _assemble_batch(self, data_iter):
+        """Pull GAS microbatches and concatenate along the batch dim."""
+        gas = self.config.gradient_accumulation_steps
+        parts = [next(data_iter) for _ in range(gas)]
+        first = parts[0]
+        if isinstance(first, dict):
+            return {
+                k: jnp.concatenate([jnp.asarray(p[k]) for p in parts], axis=0)
+                for k in first
+            }
+        if isinstance(first, (tuple, list)):
+            return tuple(
+                jnp.concatenate([jnp.asarray(p[i]) for p in parts], axis=0)
+                for i in range(len(first))
+            )
+        return jnp.concatenate([jnp.asarray(p) for p in parts], axis=0)
+
+    def train_batch(self, data_iter=None):
+        """One global batch = one pipelined fwd+bwd + optimizer step
+        (reference ``train_batch:323``)."""
+        if data_iter is None and self.training_dataloader is None:
+            raise ValueError("train_batch needs a data_iter or training_data at init")
+        if data_iter is None:
+            from ..dataloader import RepeatingLoader
+
+            if getattr(self, "_train_iter", None) is None:
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
+        gas = self.config.gradient_accumulation_steps
+        batch = self._assemble_batch(data_iter)
+        self.tput_timer.start()
+        self._inside_train_batch = True
+        try:
+            loss = self.forward(batch)
+            self.backward(loss)
+            # one apply consumed all GAS microbatches
+            self.micro_steps += gas - 1
+            self.step()
+        finally:
+            self._inside_train_batch = False
+        self.tput_timer.stop(global_step=True)
+        return loss
+
+    def eval_batch(self, data_iter, return_logits: bool = False):
+        """Pipelined evaluation over one batch (reference ``eval_batch:438``)."""
+        if return_logits:
+            raise NotImplementedError(
+                "return_logits is not supported by the pipelined eval path; "
+                "use the unpipelined model's logits() for inference"
+            )
+        batch = self._assemble_batch(data_iter)
+        was_training = getattr(self, "_training", True)
+        self._inside_train_batch = True
+        try:
+            self.eval()
+            loss = self.forward(batch)
+        finally:
+            self._inside_train_batch = False
+            self.train(was_training)
+            self._cached = None  # eval path caches nothing, but be safe
+        return loss
+
+    def set_dataloader(self, loader):
+        self.training_dataloader = loader
+        self._train_iter = None
+
+    def is_first_stage(self) -> bool:
+        return True  # single-controller: every process drives all stages
+
+    def is_last_stage(self) -> bool:
+        return True

@@ -1,0 +1,250 @@
+"""Pipeline model descriptions.
+
+Reference: ``runtime/pipe/module.py`` — ``LayerSpec:30`` (deferred layer
+construction), ``PipelineModule:86`` (layer list → stage partitioning,
+``_partition_layers:370`` with ``uniform|parameters`` methods), tied layers.
+
+Two constructs here:
+
+- ``LayerSpec`` / ``PipelineModule``: reference-parity surface for a list of
+  homogeneous functional layers, partitioned uniformly over ``pipe`` stages.
+- ``PipelinedLM``: pipelines a ``TransformerLM`` — blocks are re-stacked from
+  (L, ...) to (P, L/P, ...) with the leading dim sharded over the ``pipe`` axis;
+  embedding/head replicated across stages (their grads psum over the pipe axis
+  in the shard_map transpose — the analogue of the reference's tied-weight
+  all-reduce, ``runtime/pipe/engine.py:259 ReduceTiedGrads``).
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...comm.topology import get_topology
+from .spmd import spmd_pipeline
+
+
+class LayerSpec:
+    """Deferred layer build (reference ``LayerSpec``): ``typename(*args)`` must
+    yield an object with ``init_params(rng)`` and ``apply(params, x)``."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class PipelineModule:
+    """Uniform pipeline over a list of identical-structure layers.
+
+    Layers must share one parameter structure (the reference's ``uniform``
+    partitioning over a homogeneous stack — e.g. its ``LinearStackPipe`` test
+    fixture). Loss is computed by ``loss_fn(final_state, labels)`` on the last
+    stage. Engine model protocol: ``init_params`` / ``apply`` / ``tp_specs``.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 loss_fn: Optional[Callable] = None, topology=None,
+                 partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0):
+        self.specs = list(layers)
+        topo = topology or get_topology()
+        self.topology = topo
+        self.num_stages = num_stages or topo.pipe_parallel_size
+        if len(self.specs) % self.num_stages:
+            raise ValueError(
+                f"{len(self.specs)} layers not divisible by {self.num_stages} stages"
+            )
+        self.loss_fn = loss_fn or (lambda out, labels: jnp.mean((out - labels) ** 2))
+        self._built = [s.build() if isinstance(s, LayerSpec) else s for s in self.specs]
+        self.num_micro = 1  # set by the engine (= gradient_accumulation_steps)
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng):
+        L = len(self._built)
+        keys = jax.random.split(rng, L)
+        per_layer = [lyr.init_params(k) for lyr, k in zip(self._built, keys)]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+        Pn = self.num_stages
+        stages = jax.tree.map(
+            lambda a: a.reshape((Pn, L // Pn) + a.shape[1:]), stacked
+        )
+        return {"stages": stages}
+
+    @property
+    def tp_specs(self):
+        def spec_of(a):
+            return P("pipe", *([None] * (a.ndim - 1)))
+
+        dummy = jax.eval_shape(lambda: self.init_params(jax.random.PRNGKey(0)))
+        return jax.tree.map(spec_of, dummy)
+
+    # ------------------------------------------------------------------
+    def apply(self, params, batch, train: bool = True, rng=None):
+        """batch: (inputs, labels) with microbatch leading dim (M, mb, ...) —
+        or flat (B, ...) split into ``self.num_micro`` microbatches."""
+        params = PipelinedLM._cpu_safe(params)
+        inputs, labels = batch
+        if inputs.ndim >= 2 and inputs.shape[0] != self.num_micro:
+            M = self.num_micro
+            inputs = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
+            labels = labels.reshape((M, labels.shape[0] // M) + labels.shape[1:])
+        layer = self._built[0]
+
+        def first_fn(p, feed_t):
+            return feed_t[0].astype(jax.tree.leaves(p["stages"])[0].dtype)
+
+        def stage_fn(stage_params, state, feed_t, rng_t):
+            def body(h, lp):
+                return layer.apply(lp, h), None
+
+            out, _ = jax.lax.scan(body, state, stage_params)
+            return out, jnp.zeros((), jnp.float32)
+
+        def last_fn(p, state, feed_t):
+            loss = self.loss_fn(state, feed_t[1])
+            return loss.astype(jnp.float32), jnp.asarray(1.0, jnp.float32)
+
+        loss, _ = spmd_pipeline(
+            first_fn, stage_fn, last_fn, params, (inputs, labels),
+            mesh=self.topology.mesh, num_micro=self.num_micro,
+        )
+        return loss
+
+
+class PipelinedLM:
+    """Pipeline-parallel wrapper of a ``TransformerLM``.
+
+    Presents the engine model protocol; ``apply`` consumes the FULL global batch
+    (all microbatches) and returns the mean LM loss — the pipeline schedule is
+    one compiled program (see ``spmd.py``).
+    """
+
+    def __init__(self, model, num_stages: Optional[int] = None, topology=None):
+        from ...models.transformer import TransformerLM
+
+        assert isinstance(model, TransformerLM), "PipelinedLM wraps a TransformerLM"
+        self.model = model
+        self.config = model.config
+        topo = topology or get_topology()
+        self.topology = topo
+        self.num_stages = num_stages or topo.pipe_parallel_size
+        if model.config.num_layers % self.num_stages:
+            raise ValueError(
+                f"{model.config.num_layers} layers not divisible by "
+                f"{self.num_stages} pipeline stages"
+            )
+        self.num_micro = 1  # set by the engine
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng):
+        params = self.model.init_params(rng)
+        L, Pn = self.config.num_layers, self.num_stages
+        params["blocks"] = jax.tree.map(
+            lambda a: a.reshape((Pn, L // Pn) + a.shape[1:]), params["blocks"]
+        )
+        return params
+
+    @property
+    def tp_specs(self):
+        specs = self.model.tp_specs
+        # blocks keep their TP entries shifted right by the new pipe dim
+        specs["blocks"] = jax.tree.map(
+            lambda s: P("pipe", *tuple(s)),
+            specs["blocks"],
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        return specs
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _cpu_safe(params):
+        """XLA's CPU backend crashes ('Invalid binary instruction opcode copy')
+        when transposing bf16 matmuls inside the scan+ppermute pipeline body;
+        compute in fp32 on CPU (tests/dryrun), bf16 stays bf16 on TPU. The
+        astype is differentiable, so cotangents come back in the lp dtype."""
+        if jax.default_backend() != "cpu":
+            return params
+        return jax.tree.map(
+            lambda a: a.astype(jnp.float32) if a.dtype == jnp.bfloat16 else a, params
+        )
+
+    def apply(self, params, batch, train: bool = True, rng=None):
+        cfg = self.config
+        m = self.model
+        params = self._cpu_safe(params)
+        positions = None
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+            positions = batch.get("positions")
+        elif isinstance(batch, (tuple, list)):
+            input_ids, labels = batch
+        else:
+            input_ids, labels = batch, None
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
+            )
+        M = self.num_micro
+        B = input_ids.shape[0]
+        S = input_ids.shape[1]
+        if B % M:
+            raise ValueError(f"global batch {B} not divisible by {M} microbatches")
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        ids_mb = input_ids.reshape(M, B // M, S)
+        lbl_mb = labels.reshape(M, B // M, S)
+        pos_mb = positions.reshape(M, B // M, S)
+
+        pipeline_params = {
+            "stages": params["blocks"],
+            "rest": {k: v for k, v in params.items() if k != "blocks"},
+        }
+
+        def first_fn(p, feed_t):
+            ids, pos = feed_t[0], feed_t[2]
+            x = m._embed(p["rest"], ids, pos, p["rest"]["wte"].dtype)
+            return m._constraint(x, m._act_spec(True))
+
+        def stage_fn(stage_params, state, feed_t, rng_t):
+            pos = feed_t[2]
+            n_local = jax.tree.leaves(stage_params)[0].shape[0]
+            rngs = None if rng_t is None else jax.random.split(rng_t, n_local)
+
+            def body(carry, layer):
+                h, aux = carry
+                blk, r = (layer, None) if rngs is None else layer
+                y, _, a = m._block(h, blk, positions=pos, rng=r, train=train)
+                return (y, aux + a), None
+
+            body_fn = jax.checkpoint(body) if cfg.remat else body
+            xs = stage_params if rngs is None else (stage_params, rngs)
+            (out, aux), _ = jax.lax.scan(
+                body_fn, (state, jnp.zeros((), jnp.float32)), xs
+            )
+            return out, aux
+
+        def last_fn(p, state, feed_t):
+            lbl = feed_t[1]
+            lg = m._head(p["rest"], state).astype(jnp.float32)
+            mask = lbl != -100
+            safe = jnp.where(mask, lbl, 0)
+            logz = jax.scipy.special.logsumexp(lg, axis=-1)
+            gold = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
+            nll = (logz - gold) * mask
+            return jnp.sum(nll), jnp.sum(mask).astype(jnp.float32)
+
+        use_rng = rng is not None and cfg.dropout > 0 and train
+        loss, aux = spmd_pipeline(
+            first_fn, stage_fn, last_fn, pipeline_params, (ids_mb, lbl_mb, pos_mb),
+            mesh=self.topology.mesh, num_micro=M, remat=cfg.remat,
+            rng=rng if use_rng else None,
+        )
+        if cfg.num_experts > 0:
+            loss = loss + cfg.moe_aux_loss_coef * aux
+        return loss

@@ -1,0 +1,127 @@
+"""SPMD pipeline core — schedule-free pipelining via shard_map + ppermute.
+
+Reference: ``runtime/pipe/engine.py`` (``PipelineEngine:55``, ``_exec_schedule:1359``,
+``_INSTRUCTION_MAP``) + ``runtime/pipe/schedule.py`` (``TrainSchedule:189``) +
+``runtime/pipe/p2p.py``. The reference drives pipelining with a per-rank
+instruction stream (LoadMicroBatch / ForwardPass / SendActivation / ...), torch
+P2P sends, and per-microbatch autograd.
+
+The TPU-native design replaces the whole instruction machinery with ONE compiled
+program: a ``lax.scan`` over ``M + P - 1`` ticks inside a ``shard_map`` that is
+manual over the ``pipe`` mesh axis only (data/model/seq/expert stay under GSPMD
+inside the body). Each tick every stage applies its layer chunk to the
+activation it holds, then hands it to the next stage via ``ppermute`` — the
+collective-permute rides ICI and overlaps with the next tick's compute under
+XLA's scheduler. Backward is jax autodiff through the scan: XLA emits the
+reverse ppermutes, i.e. the same bidirectional pipeline the reference schedules
+by hand, with none of the schedule code. Microbatch-level rematerialisation
+(``jax.checkpoint`` on the tick body) bounds activation memory exactly like the
+reference's per-microbatch activation stashing.
+"""
+
+from functools import partial
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def spmd_pipeline(
+    first_fn: Callable,
+    stage_fn: Callable,
+    last_fn: Callable,
+    params: Dict[str, Any],
+    feed,
+    *,
+    mesh,
+    num_micro: int,
+    axis: str = "pipe",
+    remat: bool = True,
+    rng=None,
+):
+    """Run a pipelined forward over ``num_micro`` microbatches.
+
+    - ``first_fn(params, feed_t) -> state``: logical stage-0 ingestion (embed).
+    - ``stage_fn(stage_params_local, state, feed_t, rng_t) -> (state, aux)``: one
+      stage's layer chunk on the microbatch *this stage currently holds* (feed_t
+      is indexed by t - stage_id); ``aux`` is a scalar side-loss (MoE balance),
+      0 if unused; ``rng_t`` is a per-(tick, stage) key derived from ``rng``
+      (None when ``rng`` is None) for dropout.
+    - ``last_fn(params, state, feed_t) -> (loss_sum, denom)``: logical last-stage
+      head + loss; returns the *sum* and its normalizer (e.g. token count).
+    - ``params``: pytree; ``params["stages"]`` leaves are stacked (P, ...) and
+      arrive in the body as the local stage's chunk; everything else replicated
+      across the pipe axis.
+    - ``feed``: pytree of microbatched arrays, leading dim ``num_micro``.
+
+    Returns (loss, aux_mean): loss = Σ loss_sum / Σ denom over all microbatches,
+    replicated; aux_mean = mean of stage aux over valid (stage, microbatch) pairs.
+    """
+    P_ = mesh.shape[axis]
+    M = num_micro
+    T = M + P_ - 1
+
+    from jax.sharding import PartitionSpec
+
+    stage_spec = jax.tree.map(lambda _: PartitionSpec(axis), params["stages"])
+    param_specs = {k: (stage_spec if k == "stages" else jax.tree.map(lambda _: PartitionSpec(), v))
+                   for k, v in params.items()}
+    feed_spec = jax.tree.map(lambda _: PartitionSpec(), feed)
+
+    def body(params, feed):
+        sid = lax.axis_index(axis)
+        stages_local = jax.tree.map(lambda a: a[0], params["stages"])  # squeeze P-shard
+
+        def feed_at(i):
+            return jax.tree.map(lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), feed)
+
+        # state template from the first microbatch (cheap: traced shapes only)
+        state_shape = jax.eval_shape(lambda: first_fn(params, feed_at(0)))
+        zvar = sum(jnp.sum(x) * 0.0 for x in jax.tree.leaves(stages_local)
+                   if jnp.issubdtype(x.dtype, jnp.floating))
+        state0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype) + zvar.astype(s.dtype),
+                              state_shape)
+
+        def tick(carry, t):
+            state, loss_sum, denom, aux_sum = carry
+            in_idx = jnp.clip(t, 0, M - 1)
+            # stage s holds microbatch t - s (ingested s ticks ago at stage 0)
+            here_idx = jnp.clip(t - sid, 0, M - 1)
+            out_idx = jnp.clip(t - (P_ - 1), 0, M - 1)
+            x0 = first_fn(params, feed_at(in_idx))
+            is_first = (sid == 0)
+            x_in = jax.tree.map(
+                lambda a, b: jnp.where(is_first, a, b), x0, state
+            )
+            rng_t = None
+            if rng is not None:
+                rng_t = jax.random.fold_in(jax.random.fold_in(rng, t), sid)
+            y, aux = stage_fn(stages_local, x_in, feed_at(here_idx), rng_t)
+            # validity of the microbatch currently at this stage: mb = t - sid
+            valid_here = (t - sid >= 0) & (t - sid < M)
+            aux_sum = aux_sum + jnp.where(valid_here, aux, 0.0)
+            l, d = last_fn(params, y, feed_at(out_idx))
+            is_last = (sid == P_ - 1)
+            valid_out = (t - (P_ - 1) >= 0) & is_last
+            loss_sum = loss_sum + jnp.where(valid_out, l, 0.0)
+            denom = denom + jnp.where(valid_out, d, 0.0)
+            state = lax.ppermute(y, axis, [(i, (i + 1) % P_) for i in range(P_)])
+            return (state, loss_sum, denom, aux_sum), None
+
+        tick_fn = jax.checkpoint(tick) if remat else tick
+        zf = zvar.astype(jnp.float32)
+        init = (state0, zf, zf, zf)
+        (state, loss_sum, denom, aux_sum), _ = lax.scan(tick_fn, init, jnp.arange(T))
+        loss_sum = lax.psum(loss_sum, axis)
+        denom = lax.psum(denom, axis)
+        aux_sum = lax.psum(aux_sum, axis)
+        loss = loss_sum / jnp.maximum(denom, 1.0)
+        # each microbatch visits every stage once, so Σ aux over (stage, tick)
+        # pairs is Σ_mb full-model aux; divide by M for the per-batch mean
+        return loss, aux_sum / M
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(param_specs, feed_spec),
+        out_specs=(PartitionSpec(), PartitionSpec()), axis_names={axis},
+    )(params, feed)
