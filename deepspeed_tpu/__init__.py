@@ -99,3 +99,10 @@ def add_config_arguments(parser):
 
 def init_distributed(*args, **kwargs):
     return comm.init_distributed(*args, **kwargs)
+
+
+def init_inference(model, config=None, **kwargs):
+    """Build an inference engine (reference ``deepspeed/__init__.py:273``)."""
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(model, config=config, **kwargs)

@@ -1,0 +1,4 @@
+"""Inference (reference deepspeed/inference/)."""
+
+from .config import DeepSpeedInferenceConfig  # noqa: F401
+from .engine import InferenceEngine, init_inference  # noqa: F401

@@ -1,0 +1,187 @@
+"""Inference engine v1 — TP-sharded generation with a static KV cache.
+
+Reference: ``deepspeed/inference/engine.py`` (``InferenceEngine:39``): builds the
+TP group (:254), applies kernel injection/AutoTP (:408), CUDA-graph capture
+(:524), ``generate`` wrapper (:613), fused decode kernels driving a KV cache
+(``ops/transformer/inference/op_binding``).
+
+TPU-native mapping:
+- kernel injection → nothing to inject: the model's matmuls/attention already
+  lower onto the MXU and XLA fuses the pointwise chain; the Pallas flash kernel
+  covers prefill attention.
+- AutoTP sharding → ``tp_specs`` PartitionSpecs over the ``model`` mesh axis
+  (same column/row-parallel layout ``module_inject/auto_tp.py`` infers).
+- CUDA-graph capture/replay → ``jit``: the decode step compiles once; replay is
+  the cached executable.
+- KV cache (``inference_context.h``) → static (L, B, T, kvh, hd) arrays updated
+  via ``dynamic_update_slice`` inside the compiled step, sharded over heads.
+
+``generate`` = jitted prefill (one segment pass) + ``lax.scan`` decode loop with
+temperature / top-k / top-p sampling and EOS short-circuit masking.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm.topology import get_topology
+from ..utils.logging import log_dist
+from .config import DeepSpeedInferenceConfig
+
+
+def _sample_logits(logits, rng, temperature, top_k, top_p):
+    """Sample next token from (B, V) fp32 logits (greedy when temperature=0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; keep at least 1 token
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Generation engine over a ``TransformerLM`` (reference ``InferenceEngine:39``)."""
+
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
+                 params=None, topology=None, **kwargs):
+        self.config = config or DeepSpeedInferenceConfig.from_dict(kwargs)
+        self.module = model
+        self.topology = topology or get_topology()
+        self._mesh = self.topology.mesh
+
+        dtype = {"fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
+                 "float32": jnp.float32, "float16": jnp.float16,
+                 "bfloat16": jnp.bfloat16}[str(self.config.dtype).replace("torch.", "")]
+        self.dtype = dtype
+
+        if params is None:
+            if hasattr(model, "params"):
+                params = model.params
+            else:
+                params = model.init_params(jax.random.PRNGKey(0))
+        # TP placement: model-axis sharding from the model's specs (AutoTP analogue)
+        tp_specs = getattr(model, "tp_specs", None)
+        if tp_specs is not None:
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self._mesh, s), tp_specs,
+                is_leaf=lambda s: isinstance(s, P),
+            )
+            self.params = jax.device_put(
+                jax.tree.map(lambda a: jnp.asarray(a, dtype), params), shardings
+            )
+        else:
+            self.params = jax.device_put(
+                jax.tree.map(lambda a: jnp.asarray(a, dtype), params),
+                NamedSharding(self._mesh, P()),
+            )
+        self._decode_fns = {}
+        log_dist(
+            f"InferenceEngine: dtype={dtype.__name__} tp={self.topology.model_parallel_size}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    def _build_generate(self, prompt_len: int, max_new: int, temperature, top_k, top_p):
+        model = self.module
+
+        def gen(params, input_ids, rng, eos_id):
+            B, S = input_ids.shape
+            total = S + max_new
+            cache = model.init_kv_cache(B, total, dtype=self.dtype)
+            # prefill the whole prompt in one segment pass
+            logits, cache = model.forward_with_cache(params, input_ids, cache, 0)
+            rng, sub = jax.random.split(rng)
+            next_tok = _sample_logits(logits.astype(jnp.float32), sub,
+                                      temperature, top_k, top_p)
+            done = next_tok == eos_id
+
+            def step(carry, i):
+                cache, tok, rng, done = carry
+                rng, sub = jax.random.split(rng)
+                # tok sits at sequence position S + i (prompt is 0..S-1)
+                logits, cache = model.forward_with_cache(
+                    params, tok[:, None], cache, S + i
+                )
+                nxt = _sample_logits(logits.astype(jnp.float32), sub,
+                                     temperature, top_k, top_p)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+                return (cache, nxt, rng, done), tok
+
+            (cache, last, rng, done), toks = jax.lax.scan(
+                step, (cache, next_tok, rng, done), jnp.arange(max_new - 1)
+            )
+            # toks holds tokens emitted at steps 0..max_new-2; append the last
+            out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+            return out
+
+        return jax.jit(gen, static_argnames=())
+
+    # ------------------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: Optional[float] = None,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 eos_token_id: int = -1, seed: int = 0, **kwargs):
+        """Generate continuations (reference ``engine.py:613 _generate``).
+
+        input_ids: (B, S) int32. Returns (B, max_new_tokens) int32 — generated
+        tokens only (padded with ``eos_token_id`` after EOS).
+        """
+        cfg = self.config
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
+        top_p = cfg.top_p if top_p is None else top_p
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        key = (input_ids.shape[1], max_new_tokens, float(temperature), int(top_k),
+               float(top_p))
+        if key not in self._decode_fns:
+            self._decode_fns[key] = self._build_generate(
+                input_ids.shape[1], max_new_tokens, temperature, top_k, top_p
+            )
+        return self._decode_fns[key](
+            self.params, input_ids, jax.random.PRNGKey(seed),
+            jnp.asarray(eos_token_id, jnp.int32),
+        )
+
+    def forward(self, input_ids, **kwargs):
+        """Logits over the full input (reference ``forward:584``)."""
+        return self.module.logits(self.params, jnp.asarray(input_ids, jnp.int32))
+
+    __call__ = forward
+
+
+def init_inference(model, config=None, **kwargs) -> InferenceEngine:
+    """Build an inference engine (reference ``deepspeed/__init__.py:273``)."""
+    if config is None:
+        config = DeepSpeedInferenceConfig.from_dict(kwargs)
+    elif isinstance(config, dict):
+        merged = dict(config)
+        merged.update(kwargs)
+        config = DeepSpeedInferenceConfig.from_dict(merged)
+    elif kwargs:
+        # config instance + kwargs: merge (reference merges kwargs into config)
+        merged = dict(config.__dict__)
+        merged.update(kwargs)
+        config = DeepSpeedInferenceConfig.from_dict(merged)
+    from ..comm import init_distributed
+    from ..comm.topology import get_topology, initialize_topology
+
+    tp = config.tp_size
+    init_distributed()
+    topo = get_topology(required=False)
+    if tp > 1 and (topo is None or topo.model_parallel_size != tp):
+        topo = initialize_topology(model=tp)
+    return InferenceEngine(model, config, topology=topo)

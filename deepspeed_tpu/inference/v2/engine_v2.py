@@ -1,0 +1,222 @@
+"""Continuous-batching inference engine (FastGen equivalent).
+
+Reference: ``deepspeed/inference/v2/engine_v2.py`` — ``InferenceEngineV2.put:107``
+runs prefill+decode of mixed requests in one forward over a ragged batch;
+``engine_factory.py:67 build_hf_engine``; blocked-KV flash kernels.
+
+TPU re-design (SURVEY.md §7 "hard parts" #1): XLA needs static shapes, so the
+ragged batch becomes **bucketed static shapes**:
+
+- KV cache: one slot per live sequence, (L, max_seqs, max_seq_len, kvh, hd) —
+  the paged-blocks indirection is unnecessary when slots are dense and XLA keeps
+  the pool donated in HBM.
+- prefill: prompts are padded to power-of-two length buckets and processed by a
+  per-bucket compiled program, vmapped over sequences with per-sequence cache
+  offsets (chunked split-fuse: long prompts go through in ``prefill_chunk``
+  pieces so decode latency stays bounded).
+- decode: ONE compiled step for up to ``max_seqs`` sequences (inactive slots
+  masked), each at its own position — the continuous batch.
+
+``put(uids, tokens)`` matches the reference surface: new sequences join, all
+live sequences advance one token, and per-uid last-token logits come back.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+from ..config import DeepSpeedInferenceConfig
+from .ragged_manager import DSStateManager
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngineV2:
+    """Continuous-batching engine over a ``TransformerLM``."""
+
+    def __init__(self, model, params=None, *, max_seqs: int = 8,
+                 max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
+                 dtype=jnp.float32):
+        self.model = model
+        self.cfg = model.config
+        self.max_seqs = max_seqs
+        self.max_seq_len = max_seq_len or model.config.max_seq_len
+        self.prefill_chunk = prefill_chunk
+        self.dtype = dtype
+        if params is None:
+            params = model.init_params(jax.random.PRNGKey(0))
+        self.params = jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+        self.state = DSStateManager(max_seqs, self.max_seq_len)
+        # slot-pooled KV cache: (L, max_seqs, T, kvh, hd)
+        self.kv = model.init_kv_cache(max_seqs, self.max_seq_len, dtype=dtype)
+        self._prefill_fns = {}
+        self._decode_fn = None
+        log_dist(
+            f"InferenceEngineV2: slots={max_seqs} ctx={self.max_seq_len} "
+            f"chunk={prefill_chunk}", ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _get_prefill(self, S: int):
+        """Per-bucket prefill: (n_seq, S) ids at per-seq offsets → last logits."""
+        if S in self._prefill_fns:
+            return self._prefill_fns[S]
+        model = self.model
+
+        def one(params, kv_slot, ids, start, n_valid):
+            # kv_slot: (L, T, kvh, hd) one sequence's cache; returns last VALID logit
+            logits_all, new_kv = model.forward_with_cache_all(
+                params, ids[None], (kv_slot[0][:, None], kv_slot[1][:, None]), start
+            )
+            lg = logits_all[0, jnp.clip(n_valid - 1, 0, S - 1)]
+            return lg, (new_kv[0][:, 0], new_kv[1][:, 0])
+
+        def prefill(params, kv, ids, slots, starts, n_valid):
+            # gather slots, run vmapped, scatter back
+            k, v = kv
+            ks = k[:, slots]  # (L, n, T, kvh, hd)
+            vs = v[:, slots]
+            lg, (nk, nv) = jax.vmap(one, in_axes=(None, ((1, 1)), 0, 0, 0))(
+                params, (ks, vs), ids, starts, n_valid
+            )
+            k = k.at[:, slots].set(nk.transpose(1, 0, 2, 3, 4))
+            v = v.at[:, slots].set(nv.transpose(1, 0, 2, 3, 4))
+            return lg, (k, v)
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns[S] = fn
+        return fn
+
+    def _get_decode(self):
+        """One decode step for the full slot pool (inactive slots masked)."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model = self.model
+
+        def one(params, kv_slot, tok, pos):
+            logits, new_kv = model.forward_with_cache(
+                params, tok[None, None], (kv_slot[0][:, None], kv_slot[1][:, None]), pos
+            )
+            return logits[0], (new_kv[0][:, 0], new_kv[1][:, 0])
+
+        def decode(params, kv, toks, poss, active):
+            k, v = kv
+            lg, (nk, nv) = jax.vmap(one, in_axes=(None, ((1, 1)), 0, 0))(
+                params, (k, v), toks, poss
+            )
+            mask = active[None, :, None, None, None]
+            k = jnp.where(mask, nk.transpose(1, 0, 2, 3, 4), k)
+            v = jnp.where(mask, nv.transpose(1, 0, 2, 3, 4), v)
+            return lg, (k, v)
+
+        self._decode_fn = jax.jit(decode, donate_argnums=(1,))
+        return self._decode_fn
+
+    # ------------------------------------------------------------------
+    # reference surface
+    # ------------------------------------------------------------------
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
+            do_checks: bool = True) -> Dict[int, np.ndarray]:
+        """Advance the engine one step with new/continuing requests
+        (reference ``engine_v2.py:107``).
+
+        For each uid: if new (or given fresh tokens), the tokens are prefilled
+        (chunked); every live sequence then yields its next-token logits.
+        Returns {uid: (V,) numpy logits}.
+        """
+        if do_checks and len(batch_uids) > self.state.max_seqs:
+            raise RuntimeError(f"batch of {len(batch_uids)} exceeds {self.state.max_seqs} slots")
+        # 1. register / extend sequences
+        for uid, toks in zip(batch_uids, batch_tokens):
+            desc = self.state.get_or_create_sequence(uid)
+            if toks is not None and len(toks):
+                desc.pending.extend(int(t) for t in toks)
+
+        out: Dict[int, np.ndarray] = {}
+        # 2. chunked prefill for pending prompt tokens (split-fuse: bounded
+        # chunks, grouped by padded segment length). A sequence near the end of
+        # its slot gets an exact-fit segment (dynamic_update_slice clamps
+        # out-of-range starts, which would silently corrupt the cache).
+        while True:
+            work = [d for d in self.state.seqs.values() if d.in_flight > 0]
+            if not work:
+                break
+            groups: Dict[int, list] = {}
+            for d in work:
+                take = min(self.prefill_chunk, d.in_flight)
+                room = self.max_seq_len - d.seen_tokens
+                if room < take:
+                    raise RuntimeError(
+                        f"uid {d.uid}: prompt exceeds slot context "
+                        f"({d.seen_tokens}+{take} > {self.max_seq_len})"
+                    )
+                seg = min(_bucket(take), room)
+                groups.setdefault(seg, []).append(d)
+            for S, grp in groups.items():
+                ids = np.zeros((len(grp), S), np.int32)
+                starts = np.zeros((len(grp),), np.int32)
+                slots = np.zeros((len(grp),), np.int32)
+                nval = np.zeros((len(grp),), np.int32)
+                for i, d in enumerate(grp):
+                    take = min(S, d.in_flight, self.prefill_chunk)
+                    ids[i, :take] = d.pending[:take]
+                    del d.pending[:take]
+                    starts[i] = d.seen_tokens
+                    slots[i] = d.slot
+                    nval[i] = take
+                    d.seen_tokens += take
+                fn = self._get_prefill(S)
+                lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
+                                 jnp.asarray(slots), jnp.asarray(starts),
+                                 jnp.asarray(nval))
+                lg = np.asarray(lg)
+                for i, d in enumerate(grp):
+                    if d.in_flight == 0:  # prompt fully consumed → logits are live
+                        out[d.uid] = lg[i]
+        return out
+
+    def decode_step(self, tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
+        """One continuous-batching decode step: feed each live uid its sampled
+        token, get next-token logits for all of them."""
+        toks = np.zeros((self.max_seqs,), np.int32)
+        poss = np.zeros((self.max_seqs,), np.int32)
+        active = np.zeros((self.max_seqs,), bool)
+        by_slot: Dict[int, int] = {}
+        for uid, tok in tokens.items():
+            d = self.state.seqs[uid]
+            if d.seen_tokens >= self.max_seq_len:
+                raise RuntimeError(
+                    f"uid {uid}: context full ({d.seen_tokens} >= {self.max_seq_len}); "
+                    "flush the sequence or raise max_seq_len"
+                )
+            toks[d.slot] = tok
+            poss[d.slot] = d.seen_tokens
+            active[d.slot] = True
+            by_slot[d.slot] = uid
+            d.seen_tokens += 1
+        lg, self.kv = self._get_decode()(
+            self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
+            jnp.asarray(active),
+        )
+        lg = np.asarray(lg)
+        return {uid: lg[slot] for slot, uid in by_slot.items()}
+
+    def flush(self, uid: int):
+        self.state.flush_sequence(uid)
+
+    # reference ``query``/``can_schedule`` surface
+    def query(self) -> Tuple[int, int]:
+        return self.state.max_seqs - self.state.n_active, self.max_seq_len
+
+    def can_schedule(self, n_new: int = 1) -> bool:
+        return self.state.can_allocate(n_new)

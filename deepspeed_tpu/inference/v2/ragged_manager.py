@@ -1,0 +1,70 @@
+"""Sequence/state management for continuous batching.
+
+Reference: ``deepspeed/inference/v2/ragged/`` — ``DSStateManager``
+(``ragged_manager.py:19``), ``DSSequenceDescriptor`` (``sequence_descriptor.py``),
+``BlockedKVCache`` (``kv_cache.py:40``).
+
+TPU re-design: the reference allocates paged KV blocks and builds ragged batch
+descriptors consumed by CUDA kernels with dynamic shapes. Under XLA everything
+must be static-shaped, so the cache is a fixed pool of **sequence slots**
+(max_seqs × max_seq_len) and the host-side scheduler packs work into bucketed
+shapes; "ragged" bookkeeping (who occupies which slot, how far each sequence
+has decoded) lives here on the host where shapes don't matter.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SequenceDescriptor:
+    """reference ``DSSequenceDescriptor``: tracked state of one live sequence."""
+
+    uid: int
+    slot: int
+    seen_tokens: int = 0  # tokens already in the KV cache
+    pending: List[int] = field(default_factory=list)  # tokens not yet prefilled
+    done: bool = False
+
+    @property
+    def in_flight(self) -> int:
+        return len(self.pending)
+
+
+class DSStateManager:
+    """Slot allocator + sequence registry (reference ``ragged_manager.py:19``)."""
+
+    def __init__(self, max_seqs: int, max_seq_len: int):
+        self.max_seqs = max_seqs
+        self.max_seq_len = max_seq_len
+        self._free: List[int] = list(range(max_seqs))[::-1]
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+
+    # reference ``can_schedule`` / ``query`` (engine_v2.py:158,184)
+    def can_allocate(self, n_seqs: int = 1) -> bool:
+        return len(self._free) >= n_seqs
+
+    def get_or_create_sequence(self, uid: int) -> SequenceDescriptor:
+        if uid in self.seqs:
+            return self.seqs[uid]
+        if not self._free:
+            raise RuntimeError(f"no free KV slots for uid {uid} (max_seqs={self.max_seqs})")
+        slot = self._free.pop()
+        desc = SequenceDescriptor(uid=uid, slot=slot)
+        self.seqs[uid] = desc
+        return desc
+
+    def flush_sequence(self, uid: int):
+        """Release a finished sequence's slot (reference ``flush_sequence``)."""
+        desc = self.seqs.pop(uid, None)
+        if desc is not None:
+            self._free.append(desc.slot)
+
+    @property
+    def n_active(self) -> int:
+        return len(self.seqs)
+
+    def active(self) -> List[SequenceDescriptor]:
+        return [d for d in self.seqs.values() if not d.done]

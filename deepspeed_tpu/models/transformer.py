@@ -525,11 +525,7 @@ class TransformerLM:
         shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
-    def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
-        """Run a (possibly length-1) segment against the cache; returns
-        (logits_last, new_cache). Used by prefill (segment=prompt) and decode
-        (segment=1 token). Blocks iterate via scan carrying the cache."""
-        cfg = self.config
+    def _trunk_with_cache(self, params, input_ids, kv_cache, cache_index, positions):
         B, S = input_ids.shape
         if positions is None:
             positions = cache_index + jnp.broadcast_to(
@@ -547,8 +543,23 @@ class TransformerLM:
             return y, new_kv
 
         x, (nk, nv) = jax.lax.scan(body, x, (params["blocks"], kv_cache[0], kv_cache[1]))
-        logits = self._head(params, x[:, -1:, :])
-        return logits[:, 0, :], (nk, nv)
+        return x, (nk, nv)
+
+    def forward_with_cache_all(self, params, input_ids, kv_cache, cache_index,
+                               positions=None):
+        """Run a (possibly length-1) segment against the cache; returns
+        (logits (B,S,V), new_cache). Used by v2 prefill, which reads a
+        per-sequence valid position from the full logits."""
+        x, new_kv = self._trunk_with_cache(params, input_ids, kv_cache,
+                                           cache_index, positions)
+        return self._head(params, x), new_kv
+
+    def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
+        """Like ``forward_with_cache_all`` but projects only the LAST position
+        (B, V) — the decode/prefill hot path skips the (S, V) logits matmul."""
+        x, new_kv = self._trunk_with_cache(params, input_ids, kv_cache,
+                                           cache_index, positions)
+        return self._head(params, x[:, -1:, :])[:, 0, :], new_kv
 
 
 def build_model(preset: str, **overrides) -> TransformerLM:

@@ -85,14 +85,16 @@ class PipelineModule:
 
     # ------------------------------------------------------------------
     def apply(self, params, batch, train: bool = True, rng=None):
-        """batch: (inputs, labels) with microbatch leading dim (M, mb, ...) —
-        or flat (B, ...) split into ``self.num_micro`` microbatches."""
+        """batch: flat (inputs, labels) with global batch dim B — always split
+        into ``self.num_micro`` microbatches (pre-microbatched input is NOT
+        inferred: a flat B that happens to equal num_micro is ambiguous)."""
         params = PipelinedLM._cpu_safe(params)
         inputs, labels = batch
-        if inputs.ndim >= 2 and inputs.shape[0] != self.num_micro:
-            M = self.num_micro
-            inputs = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
-            labels = labels.reshape((M, labels.shape[0] // M) + labels.shape[1:])
+        M = self.num_micro
+        if inputs.shape[0] % M:
+            raise ValueError(f"batch {inputs.shape[0]} not divisible by {M} microbatches")
+        inputs = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
+        labels = labels.reshape((M, labels.shape[0] // M) + labels.shape[1:])
         layer = self._built[0]
 
         def first_fn(p, feed_t):
