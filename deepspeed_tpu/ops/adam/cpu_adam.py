@@ -1,0 +1,109 @@
+"""Host CPU optimizers for ZeRO-Offload.
+
+Reference: ``deepspeed/ops/adam/cpu_adam.py:13 DeepSpeedCPUAdam`` (5-7× torch
+CPU Adam via AVX) + ``cpu_adagrad``/``cpu_lion``. These operate IN PLACE on
+numpy fp32 buffers that live in host RAM (the offloaded optimizer partition);
+the engine transfers gradients device→host and pushes updated lp weights back.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import get_builder
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        builder = get_builder("cpu_adam")
+        if builder is None:
+            raise RuntimeError("cpu_adam builder unavailable")
+        _lib = builder().load()
+        _lib.ds_sq_norm.restype = ctypes.c_double
+    return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    """In-place fused Adam/AdamW on host fp32 buffers (reference ``cpu_adam.py:13``)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 bias_correction=True, adamw_mode=True, amsgrad=False, fp32_optimizer_states=True):
+        if amsgrad:
+            raise ValueError("DeepSpeedCPUAdam does not support AMSGrad (parity with reference)")
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adamw_mode = adamw_mode
+        self._lib = _load()
+
+    def step_flat(self, p: np.ndarray, g: np.ndarray, m: np.ndarray, v: np.ndarray,
+                  step: int, lr: Optional[float] = None, grad_scale: float = 1.0,
+                  clip_coef: float = 1.0):
+        """One update on a flat fp32 shard; p/m/v updated in place."""
+        assert p.dtype == np.float32 and g.dtype == np.float32
+        self._lib.ds_adam_step(
+            _fptr(p), _fptr(g), _fptr(m), _fptr(v), ctypes.c_int64(p.size),
+            ctypes.c_float(self.lr if lr is None else lr),
+            ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+            ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+            ctypes.c_int64(step), ctypes.c_int(1 if self.adamw_mode else 0),
+            ctypes.c_int(1 if self.bias_correction else 0),
+            ctypes.c_float(grad_scale), ctypes.c_float(clip_coef),
+        )
+
+    def sq_norm(self, g: np.ndarray, grad_scale: float = 1.0) -> float:
+        return float(self._lib.ds_sq_norm(_fptr(g), ctypes.c_int64(g.size),
+                                          ctypes.c_float(grad_scale)))
+
+    def f32_to_bf16(self, src: np.ndarray) -> np.ndarray:
+        out = np.empty(src.shape, dtype=np.uint16)
+        self._lib.ds_f32_to_bf16(
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), _fptr(src),
+            ctypes.c_int64(src.size),
+        )
+        return out.view("<u2")
+
+
+class DeepSpeedCPUAdagrad:
+    """reference ``csrc/adagrad/cpu_adagrad.cpp`` equivalent."""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._lib = _load()
+
+    def step_flat(self, p, g, v, lr=None, grad_scale=1.0):
+        self._lib.ds_adagrad_step(
+            _fptr(p), _fptr(g), _fptr(v), ctypes.c_int64(p.size),
+            ctypes.c_float(self.lr if lr is None else lr), ctypes.c_float(self.eps),
+            ctypes.c_float(self.weight_decay), ctypes.c_float(grad_scale),
+        )
+
+
+class DeepSpeedCPULion:
+    """reference ``csrc/lion`` equivalent."""
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr = lr
+        self.betas = betas
+        self.weight_decay = weight_decay
+        self._lib = _load()
+
+    def step_flat(self, p, g, m, lr=None, grad_scale=1.0):
+        self._lib.ds_lion_step(
+            _fptr(p), _fptr(g), _fptr(m), ctypes.c_int64(p.size),
+            ctypes.c_float(self.lr if lr is None else lr),
+            ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+            ctypes.c_float(self.weight_decay), ctypes.c_float(grad_scale),
+        )

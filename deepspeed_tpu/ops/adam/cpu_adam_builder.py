@@ -1,0 +1,11 @@
+"""Builder for the host CPU optimizer library (reference ``op_builder/cpu_adam.py``)."""
+
+from ..op_builder import OpBuilder, register_builder
+
+
+@register_builder
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["csrc/adam/cpu_adam.cpp"]

@@ -1,0 +1,14 @@
+"""Builder for the async-IO library (reference ``op_builder/async_io.py``)."""
+
+from ..op_builder import OpBuilder, register_builder
+
+
+@register_builder
+class AsyncIOBuilder(OpBuilder):
+    NAME = "aio"
+
+    def sources(self):
+        return ["csrc/aio/aio.cpp"]
+
+    def libraries_args(self):
+        return ["-lpthread"]

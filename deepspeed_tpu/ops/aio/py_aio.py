@@ -1,0 +1,72 @@
+"""Python handle over the threaded AIO library.
+
+Reference: ``deepspeed/ops/aio`` + ``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp``
+(``AsyncIOBuilder().load().aio_handle(...)`` surface: pread/pwrite/wait).
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import get_builder
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        builder = get_builder("aio")
+        if builder is None:
+            raise RuntimeError("aio builder unavailable")
+        _lib = builder().load()
+        _lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        _lib.ds_aio_pread.restype = ctypes.c_int64
+        _lib.ds_aio_pwrite.restype = ctypes.c_int64
+        _lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        _lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        _lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+        _lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+class AsyncIOHandle:
+    """Threaded async pread/pwrite (reference ``aio_handle``)."""
+
+    def __init__(self, num_threads: int = 4, use_direct: bool = False):
+        self._lib = _load()
+        self._h = self._lib.ds_aio_handle_new(ctypes.c_int(num_threads),
+                                              ctypes.c_int(1 if use_direct else 0))
+
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        """Submit an async read into ``buf``; returns a request id."""
+        return self._lib.ds_aio_pread(self._h, path.encode(),
+                                      buf.ctypes.data_as(ctypes.c_void_p),
+                                      ctypes.c_int64(buf.nbytes), ctypes.c_int64(offset))
+
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        return self._lib.ds_aio_pwrite(self._h, path.encode(),
+                                       buf.ctypes.data_as(ctypes.c_void_p),
+                                       ctypes.c_int64(buf.nbytes), ctypes.c_int64(offset))
+
+    def wait(self, req_id: int) -> int:
+        """Block until the request completes; 0 = success."""
+        return self._lib.ds_aio_wait(self._h, ctypes.c_int64(req_id))
+
+    def wait_all(self) -> int:
+        return self._lib.ds_aio_wait_all(self._h)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ds_aio_handle_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

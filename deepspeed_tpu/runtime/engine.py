@@ -150,7 +150,11 @@ class DeepSpeedEngine:
         # place lp params (compute dtype) and fp32 master
         lp = jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), params)
         self.params = jax.device_put(lp, self._param_shardings)
-        if self._mixed:
+        off = config.zero_config.offload_optimizer
+        self._offload_enabled = bool(
+            off is not None and off.device in ("cpu", "nvme")
+        )
+        if self._mixed and not self._offload_enabled:
             master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
             self.master_params = jax.device_put(master, self._opt_shardings)
         else:
@@ -164,7 +168,11 @@ class DeepSpeedEngine:
             self.optimizer = build_optimizer(config.optimizer_name, config.optimizer_params)
         else:
             self.optimizer = None
-        if self.optimizer is not None:
+        self._offload_mgr = None
+        if self.optimizer is not None and self._offload_enabled:
+            self.opt_state = None
+            self._setup_offload(off, params)
+        elif self.optimizer is not None:
             master_like = self.master_params if self._mixed else self.params
             opt_state = self.optimizer.init(master_like)
             # moments shard like the master/opt specs; step counter replicated
@@ -320,6 +328,134 @@ class DeepSpeedEngine:
             self._step_fn = None
 
     # ------------------------------------------------------------------
+    # ZeRO-Offload / Offload++ / ZeRO-Infinity (reference stage_1_and_2.py
+    # cpu_offload + swap_tensor NVMe tier; see zero/offload.py)
+    # ------------------------------------------------------------------
+    def _setup_offload(self, off, fp32_params):
+        from ..ops.adam.cpu_adam import DeepSpeedCPUAdam
+        from ..ops.optimizers import FusedAdam
+        from .zero.offload import OffloadedAdamState, split_by_ratio
+
+        if not isinstance(self.optimizer, FusedAdam):
+            raise ValueError(
+                "offload_optimizer requires an Adam-family optimizer "
+                "(reference forces DeepSpeedCPUAdam)"
+            )
+        leaves, treedef = jax.tree.flatten(fp32_params)
+        host_idx, dev_idx = split_by_ratio(leaves, off.ratio)
+        opt = self.optimizer
+        cpu_opt = DeepSpeedCPUAdam(
+            lr=opt.lr, betas=opt.betas, eps=opt.eps, weight_decay=opt.weight_decay,
+            bias_correction=opt.bias_correction, adamw_mode=opt.adam_w_mode,
+        )
+        host_state = OffloadedAdamState(
+            [np.asarray(leaves[i], np.float32) for i in host_idx],
+            device=off.device, nvme_path=off.nvme_path,
+        )
+        opt_shardings_flat = jax.tree.leaves(self._opt_shardings)
+        dev_state = None
+        if dev_idx:
+            dev_master = [jax.device_put(jnp.asarray(leaves[i], jnp.float32),
+                                         opt_shardings_flat[i]) for i in dev_idx]
+            dev_state = {
+                "master": dev_master,
+                "m": [jnp.zeros_like(m) for m in dev_master],
+                "v": [jnp.zeros_like(m) for m in dev_master],
+            }
+        self._offload_mgr = {
+            "treedef": treedef, "host_idx": host_idx, "dev_idx": dev_idx,
+            "host": host_state, "dev": dev_state, "cpu_opt": cpu_opt,
+        }
+        log_dist(
+            f"ZeRO-Offload: {len(host_idx)} leaves -> {off.device} "
+            f"(ratio={off.ratio}), {len(dev_idx)} stay on device", ranks=[0],
+        )
+
+    def _step_offload(self, lr: float):
+        """Optimizer step with offloaded states. Host leaves run the C++ CPU
+        Adam (twin-flow: concurrently with the device subset's jitted update)."""
+        mgr = self._offload_mgr
+        grads_flat = jax.tree.leaves(self._acc_grads)
+        cfg = self.config
+        if not hasattr(self, "_norm_fn"):
+            self._norm_fn = jax.jit(_global_norm)
+        inv_scale = 1.0 / float(self.scaler_state.cur_scale)
+        # overflow must cover ALL gradients (host and device leaves) and must be
+        # decided BEFORE the donating device sub-step runs
+        overflow = False
+        if cfg.fp16_enabled:
+            if not hasattr(self, "_overflow_fn"):
+                self._overflow_fn = jax.jit(has_overflow)
+            overflow = bool(self._overflow_fn(self._acc_grads))
+        gnorm = None
+        clip_coef = 1.0
+        if cfg.gradient_clipping > 0:
+            # norm of the UNSCALED gradients (norm is homogeneous: scale after)
+            gnorm = float(self._norm_fn(self._acc_grads)) * inv_scale
+            clip_coef = min(1.0, cfg.gradient_clipping / (gnorm + 1e-6))
+        if overflow:
+            mgr["host"].step_count += 1  # keep Adam step parity with skipped steps
+            self._last_global_norm = gnorm
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(True)
+            )
+            return True, gnorm
+
+        # kick off the device subset first so it overlaps the host work
+        dev_out = None
+        if mgr["dev"] is not None:
+            if not hasattr(self, "_sub_step_fn"):
+                opt = self.optimizer
+
+                def sub_step(master, m, v, grads, lr, coef, inv, step):
+                    from ..ops.optimizers import OptState
+
+                    g = [gg.astype(jnp.float32) * inv * coef for gg in grads]
+                    state = OptState(step=step, m=m, v=v)
+                    new_master, new_state = opt.update(g, state, master, lr)
+                    return new_master, new_state.m, new_state.v
+
+                self._sub_step_fn = jax.jit(sub_step, donate_argnums=(0, 1, 2))
+            d = mgr["dev"]
+            dev_out = self._sub_step_fn(
+                d["master"], d["m"], d["v"],
+                [grads_flat[i] for i in mgr["dev_idx"]],
+                jnp.asarray(lr, jnp.float32), jnp.asarray(clip_coef, jnp.float32),
+                jnp.asarray(inv_scale, jnp.float32),
+                # opt.update increments internally: pass the pre-step count
+                jnp.asarray(mgr["host"].step_count, jnp.int32),
+            )
+
+        host_grads = [np.asarray(grads_flat[i], np.float32) for i in mgr["host_idx"]]
+        new_master = mgr["host"].adam_step(
+            mgr["cpu_opt"], host_grads, lr, grad_scale=inv_scale,
+            clip_coef=clip_coef,
+        )
+
+        # assemble the new lp tree
+        params_flat = list(jax.tree.leaves(self.params))
+        shard_flat = jax.tree.leaves(self._param_shardings)
+        for j, i in enumerate(mgr["host_idx"]):
+            lp = jnp.asarray(new_master[j], dtype=jnp.float32)
+            if self.compute_dtype != jnp.float32:
+                lp = lp.astype(self.compute_dtype)
+            params_flat[i] = jax.device_put(lp, shard_flat[i])
+        if dev_out is not None:
+            d = mgr["dev"]
+            d["master"], d["m"], d["v"] = dev_out
+            for j, i in enumerate(mgr["dev_idx"]):
+                params_flat[i] = jax.device_put(
+                    d["master"][j].astype(self.compute_dtype), shard_flat[i]
+                )
+        self.params = jax.tree.unflatten(mgr["treedef"], params_flat)
+        self._last_global_norm = gnorm
+        if cfg.fp16_enabled:
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(False)
+            )
+        return False, gnorm
+
+    # ------------------------------------------------------------------
     # reference API surface
     # ------------------------------------------------------------------
     def train(self, mode: bool = True):
@@ -403,6 +539,18 @@ class DeepSpeedEngine:
     def step(self):
         """Optimizer step at gradient-accumulation boundaries (no-op otherwise)."""
         if self.micro_steps == 0 or not self.is_gradient_accumulation_boundary():
+            return
+        if self._offload_mgr is not None:
+            self.timers(STEP_MICRO_TIMER).start()
+            overflow, gnorm = self._step_offload(float(self.get_lr()[0]))
+            self._acc_grads = None
+            self.global_steps += 1
+            self.global_samples += self.config.train_batch_size
+            if overflow:
+                self.skipped_steps += 1
+            elif self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self.timers(STEP_MICRO_TIMER).stop()
             return
         if self._step_fn is None:
             raise RuntimeError("no optimizer configured")
@@ -522,7 +670,10 @@ class DeepSpeedEngine:
         self.checkpoint_engine.makedirs(d, exist_ok=True)
         self.checkpoint_engine.create(tag)
 
-        module_state = self.master_params if self._mixed else self.params
+        if self._offload_mgr is not None:
+            module_state = self._offload_master_tree()
+        else:
+            module_state = self.master_params if self._mixed else self.params
         model_sd = {
             "module": module_state,
             "dtype": str(self.compute_dtype.__name__),
@@ -544,7 +695,19 @@ class DeepSpeedEngine:
         if jax.process_index() == 0:
             self.checkpoint_engine.save(model_sd, model_path)
 
-        if self.opt_state is not None:
+        if self._offload_mgr is not None:
+            mgr = self._offload_mgr
+            optim_sd = {
+                "offload_host": mgr["host"].state_dict(),
+                "offload_dev": None if mgr["dev"] is None else _gather_to_host(
+                    {"master": mgr["dev"]["master"], "m": mgr["dev"]["m"],
+                     "v": mgr["dev"]["v"]}
+                ),
+                "scaler": _gather_to_host(self.scaler_state._asdict()),
+            }
+            if jax.process_index() == 0:
+                self.checkpoint_engine.save(optim_sd, optim_path)
+        elif self.opt_state is not None:
             optim_sd = {
                 "step": self.opt_state.step,
                 "m": self.opt_state.m,
@@ -576,20 +739,17 @@ class DeepSpeedEngine:
         model_sd = self.checkpoint_engine.load(model_path)
 
         module = model_sd["module"]
-        if self._mixed:
+        if self._mixed and self._offload_mgr is None:
             self.master_params = jax.device_put(
                 jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), module),
                 self._opt_shardings,
             )
-            self.params = jax.device_put(
-                jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), module),
-                self._param_shardings,
-            )
-        else:
-            self.params = jax.device_put(
-                jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), module),
-                self._param_shardings,
-            )
+        # under offload the fp32 master lives host/NVMe-side (restored below);
+        # materializing a device copy would defeat the offload
+        self.params = jax.device_put(
+            jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), module),
+            self._param_shardings,
+        )
         self.global_steps = int(model_sd.get("global_steps", 0))
         self.global_samples = int(model_sd.get("global_samples", 0))
         self.skipped_steps = int(model_sd.get("skipped_steps", 0))
@@ -597,7 +757,40 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and self.lr_scheduler is not None and "lr_scheduler" in model_sd:
             self.lr_scheduler.load_state_dict(model_sd["lr_scheduler"])
 
-        if not load_module_only and load_optimizer_states and self.opt_state is not None \
+        if self._offload_mgr is not None and not load_module_only \
+                and load_optimizer_states and os.path.exists(optim_path):
+            optim_sd = self.checkpoint_engine.load(optim_path)
+            mgr = self._offload_mgr
+            mgr["host"].load_state_dict(optim_sd["offload_host"])
+            if mgr["dev"] is not None and optim_sd.get("offload_dev"):
+                od = optim_sd["offload_dev"]
+                shard_flat = jax.tree.leaves(self._opt_shardings)
+                for j, i in enumerate(mgr["dev_idx"]):
+                    mgr["dev"]["master"][j] = jax.device_put(
+                        jnp.asarray(od["master"][j], jnp.float32), shard_flat[i])
+                    mgr["dev"]["m"][j] = jax.device_put(
+                        jnp.asarray(od["m"][j], jnp.float32), shard_flat[i])
+                    mgr["dev"]["v"][j] = jax.device_put(
+                        jnp.asarray(od["v"][j], jnp.float32), shard_flat[i])
+            # module weights ARE the master copies under offload
+            master = model_sd["module"]
+            flat = jax.tree.leaves(master)
+            for j, i in enumerate(mgr["host_idx"]):
+                mgr["host"].master[j][...] = np.asarray(flat[i], np.float32)
+            if mgr["dev"] is not None and not optim_sd.get("offload_dev"):
+                shard_flat = jax.tree.leaves(self._opt_shardings)
+                for j, i in enumerate(mgr["dev_idx"]):
+                    mgr["dev"]["master"][j] = jax.device_put(
+                        jnp.asarray(flat[i], jnp.float32), shard_flat[i])
+            sc = optim_sd.get("scaler")
+            if sc is not None:
+                self.scaler_state = LossScalerState(
+                    cur_scale=jnp.asarray(sc["cur_scale"], jnp.float32),
+                    cur_hysteresis=jnp.asarray(sc["cur_hysteresis"], jnp.int32),
+                    last_overflow_iter=jnp.asarray(sc["last_overflow_iter"], jnp.int32),
+                    iter_=jnp.asarray(sc["iter_"], jnp.int32),
+                )
+        elif not load_module_only and load_optimizer_states and self.opt_state is not None \
                 and os.path.exists(optim_path):
             optim_sd = self.checkpoint_engine.load(optim_path)
             self.opt_state = self.opt_state._replace(
@@ -630,10 +823,26 @@ class DeepSpeedEngine:
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
 
+    def _offload_master_tree(self):
+        """Full fp32 master pytree assembled from host + device offload shards."""
+        mgr = self._offload_mgr
+        flat = [None] * (len(mgr["host_idx"]) + len(mgr["dev_idx"]))
+        for j, i in enumerate(mgr["host_idx"]):
+            flat[i] = mgr["host"].master[j]
+        if mgr["dev"] is not None:
+            for j, i in enumerate(mgr["dev_idx"]):
+                flat[i] = mgr["dev"]["master"][j]
+        return jax.tree.unflatten(mgr["treedef"], flat)
+
     def get_fp32_params(self):
         """Full-precision view of the module weights (``zero_to_fp32`` surface)."""
-        src = self.master_params if self._mixed else self.params
-        return jax.tree.map(lambda p: np.asarray(jax.device_get(p), np.float32), src)
+        if self._offload_mgr is not None:
+            src = self._offload_master_tree()
+        else:
+            src = self.master_params if self._mixed else self.params
+        return jax.tree.map(
+            lambda p: np.asarray(jax.device_get(p) if isinstance(p, jax.Array) else p,
+                                 np.float32), src)
 
     @property
     def train_batch_size(self):

@@ -1,0 +1,138 @@
+"""ZeRO-Offload / Offload++ / ZeRO-Infinity optimizer-state offload.
+
+Reference: ``runtime/zero/stage_1_and_2.py`` ``cpu_offload`` path (optimizer
+states + fp32 master in host RAM, updated by ``DeepSpeedCPUAdam``),
+``offload_config.py`` ``ratio`` = Offload++ twin-flow partial offload
+(``engine.py:717 zero_partial_offload``), and the NVMe tier
+(``runtime/swap_tensor/partitioned_optimizer_swapper.py`` over ``csrc/aio``).
+
+TPU design: lp (compute-dtype) parameters always stay in HBM — only the fp32
+master copy and Adam moments move to host RAM (device="cpu") or to NVMe files
+accessed through the threaded AIO library (device="nvme", with read-ahead
+prefetch of the next leaf — the reference's double-buffered
+``pipelined_optimizer_swapper``). ``ratio`` < 1 keeps the largest leaves'
+states on device (updated by the jitted step) and offloads the rest, i.e.
+twin-flow: both update paths run concurrently.
+"""
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import log_dist
+from ..config import DeepSpeedConfig
+
+
+class OffloadedAdamState:
+    """Host/NVMe-resident fp32 master + moments for a subset of leaves."""
+
+    def __init__(self, leaves: List[np.ndarray], device: str = "cpu",
+                 nvme_path: Optional[str] = None, aio_threads: int = 4):
+        from ...ops.adam.cpu_adam import DeepSpeedCPUAdam  # builds the C++ lib
+
+        self.device = device
+        # np.array(copy=True): np.asarray of a jax buffer is a READ-ONLY view —
+        # the C++ updater writes through raw pointers and must own its memory
+        self.master = [np.array(l, np.float32, copy=True) for l in leaves]
+        self.step_count = 0
+        if device == "nvme":
+            from ...ops.aio.py_aio import AsyncIOHandle
+
+            assert nvme_path, "offload_optimizer.nvme_path required for device='nvme'"
+            os.makedirs(nvme_path, exist_ok=True)
+            self._aio = AsyncIOHandle(num_threads=aio_threads)
+            self._paths = [os.path.join(nvme_path, f"optstate_{i}.bin") for i in
+                           range(len(leaves))]
+            for i, m in enumerate(self.master):
+                buf = np.zeros((2, m.size), np.float32)  # [m; v]
+                rid = self._aio.pwrite(self._paths[i], buf)
+                self._aio.wait(rid)
+            self.m = self.v = None
+        else:
+            self._aio = None
+            self.m = [np.zeros(l.size, np.float32) for l in self.master]
+            self.v = [np.zeros(l.size, np.float32) for l in self.master]
+
+    # ------------------------------------------------------------------
+    def _fetch_mv(self, i) -> Tuple[np.ndarray, int]:
+        buf = np.empty((2, self.master[i].size), np.float32)
+        rid = self._aio.pread(self._paths[i], buf)
+        return buf, rid
+
+    def adam_step(self, opt, grads: List[np.ndarray], lr: float,
+                  grad_scale: float = 1.0, clip_coef: float = 1.0) -> List[np.ndarray]:
+        """Update all offloaded leaves in place; returns the master list.
+
+        NVMe: moments stream through a 2-deep prefetch pipeline — leaf i+1's
+        read is in flight while leaf i computes (reference
+        ``pipelined_optimizer_swapper`` double buffering).
+        """
+        self.step_count += 1
+        n = len(self.master)
+        if self._aio is None:
+            for i in range(n):
+                p = self.master[i]
+                opt.step_flat(p.reshape(-1), grads[i].reshape(-1), self.m[i],
+                              self.v[i], self.step_count, lr=lr,
+                              grad_scale=grad_scale, clip_coef=clip_coef)
+            return self.master
+        # NVMe tier with read-ahead
+        pending = {}
+        if n:
+            pending[0] = self._fetch_mv(0)
+        for i in range(n):
+            buf, rid = pending.pop(i)
+            if i + 1 < n:
+                pending[i + 1] = self._fetch_mv(i + 1)
+            assert self._aio.wait(rid) == 0, f"NVMe read failed for leaf {i}"
+            p = self.master[i]
+            opt.step_flat(p.reshape(-1), grads[i].reshape(-1), buf[0], buf[1],
+                          self.step_count, lr=lr, grad_scale=grad_scale,
+                          clip_coef=clip_coef)
+            wid = self._aio.pwrite(self._paths[i], buf)
+            self._aio.wait(wid)
+        return self.master
+
+    def state_dict(self) -> Dict:
+        if self._aio is None:
+            return {"master": self.master, "m": self.m, "v": self.v,
+                    "step": self.step_count}
+        mv = []
+        for i in range(len(self.master)):
+            buf, rid = self._fetch_mv(i)
+            self._aio.wait(rid)
+            mv.append(buf)
+        return {"master": self.master, "mv": mv, "step": self.step_count}
+
+    def load_state_dict(self, sd: Dict):
+        self.step_count = int(sd["step"])
+        for i, m in enumerate(sd["master"]):
+            self.master[i][...] = m
+        if self._aio is None:
+            for i in range(len(self.m)):
+                self.m[i][...] = sd["m"][i]
+                self.v[i][...] = sd["v"][i]
+        else:
+            for i, buf in enumerate(sd["mv"]):
+                rid = self._aio.pwrite(self._paths[i], np.ascontiguousarray(buf))
+                self._aio.wait(rid)
+
+
+def split_by_ratio(leaves: List, ratio: float) -> Tuple[List[int], List[int]]:
+    """Offload++ twin-flow split: offload leaves (largest first) until ``ratio``
+    of total optimizer-state bytes is host-resident; the rest stays on device."""
+    sizes = [(int(np.prod(l.shape)) if hasattr(l, "shape") else l.size, i)
+             for i, l in enumerate(leaves)]
+    total = sum(s for s, _ in sizes) or 1
+    host, dev = [], []
+    acc = 0
+    for s, i in sorted(sizes, reverse=True):
+        if acc / total < ratio:
+            host.append(i)
+            acc += s
+        else:
+            dev.append(i)
+    return sorted(host), sorted(dev)
