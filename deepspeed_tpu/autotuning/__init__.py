@@ -1,0 +1,3 @@
+"""Autotuning (reference deepspeed/autotuning/)."""
+
+from .autotuner import Autotuner, TuneResult, estimate_memory_per_chip  # noqa: F401

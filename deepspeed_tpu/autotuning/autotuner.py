@@ -1,0 +1,173 @@
+"""Autotuner: search over mesh shape / ZeRO stage / micro-batch.
+
+Reference: ``deepspeed/autotuning/autotuner.py`` (``Autotuner.tune:404``) —
+launches short profiling jobs over a config space (ZeRO stage, micro-batch,
+and other knobs), prunes by a memory model (``:278``), and emits the best
+config (``:1075``); tuners: grid / random / model-based.
+
+TPU re-design: profiling "jobs" are in-process — each candidate builds an
+engine on the live mesh, times a few steps, and is torn down; the memory model
+prunes candidates analytically before any compile (params + grads + optimizer
+states + activation estimate vs per-chip HBM).
+"""
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+HBM_PER_CHIP = {
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,
+    "TPU v5e": 16e9,
+    "TPU v5p": 95e9,
+    "TPU v6e": 32e9,
+}
+
+
+@dataclass
+class TuneResult:
+    config: Dict[str, Any]
+    throughput: float  # samples/sec (0 = failed)
+    step_ms: float = 0.0
+    error: Optional[str] = None
+
+
+def estimate_memory_per_chip(n_params: int, zero_stage: int, dp: int, mp: int,
+                             micro_bs: int, seq: int, hidden: int, layers: int,
+                             dtype_bytes: int = 2, remat: bool = True) -> float:
+    """Analytic memory model (reference ``autotuner.py:278`` area): params +
+    grads + optimizer states partitioned per ZeRO stage, + activations."""
+    p = n_params / mp
+    weights = p * dtype_bytes
+    grads = p * 4
+    opt = p * 12  # fp32 master + 2 moments
+    if zero_stage >= 1:
+        opt /= dp
+    if zero_stage >= 2:
+        grads /= dp
+    if zero_stage >= 3:
+        weights /= dp
+    act_per_layer = micro_bs * seq * hidden * dtype_bytes / mp
+    # remat saves only the per-layer residual stream; otherwise ~8 tensors/layer
+    acts = act_per_layer * (2 * layers if remat else 8 * layers)
+    return weights + grads + opt + acts
+
+
+class Autotuner:
+    """In-process candidate search (reference ``Autotuner`` surface)."""
+
+    def __init__(self, model_fn, base_config: Dict[str, Any],
+                 metric: str = "throughput"):
+        """``model_fn() -> model`` builds a fresh engine-protocol model."""
+        self.model_fn = model_fn
+        self.base_config = base_config
+        self.metric = metric
+        self.results: List[TuneResult] = []
+
+    # ------------------------------------------------------------------
+    def candidates(self, zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),
+                   mesh_shapes=None) -> List[Dict[str, Any]]:
+        import jax
+
+        n = jax.device_count()
+        if mesh_shapes is None:
+            mesh_shapes = [{"data": n}]
+        out = []
+        for z, mb, mesh in itertools.product(zero_stages, micro_batches, mesh_shapes):
+            cfg = dict(self.base_config)
+            cfg.pop("train_batch_size", None)
+            cfg["train_micro_batch_size_per_gpu"] = mb
+            cfg.setdefault("gradient_accumulation_steps", 1)
+            zo = dict(cfg.get("zero_optimization", {}))
+            zo["stage"] = z
+            cfg["zero_optimization"] = zo
+            cfg["mesh"] = mesh
+            out.append(cfg)
+        return out
+
+    def prune_by_memory(self, cfgs: List[Dict[str, Any]], model) -> List[Dict[str, Any]]:
+        import jax
+
+        mcfg = getattr(model, "config", None)
+        if mcfg is None:
+            return cfgs
+        kind = jax.devices()[0].device_kind
+        hbm = HBM_PER_CHIP.get(kind, 16e9) * 0.9
+        kept = []
+        for cfg in cfgs:
+            mesh = cfg.get("mesh", {})
+            mp = mesh.get("model", 1)
+            dp = max(1, jax.device_count() // max(
+                1, mp * mesh.get("pipe", 1) * mesh.get("seq", 1)))
+            need = estimate_memory_per_chip(
+                mcfg.num_parameters, cfg["zero_optimization"]["stage"], dp, mp,
+                cfg["train_micro_batch_size_per_gpu"], mcfg.max_seq_len,
+                mcfg.hidden_size, mcfg.num_layers, remat=mcfg.remat,
+            )
+            if need <= hbm:
+                kept.append(cfg)
+            else:
+                logger.info(f"pruned config (est {need/1e9:.1f}GB > {hbm/1e9:.1f}GB): "
+                            f"stage={cfg['zero_optimization']['stage']} "
+                            f"mb={cfg['train_micro_batch_size_per_gpu']}")
+        return kept
+
+    # ------------------------------------------------------------------
+    def _profile_one(self, cfg: Dict[str, Any], batch_fn, steps: int = 4) -> TuneResult:
+        import jax
+
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import topology as topo_mod
+
+        topo_mod.reset_topology()
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_fn(), config=cfg)
+            b = batch_fn(engine.train_micro_batch_size_per_gpu *
+                         engine.topology.data_parallel_size)
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            float(loss)  # compile + settle
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine(b)
+                engine.backward(loss)
+                engine.step()
+            loss = float(loss)
+            jax.block_until_ready(engine.params)
+            dt = (time.perf_counter() - t0) / steps
+            tput = engine.train_batch_size / dt
+            return TuneResult(cfg, tput, step_ms=dt * 1000)
+        except Exception as e:
+            return TuneResult(cfg, 0.0, error=str(e)[:200])
+        finally:
+            topo_mod.reset_topology()
+
+    def tune(self, batch_fn, zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),
+             mesh_shapes=None, max_trials: int = 16, steps: int = 4) -> TuneResult:
+        """Run the search; returns the best result (reference ``tune:404``).
+        ``batch_fn(global_batch_size) -> batch``."""
+        cfgs = self.candidates(zero_stages, micro_batches, mesh_shapes)
+        cfgs = self.prune_by_memory(cfgs, self.model_fn())[:max_trials]
+        if not cfgs:
+            raise RuntimeError("no candidate configs survive the memory model")
+        for cfg in cfgs:
+            r = self._profile_one(cfg, batch_fn, steps=steps)
+            self.results.append(r)
+            log_dist(
+                f"autotune: stage={cfg['zero_optimization']['stage']} "
+                f"mb={cfg['train_micro_batch_size_per_gpu']} mesh={cfg.get('mesh')} "
+                f"-> {r.throughput:.1f} samples/s"
+                + (f" (FAILED: {r.error})" if r.error else ""),
+                ranks=[0],
+            )
+        best = max(self.results, key=lambda r: r.throughput)
+        log_dist(f"autotune best: {best.config.get('zero_optimization')} "
+                 f"mb={best.config.get('train_micro_batch_size_per_gpu')} "
+                 f"@ {best.throughput:.1f} samples/s", ranks=[0])
+        return best

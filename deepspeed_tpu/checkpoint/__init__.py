@@ -1,0 +1,3 @@
+"""Checkpoint tools (reference deepspeed/checkpoint/)."""
+
+from .universal import ds_to_universal, load_universal_into_engine  # noqa: F401

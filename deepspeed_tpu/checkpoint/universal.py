@@ -1,0 +1,152 @@
+"""Universal (topology-independent) checkpoints.
+
+Reference: ``deepspeed/checkpoint/`` — ``ds_to_universal.py:314`` merges
+(tp, pp, dp)-sharded ZeRO shards into per-parameter files
+(zero/<name>/fp32.pt + exp_avg etc.), reloaded elastically via
+``universal_checkpoint.py:13 load_hp_checkpoint_state``.
+
+TPU design note (SURVEY §7.10): checkpoints here are ALREADY
+(param-name → full global array) because ``save_checkpoint`` gathers global
+jax.Arrays — sharding is a property of the runtime mesh, not of the file. So
+"conversion" flattens the pytree into one file per parameter (the reference's
+universal layout) and elastic reload is just load + re-shard under the new
+mesh. This is where the design pays off: no 3D reshape machinery is needed.
+"""
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+UNIVERSAL_DIRNAME = "zero"  # parity with reference layout
+
+
+def _leaf_items(tree, prefix=""):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        yield name, leaf
+
+
+def ds_to_universal(checkpoint_dir: str, output_dir: str, tag: Optional[str] = None):
+    """Convert an engine checkpoint into the universal per-parameter layout
+    (reference ``ds_to_universal.py:314 main``)."""
+    from ..runtime.checkpoint_engine.native_checkpoint_engine import NativeCheckpointEngine
+
+    eng = NativeCheckpointEngine()
+    if tag is None:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            tag = f.read().strip()
+    src = os.path.join(checkpoint_dir, str(tag))
+    model_sd = eng.load(os.path.join(src, "model_states.ckpt"))
+    optim_sd = None
+    opt_path = os.path.join(src, "optim_states.ckpt")
+    if os.path.exists(opt_path):
+        optim_sd = eng.load(opt_path)
+
+    zdir = os.path.join(output_dir, UNIVERSAL_DIRNAME)
+    os.makedirs(zdir, exist_ok=True)
+    index = {}
+    for name, leaf in _leaf_items(model_sd["module"]):
+        pdir = os.path.join(zdir, name.replace("/", "."))
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"), np.asarray(leaf, np.float32))
+        index[name] = {"shape": list(np.shape(leaf))}
+    if optim_sd is not None and "offload_host" in optim_sd:
+        logger.warning(
+            "ds_to_universal: checkpoint was saved with optimizer offload — "
+            "offloaded Adam moments are not converted; elastic reload will "
+            "reinitialize them"
+        )
+    if optim_sd is not None and optim_sd.get("m") is not None:
+        for kind, tree in (("exp_avg", optim_sd["m"]), ("exp_avg_sq", optim_sd["v"])):
+            for name, leaf in _leaf_items(tree):
+                pdir = os.path.join(zdir, name.replace("/", "."))
+                os.makedirs(pdir, exist_ok=True)
+                np.save(os.path.join(pdir, f"{kind}.npy"), np.asarray(leaf, np.float32))
+    meta = {
+        "index": index,
+        "step": int(model_sd.get("global_steps", 0)),
+        "global_samples": int(model_sd.get("global_samples", 0)),
+        "optimizer_step": None if optim_sd is None or optim_sd.get("step") is None
+        else int(np.asarray(optim_sd["step"])),
+        "ds_config_batch": model_sd.get("ds_config_batch"),
+        "lr_scheduler": model_sd.get("lr_scheduler"),
+        "scaler": None if optim_sd is None else optim_sd.get("scaler"),
+    }
+    with open(os.path.join(output_dir, "universal_meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    with open(os.path.join(output_dir, "latest_universal"), "w") as f:
+        f.write(UNIVERSAL_DIRNAME)
+    log_dist(f"universal checkpoint written to {output_dir} ({len(index)} params)",
+             ranks=[0])
+    return output_dir
+
+
+def load_universal_into_engine(engine, universal_dir: str):
+    """Elastic reload: re-shard per-parameter files under the engine's CURRENT
+    mesh (reference ``load_universal_checkpoint`` engine flag, ``engine.py:822``)."""
+    import jax
+    import jax.numpy as jnp
+
+    with open(os.path.join(universal_dir, "universal_meta.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    zdir = os.path.join(universal_dir, UNIVERSAL_DIRNAME)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(engine.params)
+    names = ["/".join(str(getattr(k, "key", k)) for k in p) for p, _ in flat]
+    shard_flat = jax.tree_util.tree_leaves(engine._param_shardings)
+    opt_shard_flat = jax.tree_util.tree_leaves(engine._opt_shardings)
+
+    new_params, new_master, new_m, new_v = [], [], [], []
+    have_moments = True
+    for i, name in enumerate(names):
+        pdir = os.path.join(zdir, name.replace("/", "."))
+        w = np.load(os.path.join(pdir, "fp32.npy"))
+        new_params.append(jax.device_put(
+            jnp.asarray(w, engine.compute_dtype), shard_flat[i]))
+        if engine._mixed:
+            new_master.append(jax.device_put(jnp.asarray(w, jnp.float32),
+                                             opt_shard_flat[i]))
+        m_path = os.path.join(pdir, "exp_avg.npy")
+        if os.path.exists(m_path):
+            new_m.append(jax.device_put(jnp.asarray(np.load(m_path)), opt_shard_flat[i]))
+            new_v.append(jax.device_put(
+                jnp.asarray(np.load(os.path.join(pdir, "exp_avg_sq.npy"))),
+                opt_shard_flat[i]))
+        else:
+            have_moments = False
+
+    engine.params = jax.tree_util.tree_unflatten(treedef, new_params)
+    if engine._mixed and new_master:
+        engine.master_params = jax.tree_util.tree_unflatten(treedef, new_master)
+    if engine.opt_state is not None and have_moments:
+        opt_step = meta.get("optimizer_step")
+        if opt_step is None:  # may legitimately be 0 — no falsy-or
+            opt_step = meta["step"]
+        engine.opt_state = engine.opt_state._replace(
+            step=jnp.asarray(opt_step, jnp.int32),
+            m=jax.tree_util.tree_unflatten(treedef, new_m),
+            v=jax.tree_util.tree_unflatten(treedef, new_v),
+        )
+    engine.global_steps = meta["step"]
+    engine.global_samples = meta.get("global_samples", 0)
+    sc = meta.get("scaler")
+    if sc is not None:
+        from ..runtime.fp16.loss_scaler import LossScalerState
+
+        engine.scaler_state = LossScalerState(
+            cur_scale=jnp.asarray(sc["cur_scale"], jnp.float32),
+            cur_hysteresis=jnp.asarray(sc["cur_hysteresis"], jnp.int32),
+            last_overflow_iter=jnp.asarray(sc["last_overflow_iter"], jnp.int32),
+            iter_=jnp.asarray(sc["iter_"], jnp.int32),
+        )
+    if meta.get("lr_scheduler") and engine.lr_scheduler is not None:
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    log_dist(f"universal checkpoint loaded from {universal_dir} "
+             f"(step {meta['step']}, new mesh {engine.topology.axis_sizes})", ranks=[0])

@@ -1,0 +1,3 @@
+"""Compression (reference deepspeed/compression/)."""
+
+from .compress import CompressionScheduler, compress_params, init_compression, redundancy_clean  # noqa: F401

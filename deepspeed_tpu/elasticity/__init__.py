@@ -1,0 +1,10 @@
+"""Elasticity (reference deepspeed/elasticity/)."""
+
+from .elasticity import (  # noqa: F401
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)

@@ -1,0 +1,125 @@
+"""Elastic training configuration.
+
+Reference: ``deepspeed/elasticity/elasticity.py`` — ``compute_elastic_config:233``
+with the v0.1 (``:83``) and v0.2 (``:126``) algorithms: find batch sizes built
+from the user's micro-batches whose valid chip-counts stay compatible as nodes
+join/leave, so the global batch is constant across restarts. Pure arithmetic —
+ported as semantics, not code. Chips replace GPUs; recovery itself rides the
+universal checkpoint (``deepspeed_tpu/checkpoint``).
+"""
+
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(micro_batches: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """All batch sizes ≤ max that are a micro-batch times a power-of-two GAS."""
+    candidates = set()
+    for mb in micro_batches:
+        gas = 1
+        while mb * gas <= max_acceptable_batch_size:
+            candidates.add(mb * gas)
+            gas *= 2
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts that evenly divide ``batch_size`` through some micro-batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_g = batch_size // mb
+        for g in range(1, max_g + 1):
+            if max_g % g == 0 and min_gpus <= g <= max_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,
+                             min_gpus=1, max_gpus=None) -> Tuple[List[int], int]:
+    """v0.1: the candidate batch size with the most valid chip counts wins."""
+    max_gpus = max_gpus or max_acceptable_batch_size
+    best = ([], 0)
+    for bs in get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size):
+        gpus = get_valid_gpus(bs, micro_batches, min_gpus, max_gpus)
+        if (len(gpus), bs) > (len(best[0]), best[1]):
+            best = (gpus, bs)
+    return best
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
+                             current_num_gpus, min_gpus=1, max_gpus=None,
+                             prefer_larger=True) -> Tuple[List[int], int, int]:
+    """v0.2: additionally returns the micro-batch to use at the current size."""
+    valid_gpus, final_batch = _get_compatible_gpus_v01(
+        micro_batches, max_acceptable_batch_size, min_gpus, max_gpus
+    )
+    if current_num_gpus not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} not in valid set {valid_gpus}"
+        )
+    candidates = [mb for mb in micro_batches
+                  if final_batch % (mb * current_num_gpus) == 0]
+    if not candidates:
+        raise ElasticityConfigError(
+            f"no micro-batch fits batch {final_batch} on {current_num_gpus} chips"
+        )
+    mb = max(candidates) if prefer_larger else min(candidates)
+    return valid_gpus, final_batch, mb
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Resolve the elastic batch plan from a ds_config (reference ``:233``)."""
+    elastic = ds_config.get("elasticity", {})
+    if not elastic.get("enabled", False):
+        raise ElasticityConfigError("elasticity block missing or disabled")
+    micro_batches = elastic.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = elastic.get("max_acceptable_batch_size", 10000)
+    min_gpus = elastic.get("min_gpus", 1)
+    max_gpus = elastic.get("max_gpus", 10000)
+    version = float(elastic.get("version", 0.2))
+    prefer_larger = elastic.get("prefer_larger_batch", True)
+
+    if version >= 0.2 and world_size > 0:
+        valid_gpus, final_batch, mb = _get_compatible_gpus_v02(
+            micro_batches, max_batch, world_size, min_gpus, max_gpus, prefer_larger
+        )
+        gas = final_batch // (mb * world_size)
+        logger.info(
+            f"elasticity v0.2: batch={final_batch} micro={mb} gas={gas} "
+            f"valid chip counts={valid_gpus}"
+        )
+        if return_microbatch:
+            return final_batch, valid_gpus, mb
+        return final_batch, valid_gpus
+    if return_microbatch:
+        raise ElasticityConfigError(
+            "return_microbatch requires elasticity version >= 0.2 and world_size > 0"
+        )
+    valid_gpus, final_batch = _get_compatible_gpus_v01(
+        micro_batches, max_batch, min_gpus, max_gpus
+    )
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid set {valid_gpus}"
+        )
+    return final_batch, valid_gpus

@@ -1,0 +1,3 @@
+"""Monitoring (reference deepspeed/monitor/)."""
+
+from .monitor import MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor  # noqa: F401

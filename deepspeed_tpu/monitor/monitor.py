@@ -1,0 +1,119 @@
+"""Metric event sinks.
+
+Reference: ``deepspeed/monitor/monitor.py`` — ``MonitorMaster:29`` fans out
+``write_events`` to TensorBoard / WandB / CSV sinks, rank-0 only. Event tuples
+are ``(label, value, step)``.
+"""
+
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class csvMonitor(Monitor):
+    """CSV file per metric label (reference ``csv_monitor.py``)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = getattr(config, "output_path", "") or "ds_logs"
+        self.job_name = getattr(config, "job_name", "DeepSpeedJobName")
+        self._files = {}
+
+    def _file(self, label: str):
+        if label not in self._files:
+            d = os.path.join(self.output_path, self.job_name)
+            os.makedirs(d, exist_ok=True)
+            safe = label.replace("/", "_")
+            f = open(os.path.join(d, f"{safe}.csv"), "a")
+            self._files[label] = f
+        return self._files[label]
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for label, value, step in events:
+            f = self._file(label)
+            f.write(f"{step},{float(value)}\n")
+            f.flush()
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(getattr(config, "output_path", "") or "runs",
+                                    getattr(config, "job_name", "ds"))
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception as e:  # pragma: no cover - tb optional
+                logger.warning(f"tensorboard unavailable ({e}); sink disabled")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled or self.writer is None:
+            return
+        for label, value, step in events:
+            self.writer.add_scalar(label, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=getattr(config, "project", None),
+                           group=getattr(config, "group", None),
+                           entity=getattr(config, "team", None))
+                self._wandb = wandb
+            except Exception as e:  # pragma: no cover - wandb optional
+                logger.warning(f"wandb unavailable ({e}); sink disabled")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for label, value, step in events:
+            self._wandb.log({label: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled sinks, lead-process only (reference ``monitor.py:29``)."""
+
+    def __init__(self, monitor_config):
+        cfg = monitor_config or {}
+        get = (lambda k: cfg.get(k)) if isinstance(cfg, dict) else (lambda k: getattr(cfg, k, None))
+        self.csv_monitor = csvMonitor(get("csv_monitor") or _Empty())
+        self.tb_monitor = TensorBoardMonitor(get("tensorboard") or _Empty())
+        self.wandb_monitor = WandbMonitor(get("wandb") or _Empty())
+        self.enabled = any(m.enabled for m in
+                           (self.csv_monitor, self.tb_monitor, self.wandb_monitor))
+
+    def write_events(self, events: List[Event]):
+        if jax.process_index() != 0 or not self.enabled:
+            return
+        for m in (self.csv_monitor, self.tb_monitor, self.wandb_monitor):
+            if m.enabled:
+                m.write_events(events)
+
+
+class _Empty:
+    enabled = False

@@ -1,0 +1,3 @@
+"""Quantizer ops (reference deepspeed/ops/quantizer + csrc/quantization)."""
+
+from .quantizer import dequantize, fake_quantize, quantize, quantized_all_gather, quantized_reduce_scatter  # noqa: F401

@@ -1,0 +1,98 @@
+"""Block quantization ops.
+
+Reference: ``csrc/quantization/{quantize.cu,dequantize.cu,fake_quantizer.cu,
+quant_reduce.cu}`` + ``deepspeed/ops/quantizer``. Symmetric/asymmetric N-bit
+block quantization used by ZeRO++ (qwZ weight all-gather, qgZ gradient
+all-to-all) and by compression/QAT fake-quant.
+
+XLA-native: these are bandwidth-bound elementwise ops that fuse into their
+producers/consumers; a Pallas variant only pays off fused into collective
+staging, so the jnp forms are the canonical implementation here.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _blocked(x, num_groups: int):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if n % num_groups:
+        raise ValueError(f"size {n} not divisible by {num_groups} groups")
+    return flat.reshape(num_groups, n // num_groups)
+
+
+def quantize(x, num_bits: int = 8, num_groups: int = 1,
+             symmetric: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Blockwise quantize → (int8 codes, scale (G,1), zero-point (G,1)).
+
+    Codes are stored in int8 regardless of num_bits (<=8): the range is
+    [-2^(b-1), 2^(b-1)-1] symmetric, [0, 2^b-1] asymmetric.
+    """
+    g = _blocked(x.astype(jnp.float32), num_groups)
+    if symmetric:
+        qmax = 2.0 ** (num_bits - 1) - 1
+        scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        codes = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax)
+        zero = jnp.zeros_like(scale)
+    else:
+        qmax = 2.0 ** num_bits - 1
+        lo = jnp.min(g, axis=-1, keepdims=True)
+        hi = jnp.max(g, axis=-1, keepdims=True)
+        scale = (hi - lo) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = lo
+        codes = jnp.clip(jnp.round((g - zero) / scale), 0, qmax)
+    return codes.astype(jnp.int8), scale, zero
+
+
+def dequantize(codes, scale, zero, orig_shape) -> jnp.ndarray:
+    g = codes.astype(jnp.float32) * scale + zero
+    return g.reshape(orig_shape)
+
+
+def fake_quantize(x, num_bits: int = 8, num_groups: int = 1, symmetric: bool = True):
+    """Quantize-dequantize with a straight-through estimator (QAT fake quant,
+    reference ``fake_quantizer.cu``)."""
+    codes, scale, zero = quantize(x, num_bits, num_groups, symmetric)
+    deq = dequantize(codes, scale, zero, x.shape).astype(x.dtype)
+    # STE: forward uses deq, gradient passes through unchanged
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+def quantized_all_gather(x, axis_name: str, num_bits: int = 8, num_groups: int = 16):
+    """qwZ-style collective: quantize → all_gather codes+scales → dequantize
+    (reference ``partition_parameters.py:728 CUDAQuantizer`` + gather path).
+    Call inside shard_map; cuts gather bytes ~4x for fp32 (8-bit codes)."""
+    codes, scale, zero = quantize(x, num_bits, num_groups)
+    codes_g = jax.lax.all_gather(codes, axis_name, axis=0, tiled=False)
+    scale_g = jax.lax.all_gather(scale, axis_name, axis=0, tiled=False)
+    zero_g = jax.lax.all_gather(zero, axis_name, axis=0, tiled=False)
+    n = codes_g.shape[0]
+    return jax.vmap(lambda c, s, z: dequantize(c, s, z, x.shape))(
+        codes_g, scale_g, zero_g
+    ).reshape((n,) + x.shape)
+
+
+def quantized_reduce_scatter(grad, axis_name: str, num_bits: int = 8,
+                             num_groups: int = 16):
+    """qgZ-style gradient reduction: quantize per rank, all-to-all codes,
+    dequantize + local sum (reference ``runtime/comm/coalesced_collectives.py``
+    ``all_to_all_quant_reduce``). Call inside shard_map over ``axis_name``; the
+    input's leading dim must equal the axis size (one chunk per destination)."""
+    n = jax.lax.axis_size(axis_name)
+    assert grad.shape[0] == n, "leading dim must equal axis size"
+
+    def q(chunk):
+        return quantize(chunk, num_bits, num_groups)
+
+    codes, scale, zero = jax.vmap(q)(grad)
+    codes = jax.lax.all_to_all(codes, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    scale = jax.lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    zero = jax.lax.all_to_all(zero, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    deq = jax.vmap(lambda c, s, z: dequantize(c, s, z, grad.shape[1:]))(codes, scale, zero)
+    return jnp.sum(deq, axis=0)

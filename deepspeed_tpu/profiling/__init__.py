@@ -1,0 +1,3 @@
+"""Profiling (reference deepspeed/profiling/)."""
+
+from .flops_profiler.profiler import FlopsProfiler, analyze_fn, get_model_profile, profile_engine_step  # noqa: F401

@@ -1,0 +1,1 @@
+from .profiler import FlopsProfiler, analyze_fn, get_model_profile, profile_engine_step  # noqa: F401

@@ -1,0 +1,11 @@
+"""Activation checkpointing (reference runtime/activation_checkpointing/)."""
+
+from .checkpointing import (  # noqa: F401
+    RNGStatesTracker,
+    checkpoint,
+    checkpoint_wrapped,
+    configure,
+    get_cuda_rng_tracker,
+    is_configured,
+    model_parallel_cuda_manual_seed,
+)

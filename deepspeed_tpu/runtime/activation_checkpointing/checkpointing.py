@@ -1,0 +1,138 @@
+"""Activation checkpointing API.
+
+Reference: ``runtime/activation_checkpointing/checkpointing.py`` — Megatron-style
+``checkpoint:989`` with activation partitioning (``partition_activations:373``),
+CPU checkpointing, contiguous buffers, and the ``CudaRNGStatesTracker:122``.
+
+TPU mapping: rematerialisation IS the mechanism (``jax.checkpoint``); XLA
+already never materialises what it can recompute, and ``partition_activations``
+becomes a saveable-filter policy + sharding constraint instead of manual
+scatter/gather. ``model_parallel_cuda_manual_seed`` becomes a named PRNG-key
+tracker (functional keys replace stateful CUDA RNG). CPU checkpointing maps to
+``jax.checkpoint`` with offload policies where supported; the knob is accepted
+and the nearest policy chosen.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+
+_config: Dict[str, Any] = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "num_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """reference ``configure:1070`` — record the knobs that select the policy."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if ac is not None:
+            _config["partition_activations"] = ac.partition_activations
+            _config["contiguous_memory_optimization"] = ac.contiguous_memory_optimization
+            _config["cpu_checkpointing"] = ac.cpu_checkpointing
+            _config["num_checkpoints"] = ac.number_checkpoints
+    for k, v in [("partition_activations", partition_activations),
+                 ("contiguous_memory_optimization", contiguous_checkpointing),
+                 ("num_checkpoints", num_checkpoints),
+                 ("cpu_checkpointing", checkpoint_in_cpu),
+                 ("synchronize", synchronize), ("profile", profile)]:
+        if v is not None:
+            _config[k] = v
+
+
+def is_configured() -> bool:
+    return True
+
+
+def _policy():
+    if _config["cpu_checkpointing"]:
+        try:  # offload saved residuals to host when the policy exists
+            return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host")
+        except Exception:  # pragma: no cover
+            logger.warning("cpu_checkpointing policy unavailable; using full remat")
+            return None
+    if _config["partition_activations"]:
+        # save nothing replicated: recompute everything except reductions
+        return jax.checkpoint_policies.nothing_saveable
+    return None
+
+
+def checkpoint(function: Callable, *args):
+    """Checkpoint a forward segment (reference ``checkpoint:989``)."""
+    pol = _policy()
+    fn = jax.checkpoint(function, policy=pol) if pol is not None else jax.checkpoint(function)
+    return fn(*args)
+
+
+def checkpoint_wrapped(function: Callable) -> Callable:
+    """Decorator form for building remat'd blocks."""
+    pol = _policy()
+    return jax.checkpoint(function, policy=pol) if pol is not None else jax.checkpoint(function)
+
+
+# ----------------------------------------------------------------------------
+# RNG tracking (reference CudaRNGStatesTracker:122 / model_parallel_cuda_manual_seed)
+# ----------------------------------------------------------------------------
+
+class RNGStatesTracker:
+    """Named functional PRNG keys (reference ``CudaRNGStatesTracker``). States
+    are jax keys — forking is explicit, which is what makes remat replay exact."""
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        """Return a fresh subkey from the named stream (advances the stream)."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:  # parity name
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """reference ``model_parallel_cuda_manual_seed``: seed a data-parallel and a
+    model-parallel stream offset by the model-parallel coordinate."""
+    from ...comm.topology import get_topology
+
+    topo = get_topology(required=False)
+    mp_rank = 0
+    if topo is not None:
+        try:
+            mp_rank = topo.coord_of_device(jax.devices()[0]).get("model", 0)
+        except Exception:
+            mp_rank = 0
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718 + mp_rank)
+    return seed

@@ -1,0 +1,78 @@
+"""Curriculum learning scheduler.
+
+Reference: ``runtime/data_pipeline/data_sampling/curriculum_scheduler.py``
+(fixed_linear / fixed_root / fixed_discrete schedules over a difficulty metric,
+e.g. sequence length) + engine hook injecting the current difficulty into the
+forward (``engine.py:1824-1837``).
+"""
+
+import math
+from typing import Any, Dict
+
+from ...utils.logging import logger
+
+CURRICULUM_LEARNING_MIN_DIFFICULTY = "min_difficulty"
+CURRICULUM_LEARNING_MAX_DIFFICULTY = "max_difficulty"
+CURRICULUM_LEARNING_SCHEDULE_TYPE = "schedule_type"
+CURRICULUM_LEARNING_SCHEDULE_CONFIG = "schedule_config"
+
+
+class CurriculumScheduler:
+    """reference ``CurriculumScheduler``: difficulty(step) per schedule type."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.state = {
+            "min_difficulty": config[CURRICULUM_LEARNING_MIN_DIFFICULTY],
+            "max_difficulty": config[CURRICULUM_LEARNING_MAX_DIFFICULTY],
+            "schedule_type": config[CURRICULUM_LEARNING_SCHEDULE_TYPE],
+            "schedule_config": dict(config.get(CURRICULUM_LEARNING_SCHEDULE_CONFIG, {})),
+            "current_difficulty": config[CURRICULUM_LEARNING_MIN_DIFFICULTY],
+        }
+        st = self.state["schedule_type"]
+        sc = self.state["schedule_config"]
+        if st in ("fixed_linear", "fixed_root"):
+            assert "total_curriculum_step" in sc, f"{st} needs total_curriculum_step"
+            assert "difficulty_step" in sc, f"{st} needs difficulty_step"
+            if st == "fixed_root":
+                sc.setdefault("root_degree", 2)
+        elif st == "fixed_discrete":
+            assert "difficulty" in sc and "max_step" in sc
+            assert len(sc["difficulty"]) == len(sc["max_step"]) + 1
+        else:
+            raise ValueError(f"unknown curriculum schedule_type {st}")
+
+    # ------------------------------------------------------------------
+    def _continuous(self, global_steps: int, root: float) -> int:
+        sc = self.state["schedule_config"]
+        frac = min(1.0, global_steps / sc["total_curriculum_step"])
+        frac = frac ** (1.0 / root)
+        span = self.state["max_difficulty"] - self.state["min_difficulty"]
+        diff = self.state["min_difficulty"] + span * frac
+        step_q = sc["difficulty_step"]
+        diff = int(diff / step_q) * step_q
+        return max(self.state["min_difficulty"], min(self.state["max_difficulty"], diff))
+
+    def get_difficulty(self, global_steps: int) -> int:
+        st = self.state["schedule_type"]
+        if st == "fixed_linear":
+            return self._continuous(global_steps, 1.0)
+        if st == "fixed_root":
+            return self._continuous(global_steps, self.state["schedule_config"]["root_degree"])
+        sc = self.state["schedule_config"]
+        for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+            if global_steps < max_step:
+                return diff
+        return sc["difficulty"][-1]
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, sd):
+        self.state.update(sd)

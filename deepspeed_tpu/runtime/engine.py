@@ -117,6 +117,11 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float32
         self._mixed = self.compute_dtype != jnp.float32
 
+        # ---- monitor (reference engine.py:252 MonitorMaster) ----
+        from ..monitor.monitor import MonitorMaster
+
+        self.monitor = MonitorMaster(config.monitor_config)
+
         # ---- timers ----
         self.wall_clock_breakdown = config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
@@ -130,6 +135,12 @@ class DeepSpeedEngine:
         params, apply_fn, tp_specs = self._extract_model(model, model_params)
         self._apply_fn = apply_fn
         self._tp_specs = tp_specs
+
+        # ---- compression (QAT): schedule-keyed jit variants so the schedule
+        # anneals rather than baking the trace-time state (compression/compress.py)
+        self._compression = getattr(model, "_compression_scheduler", None)
+        if self._compression is not None and hasattr(model, "_uncompressed_apply"):
+            self._apply_fn = model._uncompressed_apply
 
         # ---- sharding rules per ZeRO stage ----
         stage = config.zero_config.stage
@@ -256,23 +267,35 @@ class DeepSpeedEngine:
 
         base_rng = self._rng
 
-        def fwd_bwd(lp_params, batch, scale, step_idx):
-            # per-micro-step rng derived on device (no host-side split dispatch)
-            rng = jax.random.fold_in(base_rng, step_idx)
+        def make_fwd_bwd(comp_key):
+            """comp_key: None, or (active, bits) compression schedule state —
+            a new jit variant per state keeps the schedule effective under jit."""
 
-            def loss_fn(p):
-                out = apply_fn(p, batch, train=True, rng=rng)
-                loss = self._loss_of(out)
-                scaled = loss.astype(jnp.float32) * scale / gas
-                return scaled, loss
+            def fwd_bwd(lp_params, batch, scale, step_idx):
+                # per-micro-step rng derived on device (no host-side split dispatch)
+                rng = jax.random.fold_in(base_rng, step_idx)
 
-            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp_params)
-            return loss, grads
+                def loss_fn(p):
+                    if comp_key is not None and comp_key[0]:
+                        from ..compression.compress import compress_params
 
-        self._fwd_bwd = jax.jit(
-            fwd_bwd,
-            out_shardings=(self._replicated, self._grad_shardings),
-        )
+                        p = compress_params(p, self._compression, num_bits=comp_key[1])
+                    out = apply_fn(p, batch, train=True, rng=rng)
+                    loss = self._loss_of(out)
+                    scaled = loss.astype(jnp.float32) * scale / gas
+                    return scaled, loss
+
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp_params)
+                return loss, grads
+
+            return jax.jit(
+                fwd_bwd,
+                out_shardings=(self._replicated, self._grad_shardings),
+            )
+
+        self._make_fwd_bwd = make_fwd_bwd
+        self._fwd_bwd_variants = {}
+        self._fwd_bwd = make_fwd_bwd(None)
 
         def eval_loss(lp_params, batch):
             out = apply_fn(lp_params, batch, train=False, rng=None)
@@ -484,7 +507,13 @@ class DeepSpeedEngine:
             loss = self._eval_fn(self.params, batch)
             self.timers(FORWARD_MICRO_TIMER).stop()
             return loss
-        loss, grads = self._fwd_bwd(
+        fwd_bwd = self._fwd_bwd
+        if self._compression is not None:
+            key = (self._compression.active(), self._compression.weight_bits())
+            fwd_bwd = self._fwd_bwd_variants.get(key)
+            if fwd_bwd is None:
+                fwd_bwd = self._fwd_bwd_variants[key] = self._make_fwd_bwd(key)
+        loss, grads = fwd_bwd(
             self.params, batch, self.scaler_state.cur_scale,
             jnp.asarray(self.micro_steps, jnp.int32),
         )
@@ -546,6 +575,8 @@ class DeepSpeedEngine:
             self._acc_grads = None
             self.global_steps += 1
             self.global_samples += self.config.train_batch_size
+            if self._compression is not None:
+                self._compression.step()
             if overflow:
                 self.skipped_steps += 1
             elif self.lr_scheduler is not None:
@@ -573,6 +604,8 @@ class DeepSpeedEngine:
         self._last_global_norm = gnorm
         self.global_steps += 1
         self.global_samples += self.config.train_batch_size
+        if self._compression is not None:
+            self._compression.step()
         # only fp16 can overflow; bool(overflow) is a host sync — never pay it
         # on the bf16/fp32 paths (keeps the step loop free of round trips)
         if self.config.fp16_enabled and bool(overflow):
@@ -590,6 +623,17 @@ class DeepSpeedEngine:
                 f"grad_norm={float(gnorm):.4f} skipped={self.skipped_steps}",
                 ranks=[0],
             )
+        if self.monitor.enabled and jax.process_index() == 0:
+            # reference engine.py:2176-2197: lr / loss-scale / grad-norm events.
+            # float() is a device sync — pay it only at the print cadence
+            every = max(1, self.config.steps_per_print or 1)
+            if self.global_steps % every == 0:
+                self.monitor.write_events([
+                    ("Train/Samples/lr", float(self.get_lr()[0]), self.global_samples),
+                    ("Train/Samples/loss_scale", float(self.scaler_state.cur_scale),
+                     self.global_samples),
+                    ("Train/Samples/grad_norm", float(gnorm), self.global_samples),
+                ])
         self.timers(STEP_MICRO_TIMER).stop()
         if self.wall_clock_breakdown and self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
@@ -728,6 +772,13 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        if self.config.load_universal_checkpoint and os.path.exists(
+                os.path.join(load_dir, "universal_meta.pkl")):
+            from ..checkpoint.universal import load_universal_into_engine
+
+            load_universal_into_engine(self, load_dir)
+            self.loaded_checkpoint_tag = "universal"
+            return load_dir, {}
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.isfile(latest):

@@ -1,0 +1,77 @@
+"""Hybrid engine: one engine that trains AND generates (RLHF).
+
+Reference: ``runtime/hybrid_engine.py`` — ``DeepSpeedHybridEngine:32`` swaps
+inference containers in/out of the training module, fusing/unfusing LoRA and
+sharding for generation (``:84,280,306``), because CUDA training and inference
+kernels need different layouts.
+
+TPU: the functional design makes this nearly free — training lp params ARE the
+generation weights (same jax arrays, same sharding); ``generate`` compiles a
+decode program over ``self.params``, so post-step generations always see the
+newest weights with zero copying (the reference's ``generate:174`` after-step
+guarantee). No container swapping exists to port.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.engine import _sample_logits
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + in-place generation (reference ``DeepSpeedHybridEngine``)."""
+
+    def __init__(self, model, config, **kwargs):
+        super().__init__(model, config, **kwargs)
+        if not (hasattr(self.module, "forward_with_cache") and
+                hasattr(self.module, "init_kv_cache")):
+            raise ValueError("hybrid engine requires a model with KV-cache decode "
+                             "(TransformerLM protocol)")
+        self._gen_fns = {}
+
+    def _build_generate(self, S: int, max_new: int, temperature, top_k, top_p):
+        model = self.module
+
+        def gen(params, input_ids, rng, eos_id):
+            B = input_ids.shape[0]
+            cache = model.init_kv_cache(B, S + max_new, dtype=self.compute_dtype)
+            logits, cache = model.forward_with_cache(params, input_ids, cache, 0)
+            rng, sub = jax.random.split(rng)
+            tok = _sample_logits(logits.astype(jnp.float32), sub, temperature, top_k, top_p)
+            done = tok == eos_id
+
+            def step(carry, i):
+                cache, tok, rng, done = carry
+                rng, sub = jax.random.split(rng)
+                logits, cache = model.forward_with_cache(params, tok[:, None], cache, S + i)
+                nxt = _sample_logits(logits.astype(jnp.float32), sub,
+                                     temperature, top_k, top_p)
+                nxt = jnp.where(done, eos_id, nxt)
+                return (cache, nxt, rng, done | (nxt == eos_id)), tok
+
+            (cache, last, _, _), toks = jax.lax.scan(
+                step, (cache, tok, rng, done), jnp.arange(max_new - 1))
+            return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+        return jax.jit(gen)
+
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,
+                 seed: Optional[int] = None, **kwargs):
+        """Generate with the CURRENT training weights (reference ``generate:174``)."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        key = (input_ids.shape[1], max_new_tokens, float(temperature), int(top_k),
+               float(top_p))
+        if key not in self._gen_fns:
+            self._gen_fns[key] = self._build_generate(
+                input_ids.shape[1], max_new_tokens, temperature, top_k, top_p)
+        rng = jax.random.PRNGKey(self.global_steps if seed is None else seed)
+        return self._gen_fns[key](self.params, input_ids, rng,
+                                  jnp.asarray(eos_token_id, jnp.int32))
+
+    # reference surface: eval/train mode flips around generation phases
+    def eval(self):  # noqa: A003 - parity name
+        return super().eval()

@@ -1,0 +1,284 @@
+"""Aux subsystem tests: monitor, flops profiler, elasticity, compression,
+quantizer, curriculum, activation checkpointing, universal checkpoint, hybrid
+engine, autotuner (reference tests/unit/{monitor,elasticity,compression,...})."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+
+def tiny_model(**kw):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=32)
+    base.update(kw)
+    return TransformerLM(gpt2_config("125m", **base))
+
+
+def batch(B=8, S=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": jnp.asarray(rng.integers(0, 128, (B, S), dtype=np.int32))}
+
+
+class TestMonitor:
+    def test_csv_events_written(self, tmp_path):
+        from deepspeed_tpu.runtime.config import MonitorSinkConfig
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        cfg = {"csv_monitor": MonitorSinkConfig.from_dict(
+            {"enabled": True, "output_path": str(tmp_path), "job_name": "job"}),
+            "tensorboard": MonitorSinkConfig.from_dict({}),
+            "wandb": MonitorSinkConfig.from_dict({})}
+        mon = MonitorMaster(cfg)
+        assert mon.enabled
+        mon.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.2, 2)])
+        f = tmp_path / "job" / "Train_loss.csv"
+        assert f.exists() and len(f.read_text().strip().splitlines()) == 2
+
+    def test_engine_writes_events(self, tmp_path):
+        topo_mod.reset_topology()
+        cfg = {
+            "train_batch_size": 8,
+            "steps_per_print": 1,  # monitor writes at the print cadence
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                            "job_name": "t"},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+        b = batch()
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        assert (tmp_path / "t" / "Train_Samples_lr.csv").exists()
+
+
+class TestFlopsProfiler:
+    def test_xla_cost_analysis(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu.profiling import get_model_profile
+
+        m = tiny_model()
+        flops, macs, n_params = get_model_profile(m, batch(), print_profile=False)
+        # fwd flops should be near 2 * params * tokens (plus attention)
+        approx = 2 * m.config.num_parameters * 8 * 32
+        assert flops > 0.3 * approx
+        assert n_params == sum(p.size for p in jax.tree.leaves(
+            m.init_params(jax.random.PRNGKey(0))))
+
+
+class TestElasticity:
+    def test_compute_elastic_config(self):
+        from deepspeed_tpu.elasticity import compute_elastic_config
+
+        ds = {"elasticity": {"enabled": True, "micro_batch_sizes": [2, 4, 6],
+                             "max_acceptable_batch_size": 48, "version": 0.2}}
+        final, valid, mb = compute_elastic_config(ds, world_size=4,
+                                                  return_microbatch=True)
+        assert final % (mb * 4) == 0
+        assert 4 in valid
+
+    def test_incompatible_world_size(self):
+        from deepspeed_tpu.elasticity import (
+            ElasticityIncompatibleWorldSize, compute_elastic_config)
+
+        ds = {"elasticity": {"enabled": True, "micro_batch_sizes": [2],
+                             "max_acceptable_batch_size": 4, "version": 0.2}}
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(ds, world_size=3, return_microbatch=True)
+
+
+class TestQuantizer:
+    def test_roundtrip_error_bounded(self):
+        from deepspeed_tpu.ops.quantizer import dequantize, quantize
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+        codes, scale, zero = quantize(x, num_bits=8, num_groups=16)
+        deq = dequantize(codes, scale, zero, x.shape)
+        err = jnp.max(jnp.abs(deq - x))
+        assert float(err) < float(jnp.max(jnp.abs(x))) / 100  # ~1% of range
+
+    def test_fake_quant_ste_grads(self):
+        from deepspeed_tpu.ops.quantizer import fake_quantize
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, 32))
+        g = jax.grad(lambda x: jnp.sum(fake_quantize(x, 4, 4) ** 2))(x)
+        assert bool(jnp.all(jnp.isfinite(g))) and float(jnp.max(jnp.abs(g))) > 0
+
+    def test_quantized_collectives(self):
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=8)
+        from deepspeed_tpu.ops.quantizer import quantized_reduce_scatter
+        from jax.sharding import PartitionSpec as P
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 8, 128))
+
+        def body(x):
+            return quantized_reduce_scatter(x[0], "data", num_groups=8)
+
+        out = jax.shard_map(body, mesh=topo.mesh,
+                            in_specs=P("data"), out_specs=P("data"))(x)
+        ref = jnp.sum(x, axis=0)  # each rank's chunk summed across ranks
+        # int8 quantization error is bounded but nonzero
+        rel = float(jnp.max(jnp.abs(out.reshape(ref.shape) - ref)) /
+                    jnp.max(jnp.abs(ref)))
+        assert rel < 0.1
+        topo_mod.reset_topology()
+
+
+class TestCompression:
+    def test_qat_fake_quant_trains(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu.compression import init_compression
+
+        m = tiny_model()
+        comp_cfg = {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "quantization_type": "symmetric"},
+            "different_groups": {"g0": {"params": {"target_bits": 8, "start_bits": 8},
+                                        "quantize_groups": 1, "modules": ["*"]}},
+        }}
+        m, scheduler = init_compression(m, comp_cfg)
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "adamw", "params": {"lr": 2e-3}}}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+        b = batch()
+        losses = []
+        for _ in range(6):
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            scheduler.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+        cs = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        })
+        assert cs.get_difficulty(0) == 8
+        assert cs.get_difficulty(100) == 64
+        assert cs.get_difficulty(50) == 32
+
+    def test_fixed_discrete(self):
+        from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+        cs = CurriculumScheduler({
+            "min_difficulty": 2, "max_difficulty": 10,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [2, 6, 10], "max_step": [10, 20]},
+        })
+        assert cs.get_difficulty(5) == 2
+        assert cs.get_difficulty(15) == 6
+        assert cs.get_difficulty(25) == 10
+
+
+class TestActivationCheckpointing:
+    def test_checkpoint_matches_plain(self):
+        from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+        ac.configure(partition_activations=False)
+        f = lambda x: jnp.sum(jnp.tanh(x) ** 2)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+        g1 = jax.grad(f)(x)
+        g2 = jax.grad(lambda x: ac.checkpoint(f, x))(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+    def test_rng_tracker_fork(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import (
+            get_cuda_rng_tracker, model_parallel_cuda_manual_seed)
+
+        model_parallel_cuda_manual_seed(1234)
+        t = get_cuda_rng_tracker()
+        a, b = t.fork(), t.fork()
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestUniversalCheckpoint:
+    def test_convert_and_elastic_reload(self, tmp_path):
+        topo_mod.reset_topology()
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2},
+               "mesh": {"data": 8}}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+        b = batch()
+        for _ in range(3):
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+        ck = tmp_path / "ck"
+        uni = tmp_path / "uni"
+        engine.save_checkpoint(str(ck), tag="t")
+        from deepspeed_tpu.checkpoint import ds_to_universal
+
+        ds_to_universal(str(ck), str(uni), tag="t")
+        ref = jax.tree.leaves(engine.get_fp32_params())[0].copy()
+        ref_loss = float(engine({"input_ids": b["input_ids"]}))
+
+        # reload on a DIFFERENT topology (elastic: dp8 -> dp4 x tp2)
+        topo_mod.reset_topology()
+        cfg2 = dict(cfg)
+        cfg2["mesh"] = {"data": 4, "model": 2}
+        cfg2["checkpoint"] = {"load_universal": True}
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg2)
+        engine2.load_checkpoint(str(uni))
+        after = jax.tree.leaves(engine2.get_fp32_params())[0]
+        np.testing.assert_allclose(ref, after, atol=1e-6)
+        assert engine2.global_steps == engine.global_steps
+        loss2 = float(engine2({"input_ids": b["input_ids"]}))
+        assert abs(loss2 - ref_loss) < 1e-3
+
+
+class TestHybridEngine:
+    def test_train_then_generate(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+        import deepspeed_tpu.comm as comm
+
+        comm.init_distributed(mesh_config=cfg.mesh_config)
+        engine = DeepSpeedHybridEngine(tiny_model(), cfg)
+        b = batch()
+        out1 = np.asarray(engine.generate(b["input_ids"][:2, :8], max_new_tokens=4,
+                                          temperature=0.0))
+        loss = engine(b)
+        engine.backward(loss)
+        engine.step()
+        out2 = np.asarray(engine.generate(b["input_ids"][:2, :8], max_new_tokens=4,
+                                          temperature=0.0))
+        assert out1.shape == (2, 4)
+        # weights changed → generations generally change (not guaranteed, but
+        # with lr=1e-3 on random init the argmax shifts essentially always)
+        assert out1.shape == out2.shape
+
+
+class TestAutotuner:
+    def test_search_picks_runnable_config(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner(
+            model_fn=lambda: tiny_model(),
+            base_config={"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+        )
+        best = tuner.tune(
+            batch_fn=lambda B: batch(B=B),
+            zero_stages=(0, 2), micro_batches=(1, 2), steps=2,
+        )
+        assert best.throughput > 0
+        assert len(tuner.results) == 4
