@@ -39,7 +39,7 @@ def main():
 
     seq = 1024
     micro_bs = 8  # per chip
-    cfg = gpt2_config("350m", max_seq_len=seq, remat=True)
+    cfg = gpt2_config("350m", max_seq_len=seq, remat=True, remat_policy="dots")
     model = TransformerLM(cfg)
 
     ds_config = {
@@ -61,20 +61,22 @@ def main():
         for _ in range(8)
     ]
 
-    def step(b):
-        loss = engine(b)
-        engine.backward(loss)
-        engine.step()
-        return loss
+    def data_iter():
+        i = 0
+        while True:
+            yield batches[i % len(batches)]
+            i += 1
 
-    # warmup/compile (sync on the loss scalar)
-    float(step(batches[0]))
+    it = data_iter()
+    # warmup: first call compiles, second recompiles for donated-buffer layouts
+    for _ in range(3):
+        float(engine.train_batch(it))
 
     iters = 20
     t0 = time.perf_counter()
     loss = None
-    for i in range(iters):
-        loss = step(batches[i % len(batches)])
+    for _ in range(iters):
+        loss = engine.train_batch(it)
     loss = float(loss)
     jax.block_until_ready(engine.params)
     dt = time.perf_counter() - t0

@@ -61,7 +61,7 @@ class TransformerConfig:
     moe_aux_loss_coef: float = 0.01
     # training knobs
     remat: bool = False  # per-block activation rematerialisation
-    remat_policy: str = "full"  # "full" | "dots" (save matmul outputs)
+    remat_policy: str = "full"  # "full" (min memory) | "dots" (save matmul outputs, faster)
     param_dtype: Any = jnp.float32
     # fraction of attention logits softcapped (gemma-style); 0 = off
     logit_softcap: float = 0.0

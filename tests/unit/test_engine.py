@@ -225,13 +225,13 @@ def test_train_batch_advances_through_dataset():
         model=make_simple_model(HIDDEN), config=cfg, training_data=ds
     )
     seen = []
-    orig_forward = engine.forward
+    orig_shard = engine._shard_batch  # both the fused and the f/b/s path use it
 
     def spy(batch, **kw):
         seen.append(np.asarray(jax.device_get(batch[0]))[0, 0])
-        return orig_forward(batch, **kw)
+        return orig_shard(batch, **kw)
 
-    engine.forward = spy
+    engine._shard_batch = spy
     for _ in range(3):
         engine.train_batch()
     assert len(set(seen)) == 3  # three distinct batches
