@@ -51,6 +51,7 @@ class TransformerConfig:
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
     activation: str = "gelu"  # "gelu" | "swiglu"
     tie_embeddings: bool = True
+    qkv_bias: bool = False  # GPT-2-style biases on q/k/v projections
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     dropout: float = 0.0
@@ -273,7 +274,13 @@ class TransformerLM:
             blocks["ln2_bias"] = jnp.zeros((L, H), dt)
             blocks["attn_bias"] = jnp.zeros((L, H), dt)
             blocks["mlp_bias"] = jnp.zeros((L, H), dt)
+            if cfg.activation != "swiglu" and E == 0:
+                blocks["mlp_up_bias"] = jnp.zeros((L, I), dt)
             params["lnf_bias"] = jnp.zeros((H,), dt)
+        if cfg.qkv_bias:
+            blocks["wq_bias"] = jnp.zeros((L, nh * hd), dt)
+            blocks["wk_bias"] = jnp.zeros((L, kvh * hd), dt)
+            blocks["wv_bias"] = jnp.zeros((L, kvh * hd), dt)
         if cfg.pos_embedding == "learned":
             params["wpe"] = init(k[8], (cfg.max_seq_len, H), dt)
         if not cfg.tie_embeddings:
@@ -322,7 +329,13 @@ class TransformerLM:
             blocks["ln2_bias"] = P(None, None)
             blocks["attn_bias"] = P(None, None)
             blocks["mlp_bias"] = P(None, None)
+            if cfg.activation != "swiglu" and cfg.num_experts == 0:
+                blocks["mlp_up_bias"] = P(None, m)
             specs["lnf_bias"] = P(None)
+        if cfg.qkv_bias:
+            blocks["wq_bias"] = P(None, m)
+            blocks["wk_bias"] = P(None, m)
+            blocks["wv_bias"] = P(None, m)
         if cfg.pos_embedding == "learned":
             specs["wpe"] = P(None, None)
         if not cfg.tie_embeddings:
@@ -354,9 +367,16 @@ class TransformerLM:
         B, S, H = x.shape
 
         h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
-        q = (h @ blk["wq"].astype(h.dtype)).reshape(B, S, nh, hd)
-        kk = (h @ blk["wk"].astype(h.dtype)).reshape(B, S, kvh, hd)
-        v = (h @ blk["wv"].astype(h.dtype)).reshape(B, S, kvh, hd)
+        q = h @ blk["wq"].astype(h.dtype)
+        kk = h @ blk["wk"].astype(h.dtype)
+        v = h @ blk["wv"].astype(h.dtype)
+        if "wq_bias" in blk:
+            q = q + blk["wq_bias"].astype(h.dtype)
+            kk = kk + blk["wk_bias"].astype(h.dtype)
+            v = v + blk["wv_bias"].astype(h.dtype)
+        q = q.reshape(B, S, nh, hd)
+        kk = kk.reshape(B, S, kvh, hd)
+        v = v.reshape(B, S, kvh, hd)
         if cfg.pos_embedding == "rope":
             q, kk = _rope(q, kk, positions, hd, cfg.rope_theta)
 
@@ -399,7 +419,10 @@ class TransformerLM:
                 u = h @ blk["w_up"].astype(h.dtype)
                 inter = jax.nn.silu(g) * u
             else:
-                inter = jax.nn.gelu(h @ blk["w_up"].astype(h.dtype), approximate=True)
+                up = h @ blk["w_up"].astype(h.dtype)
+                if "mlp_up_bias" in blk:
+                    up = up + blk["mlp_up_bias"].astype(h.dtype)
+                inter = jax.nn.gelu(up, approximate=True)
             mlp_out = inter @ blk["w_down"].astype(h.dtype)
         if "mlp_bias" in blk:
             mlp_out = mlp_out + blk["mlp_bias"].astype(h.dtype)

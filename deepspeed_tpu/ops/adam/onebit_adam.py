@@ -1,0 +1,134 @@
+"""1-bit Adam / 0/1 Adam / 1-bit LAMB optimizers.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam,zoadam,lamb}.py`` — Adam with a
+``freeze_step`` warmup: full-precision Adam while the variance estimate settles,
+then the variance is FROZEN and only the (1-bit-compressible) momentum is
+communicated/updated. The compression itself lives in the engine's gradient
+path (``runtime/comm/compressed.py``); these classes implement the frozen-
+variance update rule on top of the standard optimizer protocol.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizers import FusedAdam, OptState
+
+
+class OnebitAdam(FusedAdam):
+    """reference ``onebit/adam.py OnebitAdam``: Adam until ``freeze_step``, then
+    momentum-SGD with the frozen ``sqrt(v)`` preconditioner."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 freeze_step: int = 100, bias_correction=True, adam_w_mode=True,
+                 cuda_aware=False, comm_backend_name="xla", **kw):
+        super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                         bias_correction=bias_correction, adam_w_mode=adam_w_mode)
+        self.freeze_step = freeze_step
+
+    def update(self, grads, state: OptState, master_params, lr, weight_decay_mask=None):
+        b1, b2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+        sf = jnp.asarray(step, jnp.float32)
+        bc1 = 1.0 - b1 ** sf if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** sf if self.bias_correction else 1.0
+        wd = self._wd_tree(master_params, weight_decay_mask)
+
+        def upd(p, g, m, v, w):
+            g = g.astype(jnp.float32)
+            if not self.adam_w_mode:
+                g = g + w * p
+            m_ = b1 * m + (1.0 - b1) * g
+            # variance updates stop once frozen (reference: v is exactly the
+            # freeze-step estimate thereafter, making the update linear in the
+            # gradient — the property that lets the momentum be sign-compressed)
+            v_ = jnp.where(frozen, v, b2 * v + (1.0 - b2) * (g * g))
+            denom = jnp.sqrt(v_ / bc2) + self.eps
+            new_p = p - lr * (m_ / bc1) / denom
+            if self.adam_w_mode:
+                new_p = new_p - lr * w * p
+            return new_p, m_, v_
+
+        flat = jax.tree.map(upd, master_params, grads, state.m, state.v, wd)
+        new_p = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_p, OptState(step=step, m=new_m, v=new_v)
+
+
+class ZeroOneAdam(OnebitAdam):
+    """reference ``onebit/zoadam.py``: 0/1 Adam — like 1-bit Adam with periodic
+    variance refresh instead of a hard freeze."""
+
+    def __init__(self, *args, var_update_scaler: int = 16, **kw):
+        kw.pop("var_freeze_step", None)
+        super().__init__(*args, **kw)
+        self.var_update_scaler = var_update_scaler
+
+    def update(self, grads, state, master_params, lr, weight_decay_mask=None):
+        b1, b2 = self.betas
+        step = state.step + 1
+        # refresh variance every var_update_scaler steps after freeze
+        refresh = (step % self.var_update_scaler) == 0
+        frozen = (step > self.freeze_step) & ~refresh
+        sf = jnp.asarray(step, jnp.float32)
+        bc1 = 1.0 - b1 ** sf if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** sf if self.bias_correction else 1.0
+        wd = self._wd_tree(master_params, weight_decay_mask)
+
+        def upd(p, g, m, v, w):
+            g = g.astype(jnp.float32)
+            if not self.adam_w_mode:
+                g = g + w * p
+            m_ = b1 * m + (1.0 - b1) * g
+            v_ = jnp.where(frozen, v, b2 * v + (1.0 - b2) * (g * g))
+            denom = jnp.sqrt(v_ / bc2) + self.eps
+            new_p = p - lr * (m_ / bc1) / denom
+            if self.adam_w_mode:
+                new_p = new_p - lr * w * p
+            return new_p, m_, v_
+
+        flat = jax.tree.map(upd, master_params, grads, state.m, state.v, wd)
+        new_p = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_p, OptState(step=step, m=new_m, v=new_v)
+
+
+class OnebitLamb(OnebitAdam):
+    """reference ``onebit/lamb.py``: 1-bit LAMB — frozen-variance Adam update
+    with a per-tensor trust ratio on the applied step."""
+
+    def __init__(self, *args, max_coeff=10.0, min_coeff=0.01, **kw):
+        super().__init__(*args, **kw)
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def update(self, grads, state, master_params, lr, weight_decay_mask=None):
+        b1, b2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+        sf = jnp.asarray(step, jnp.float32)
+        bc1 = 1.0 - b1 ** sf if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** sf if self.bias_correction else 1.0
+        wd = self._wd_tree(master_params, weight_decay_mask)
+
+        def upd(p, g, m, v, w):
+            g = g.astype(jnp.float32)
+            m_ = b1 * m + (1.0 - b1) * g
+            v_ = jnp.where(frozen, v, b2 * v + (1.0 - b2) * (g * g))
+            update = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.eps) + w * p
+            w_norm = jnp.linalg.norm(p.ravel())
+            u_norm = jnp.linalg.norm(update.ravel())
+            trust = jnp.where((w_norm > 0) & (u_norm > 0),
+                              jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                              1.0)
+            return p - lr * trust * update, m_, v_
+
+        flat = jax.tree.map(upd, master_params, grads, state.m, state.v, wd)
+        new_p = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_p, OptState(step=step, m=new_m, v=new_v)

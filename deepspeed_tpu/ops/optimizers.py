@@ -238,6 +238,13 @@ class SGD(Optimizer):
         return new_p, OptState(step=step, m=None, v=None)
 
 
+def _onebit_classes():
+    from .adam.onebit_adam import OnebitAdam, OnebitLamb, ZeroOneAdam
+
+    return {"onebitadam": OnebitAdam, "zerooneadam": ZeroOneAdam,
+            "onebitlamb": OnebitLamb}
+
+
 OPTIMIZER_CLASSES = {
     "adam": FusedAdam,
     "adamw": FusedAdam,
@@ -254,8 +261,13 @@ def build_optimizer(name: str, params_dict: Optional[dict] = None) -> Optimizer:
     name = name.lower()
     params = dict(params_dict or {})
     params.pop("torch_adam", None)  # reference-only knob
+    for k in ("cuda_aware", "comm_backend_name"):
+        params.pop(k, None)  # reference comm knobs; the XLA backend is implied
+    if name in ("onebitadam", "zerooneadam", "onebitlamb"):
+        return _onebit_classes()[name](**params)
     if name not in OPTIMIZER_CLASSES:
-        raise ValueError(f"unknown optimizer type '{name}' (known: {sorted(OPTIMIZER_CLASSES)})")
+        known = sorted(OPTIMIZER_CLASSES) + ["onebitadam", "onebitlamb", "zerooneadam"]
+        raise ValueError(f"unknown optimizer type '{name}' (known: {known})")
     cls = OPTIMIZER_CLASSES[name]
     if cls is FusedAdam:
         # reference semantics: "Adam" forces AdamW logic unless adam_w_mode is

@@ -1,0 +1,11 @@
+"""Sparse attention (reference deepspeed/ops/sparse_attention)."""
+
+from .sparse_self_attention import SparseSelfAttention, layout_to_bias  # noqa: F401
+from .sparsity_config import (  # noqa: F401
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)

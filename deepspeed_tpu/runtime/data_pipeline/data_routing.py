@@ -1,0 +1,70 @@
+"""Random layer-token-drop (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + the CUDA token_sort/gather/scatter kernels
+(``csrc/random_ltd``): middle layers process only a random, scheduled-size
+subset of tokens; dropped tokens bypass the layer via the residual stream.
+
+TPU notes: the kernel work (sort/gather/scatter) is ``jax.random.permutation``
++ ``take``/``scatter`` — XLA fuses these, so no Pallas kernel is warranted
+(SURVEY §2.3 row "Random-LTD kernels": "jnp.argsort/take — kernel likely
+unnecessary"). The kept-token count must be static per compiled program; the
+scheduler quantizes it (``reserved_length_increment``) so training sees few
+recompiles as the schedule anneals.
+"""
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_apply(layer_fn: Callable, x, keep: int, rng):
+    """Apply ``layer_fn`` to a random ``keep``-token subset of (B, S, H) x;
+    dropped tokens pass through unchanged (reference ``RandomLayerTokenDrop``)."""
+    B, S, H = x.shape
+    if keep >= S:
+        return layer_fn(x)
+    perm = jax.vmap(lambda r: jax.random.permutation(r, S))(
+        jax.random.split(rng, B))  # (B, S) independent per sample
+    kept_idx = jnp.sort(perm[:, :keep], axis=1)  # keep temporal order
+    gathered = jnp.take_along_axis(x, kept_idx[..., None], axis=1)  # (B, keep, H)
+    processed = layer_fn(gathered)
+    return jnp.array(x).at[
+        jnp.arange(B)[:, None], kept_idx
+    ].set(processed)
+
+
+class RandomLTDScheduler:
+    """reference ``runtime/data_pipeline/data_routing/scheduler.py``: linear
+    increase of the kept-token count from ``start`` to the full sequence."""
+
+    def __init__(self, total_layers: int, start_length: int, seq_length: int,
+                 schedule_steps: int, increment: int = 16,
+                 layers_skipped_at_ends: int = 1):
+        self.total_layers = total_layers
+        self.start = start_length
+        self.full = seq_length
+        self.steps = schedule_steps
+        self.increment = increment
+        self.skip_ends = layers_skipped_at_ends
+        self.current = start_length
+
+    def get_reserved_length(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(1, self.steps))
+        raw = self.start + (self.full - self.start) * frac
+        q = int(raw // self.increment) * self.increment
+        return min(self.full, max(self.start, q))
+
+    def update(self, global_step: int) -> int:
+        self.current = self.get_reserved_length(global_step)
+        return self.current
+
+    def applies_to_layer(self, layer_idx: int) -> bool:
+        return self.skip_ends <= layer_idx < self.total_layers - self.skip_ends
+
+    def state_dict(self) -> Dict:
+        return {"current": self.current}
+
+    def load_state_dict(self, sd: Dict):
+        self.current = sd["current"]

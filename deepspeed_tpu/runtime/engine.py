@@ -141,6 +141,12 @@ class DeepSpeedEngine:
         self._compression = getattr(model, "_compression_scheduler", None)
         if self._compression is not None and hasattr(model, "_uncompressed_apply"):
             self._apply_fn = model._uncompressed_apply
+        if self._compression is not None and config.optimizer_name in (
+                "onebitadam", "zerooneadam", "onebitlamb"):
+            raise ValueError(
+                "compression (QAT) and 1-bit optimizers cannot be combined: the "
+                "compressed-gradient path bypasses the QAT forward"
+            )
 
         # ---- sharding rules per ZeRO stage ----
         stage = config.zero_config.stage
@@ -382,6 +388,84 @@ class DeepSpeedEngine:
             self._fused_step_fn = None
 
     # ------------------------------------------------------------------
+    # 1-bit optimizers: error-feedback sign-compressed gradient allreduce
+    # (reference runtime/comm/nccl.py:52 + fp16/onebit/*; comm/compressed.py)
+    # ------------------------------------------------------------------
+    def _onebit_active(self) -> bool:
+        from ..ops.adam.onebit_adam import OnebitAdam
+
+        if not isinstance(self.optimizer, OnebitAdam):
+            return False
+        axes = tuple(a for a in ("data", "expert") if self.topology.get_dim(a) > 1)
+        if not axes or self.zero_stage > 1:
+            return False
+        # warmup phase communicates full-precision (reference freeze_step)
+        return self.global_steps >= self.optimizer.freeze_step
+
+    def _onebit_fwd_bwd(self, batch):
+        """Local grads under shard_map over the DP axes + EF 1-bit allreduce."""
+        from jax.sharding import PartitionSpec as P
+
+        from .comm.compressed import compressed_allreduce_tree
+
+        topo = self.topology
+        axes = tuple(a for a in ("data", "expert") if topo.get_dim(a) > 1)
+        dpn = int(np.prod([topo.get_dim(a) for a in axes]))
+
+        if getattr(self, "_onebit_fn", None) is None:
+            apply_fn = self._apply_fn
+            base_rng = self._rng
+            gas = getattr(self, "_gas_divisor", self.config.gradient_accumulation_steps)
+
+            def body(lp, batch_local, err_local, scale, step_idx):
+                rng = jax.random.fold_in(base_rng, step_idx)
+                err = jax.tree.map(lambda e: e[0], err_local)
+
+                def loss_fn(p):
+                    out = apply_fn(p, batch_local, train=True, rng=rng)
+                    loss = self._loss_of(out)
+                    return loss.astype(jnp.float32) * scale / gas, loss
+
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp)
+                # EF state must live in UNSCALED units: a loss-scale change
+                # between steps would otherwise re-inject the residual at the
+                # wrong magnitude. Unscale → compress → rescale for step_fn.
+                inv = 1.0 / scale
+                g_unscaled = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+                red, new_err = compressed_allreduce_tree(g_unscaled, err, axes)
+                red = jax.tree.map(lambda g: g * scale, red)
+                # an fp16 overflow would poison the residual with NaN/Inf
+                # forever (the step is skipped, the buffer is not) — sanitize
+                new_err = jax.tree.map(
+                    lambda e: jnp.where(jnp.isfinite(e), e, 0.0), new_err
+                )
+                new_err = jax.tree.map(lambda e: e[None], new_err)
+                return jax.lax.pmean(loss, axes), red, new_err
+
+            param_specs = jax.tree.map(lambda _: P(), self.params)
+            batch_spec_ = jax.tree.map(lambda _: P(axes), batch)
+            err_spec = jax.tree.map(lambda _: P(axes), self.params)
+            self._onebit_fn = jax.jit(jax.shard_map(
+                body, mesh=topo.mesh,
+                in_specs=(param_specs, batch_spec_, err_spec, P(), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P(), self.params), err_spec),
+                axis_names=set(axes),
+            ))
+        if getattr(self, "_ef_errors", None) is None:
+            self._ef_errors = jax.tree.map(
+                lambda p: jax.device_put(
+                    jnp.zeros((dpn,) + p.shape, jnp.float32),
+                    NamedSharding(topo.mesh, P(axes)),
+                ),
+                self.params,
+            )
+        loss, grads, self._ef_errors = self._onebit_fn(
+            self.params, batch, self._ef_errors, self.scaler_state.cur_scale,
+            jnp.asarray(self.micro_steps, jnp.int32),
+        )
+        return loss, grads
+
+    # ------------------------------------------------------------------
     # ZeRO-Offload / Offload++ / ZeRO-Infinity (reference stage_1_and_2.py
     # cpu_offload + swap_tensor NVMe tier; see zero/offload.py)
     # ------------------------------------------------------------------
@@ -544,10 +628,13 @@ class DeepSpeedEngine:
             fwd_bwd = self._fwd_bwd_variants.get(key)
             if fwd_bwd is None:
                 fwd_bwd = self._fwd_bwd_variants[key] = self._make_fwd_bwd(key)
-        loss, grads = fwd_bwd(
-            self.params, batch, self.scaler_state.cur_scale,
-            jnp.asarray(self.micro_steps, jnp.int32),
-        )
+        if self._onebit_active():
+            loss, grads = self._onebit_fwd_bwd(batch)
+        else:
+            loss, grads = fwd_bwd(
+                self.params, batch, self.scaler_state.cur_scale,
+                jnp.asarray(self.micro_steps, jnp.int32),
+            )
         self._cached = (loss, grads)
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
@@ -674,6 +761,7 @@ class DeepSpeedEngine:
         if (self.config.gradient_accumulation_steps == 1
                 and self._fused_step_fn is not None
                 and self._offload_mgr is None and self._compression is None
+                and not self._onebit_active()
                 and getattr(self, "_training", True)):
             loss = self._fused_micro_step(next(it))
             self.tput_timer.stop(global_step=True)
