@@ -60,6 +60,9 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # progressive layer drop (PLD): stochastic depth driven by a per-step theta
+    # injected as batch["pld_theta"] (reference progressive_layer_drop.py)
+    progressive_layer_drop: bool = False
     # training knobs
     remat: bool = False  # per-block activation rematerialisation
     remat_policy: str = "full"  # "full" (min memory) | "dots" (save matmul outputs, faster)
@@ -463,20 +466,34 @@ class TransformerLM:
             )
         return jax.checkpoint(fn)
 
-    def _trunk(self, params, x, positions, rng, train):
-        """Run all blocks via scan (remat optional)."""
+    def _trunk(self, params, x, positions, rng, train, pld_theta=None):
+        """Run all blocks via scan (remat optional). With ``pld_theta``
+        (progressive layer drop, reference ``progressive_layer_drop.py``),
+        layer l keeps with prob 1 - (l/L)(1 - theta) — deeper layers dropped more."""
         cfg = self.config
+        L = cfg.num_layers
+        use_pld = pld_theta is not None and train
+        use_rng = rng is not None and train and (cfg.dropout > 0 or use_pld)
 
-        if rng is not None and cfg.dropout > 0 and train:
-            rngs = jax.random.split(rng, cfg.num_layers)
+        if use_rng:
+            rngs = jax.random.split(rng, L)
 
             def body(h, layer):
-                blk, rsub = layer
-                y, _, aux = self._block(h, blk, positions=positions, rng=rsub, train=train)
+                blk, rsub, idx = layer
+                r_drop, r_pld = jax.random.split(rsub)
+                y, _, aux = self._block(h, blk, positions=positions,
+                                        rng=r_drop if cfg.dropout > 0 else None,
+                                        train=train)
+                if use_pld:
+                    keep_p = 1.0 - (idx.astype(jnp.float32) / L) * (1.0 - pld_theta)
+                    keep = jax.random.bernoulli(r_pld, keep_p)
+                    y = jnp.where(keep, y, h)
+                    aux = jnp.where(keep, aux, 0.0)
                 return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
-            x, auxes = jax.lax.scan(block_fn, x, (params["blocks"], rngs))
+            x, auxes = jax.lax.scan(
+                block_fn, x, (params["blocks"], rngs, jnp.arange(L)))
         else:
             def body(h, blk):
                 y, _, aux = self._block(h, blk, positions=positions, rng=None, train=train)
@@ -493,14 +510,15 @@ class TransformerLM:
         return x @ w.astype(x.dtype)  # (B,S,V)
 
     # ------------------------------------------------------------------
-    def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None):
+    def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None,
+                    pld_theta=None):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         dtype = jax.tree.leaves(params)[0].dtype
         x = self._embed(params, input_ids, positions, dtype)
         x = self._constraint(x, self._act_spec(True))
-        x, aux = self._trunk(params, x, positions, rng, train)
+        x, aux = self._trunk(params, x, positions, rng, train, pld_theta=pld_theta)
         return self._head(params, x), aux
 
     def logits(self, params, input_ids, positions=None, train=False, rng=None):
@@ -513,10 +531,13 @@ class TransformerLM:
         (shifted internally when absent; -100 = ignore), or a bare (B,S) array,
         or an (input_ids, labels) tuple.
         """
+        pld_theta = None
         if isinstance(batch, dict):
             input_ids = batch["input_ids"]
             labels = batch.get("labels")
             positions = batch.get("positions")
+            if self.config.progressive_layer_drop:
+                pld_theta = batch.get("pld_theta")
         elif isinstance(batch, (tuple, list)):
             input_ids, labels = batch
             positions = None
@@ -524,7 +545,7 @@ class TransformerLM:
             input_ids, labels, positions = batch, None, None
 
         lg, aux = self._logits_aux(params, input_ids, positions=positions,
-                                   train=train, rng=rng)
+                                   train=train, rng=rng, pld_theta=pld_theta)
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1

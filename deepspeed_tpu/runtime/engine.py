@@ -132,7 +132,20 @@ class DeepSpeedEngine:
 
         # ---- model params + apply fn ----
         self._rng = jax.random.PRNGKey(config.seed)
-        params, apply_fn, tp_specs = self._extract_model(model, model_params)
+        # zero.Init path: initialize INSIDE jit with sharded outputs so large
+        # models never materialize unsharded (reference zero.Init,
+        # partition_parameters.py:783); shapes come from eval_shape
+        sharded_init = (
+            model_params is None and not isinstance(model, tuple)
+            and not hasattr(model, "params") and hasattr(model, "init_params")
+        )
+        if sharded_init:
+            init_rng = jax.random.PRNGKey(0)
+            params = jax.eval_shape(model.init_params, init_rng)  # abstract
+            apply_fn = model.apply
+            tp_specs = getattr(model, "tp_specs", None)
+        else:
+            params, apply_fn, tp_specs = self._extract_model(model, model_params)
         self._apply_fn = apply_fn
         self._tp_specs = tp_specs
 
@@ -147,6 +160,20 @@ class DeepSpeedEngine:
                 "compression (QAT) and 1-bit optimizers cannot be combined: the "
                 "compressed-gradient path bypasses the QAT forward"
             )
+
+        # PLD needs BOTH the engine schedule and the model flag — catch the
+        # half-configured case instead of silently training without drop
+        pld_cfg = config.progressive_layer_drop
+        if pld_cfg and pld_cfg.get("enabled"):
+            mc = getattr(model, "config", None)
+            if (mc is not None and hasattr(mc, "progressive_layer_drop")
+                    and not mc.progressive_layer_drop):
+                raise ValueError(
+                    "progressive_layer_drop is enabled in the ds_config but the "
+                    "model was built without TransformerConfig("
+                    "progressive_layer_drop=True) — the injected theta would be "
+                    "silently ignored"
+                )
 
         # ---- sharding rules per ZeRO stage ----
         stage = config.zero_config.stage
@@ -165,17 +192,36 @@ class DeepSpeedEngine:
         self._replicated = NamedSharding(topo.mesh, PartitionSpec())
 
         # place lp params (compute dtype) and fp32 master
-        lp = jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), params)
-        self.params = jax.device_put(lp, self._param_shardings)
         off = config.zero_config.offload_optimizer
         self._offload_enabled = bool(
             off is not None and off.device in ("cpu", "nvme")
         )
-        if self._mixed and not self._offload_enabled:
-            master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
-            self.master_params = jax.device_put(master, self._opt_shardings)
+        if sharded_init:
+            from ..zero import sharded_dual_init
+
+            want_master = self._mixed or self._offload_enabled
+            self.params, master = sharded_dual_init(
+                model, init_rng, self.compute_dtype, self._param_shardings,
+                self._opt_shardings if want_master else None,
+            )
+            if self._mixed and not self._offload_enabled:
+                self.master_params = master
+            else:
+                self.master_params = None
+            if self._offload_enabled:
+                # offload manager needs concrete fp32 leaves on host — taken
+                # from the TRUE fp32 init, not a bf16 round trip
+                src = master if master is not None else self.params
+                params = jax.tree.map(lambda p: np.asarray(p, np.float32), src)
+                del master
         else:
-            self.master_params = None
+            lp = jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), params)
+            self.params = jax.device_put(lp, self._param_shardings)
+            if self._mixed and not self._offload_enabled:
+                master = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+                self.master_params = jax.device_put(master, self._opt_shardings)
+            else:
+                self.master_params = None
 
         # ---- optimizer ----
         self.client_optimizer = optimizer
@@ -606,6 +652,21 @@ class DeepSpeedEngine:
     def __call__(self, batch, **kwargs):
         return self.forward(batch, **kwargs)
 
+    def _inject_train_kwargs(self, batch):
+        """Curriculum/PLD injection (reference engine.py:1824-1837): adds the
+        per-step progressive-layer-drop theta to dict batches."""
+        pld = self.config.progressive_layer_drop
+        if (pld and pld.get("enabled") and isinstance(batch, dict)
+                and getattr(self, "_training", True)):
+            import math
+
+            theta = float(pld.get("theta", 0.5))
+            gamma = float(pld.get("gamma", 0.001))
+            theta_t = (1.0 - theta) * math.exp(-gamma * self.global_steps) + theta
+            batch = dict(batch)
+            batch["pld_theta"] = jnp.asarray(theta_t, jnp.float32)
+        return batch
+
     def forward(self, batch, **kwargs):
         """Compute loss AND cache gradients for the pending ``backward`` (see
         module docstring). Returns the unscaled loss (a replicated jax scalar).
@@ -617,7 +678,7 @@ class DeepSpeedEngine:
                 "inside `batch` (the apply_fn receives it whole)"
             )
         self.timers(FORWARD_MICRO_TIMER).start()
-        batch = self._shard_batch(batch)
+        batch = self._shard_batch(self._inject_train_kwargs(batch))
         if not getattr(self, "_training", True):
             loss = self._eval_fn(self.params, batch)
             self.timers(FORWARD_MICRO_TIMER).stop()
@@ -779,7 +840,7 @@ class DeepSpeedEngine:
     def _fused_micro_step(self, batch):
         """One fwd+bwd+optimizer step as a single compiled program (GAS=1 path)."""
         self.timers(STEP_MICRO_TIMER).start()
-        batch = self._shard_batch(batch)
+        batch = self._shard_batch(self._inject_train_kwargs(batch))
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         (new_lp, new_master, new_opt, new_scaler, loss, gnorm, overflow) = \
             self._fused_step_fn(

@@ -1,0 +1,35 @@
+"""Meta/abstract-device initialization context.
+
+Reference: ``deepspeed/utils/init_on_device.py:12 OnDevice`` — constructs a
+module with meta tensors (shapes only) so huge models can be described without
+allocating. JAX equivalent: ``jax.eval_shape`` over the initializer; this class
+wraps it in the reference's context-manager shape.
+"""
+
+from typing import Any
+
+import jax
+
+
+class OnDevice:
+    """``with OnDevice(): shapes = OnDevice.shape_of(model)``
+
+    The context itself is a compatibility shim (functional init has no global
+    allocation state to patch); ``shape_of`` is the meta-device mechanism."""
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @staticmethod
+    def shape_of(model, rng=None) -> Any:
+        """Abstract (ShapeDtypeStruct) parameter pytree — no allocation."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return jax.eval_shape(model.init_params, rng)

@@ -1,0 +1,87 @@
+"""Debug access to full-precision parameter/gradient/optimizer state.
+
+Reference: ``deepspeed/utils/tensor_fragment.py`` — ``safe_get_full_fp32_param:123``,
+``safe_get_full_grad:190``, ``safe_get_full_optimizer_state``, and the
+``safe_set_*`` writers: they reassemble a full tensor from the lp→hp fragment
+mapping ZeRO scatters across ranks.
+
+TPU: shards are mesh-placement, not rank-private buffers, so "reassemble" is
+``jax.device_get`` of the global array — these helpers are thin, but the API
+matters for porting reference debugging/telemetry code. Lookup is by pytree
+path string (e.g. ``"blocks/wq"``) since functional params have no module attrs.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def _find(tree, name: str):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    for i, (path, leaf) in enumerate(flat):
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        if p == name:
+            return i, leaf, flat, treedef
+    raise KeyError(f"no parameter at path '{name}' "
+                   f"(known: {['/'.join(str(getattr(k, 'key', k)) for k in p) for p, _ in flat][:8]}...)")
+
+
+def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
+    """reference ``:123`` — full fp32 master value of ONE parameter (only that
+    leaf is transferred, not the whole tree)."""
+    if engine._offload_mgr is not None:
+        src = engine._offload_master_tree()
+    elif engine._mixed and engine.master_params is not None:
+        src = engine.master_params
+    else:
+        src = engine.params
+    _, leaf, _, _ = _find(src, name)
+    if isinstance(leaf, np.ndarray):
+        return np.asarray(leaf, np.float32)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """reference ``:190`` — full UNSCALED gradient from the current
+    accumulation buffer (the buffer holds loss-scale-multiplied grads)."""
+    if engine._acc_grads is None:
+        return None
+    _, leaf, _, _ = _find(engine._acc_grads, name)
+    inv = 1.0 / float(engine.scaler_state.cur_scale)
+    return np.asarray(jax.device_get(leaf), np.float32) * inv
+
+
+def safe_get_full_optimizer_state(engine, name: str, state_key: str) -> Optional[np.ndarray]:
+    """reference ``safe_get_full_optimizer_state`` — 'exp_avg' / 'exp_avg_sq'."""
+    if engine.opt_state is None:
+        return None
+    tree = {"exp_avg": engine.opt_state.m, "exp_avg_sq": engine.opt_state.v}[state_key]
+    if tree is None:
+        return None
+    _, leaf, _, _ = _find(tree, name)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> None:
+    """reference ``safe_set_full_fp32_param`` — overwrite one master parameter
+    (and its lp copy), preserving shardings."""
+    import jax.numpy as jnp
+
+    target = engine.master_params if engine._mixed else engine.params
+    if target is None:
+        raise RuntimeError("no master params resident (offload?); use the offload API")
+    i, leaf, flat, treedef = _find(target, name)
+    leaves = [l for _, l in flat]
+    leaves[i] = jax.device_put(jnp.asarray(value, leaf.dtype), leaf.sharding)
+    new_tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    if engine._mixed:
+        engine.master_params = new_tree
+        # refresh the lp copy of that leaf
+        iL, leafL, flatL, treedefL = _find(engine.params, name)
+        leavesL = [l for _, l in flatL]
+        leavesL[iL] = jax.device_put(
+            jnp.asarray(value, engine.compute_dtype), leafL.sharding)
+        engine.params = jax.tree_util.tree_unflatten(treedefL, leavesL)
+    else:
+        engine.params = new_tree

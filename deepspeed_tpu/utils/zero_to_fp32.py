@@ -1,0 +1,81 @@
+"""Offline ZeRO-checkpoint → consolidated fp32 state dict.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (``:474
+get_fp32_state_dict_from_zero_checkpoint``, ``:524
+convert_zero_checkpoint_to_fp32_state_dict``) — stitches per-rank ZeRO shards
+back into full fp32 tensors.
+
+Here checkpoints already store full global arrays (the sharding lives in the
+runtime mesh, not the file), so "consolidation" is a load + flatten; the CLI
+surface is kept so reference workflows (`python -m deepspeed_tpu.utils.zero_to_fp32
+ckpt_dir out.npz`) port unchanged.
+"""
+
+import argparse
+import os
+from typing import Dict
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag=None) -> Dict[str, np.ndarray]:
+    """reference ``:474`` — returns {param_name: fp32 ndarray}."""
+    from ..runtime.checkpoint_engine.native_checkpoint_engine import NativeCheckpointEngine
+
+    if tag is None:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            tag = f.read().strip()
+    sd = NativeCheckpointEngine().load(
+        os.path.join(checkpoint_dir, str(tag), "model_states.ckpt"))
+    out = {}
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, f"{prefix}.{k}" if prefix else str(k))
+        elif isinstance(tree, (list, tuple)):
+            for i, v in enumerate(tree):
+                walk(v, f"{prefix}.{i}")
+        elif hasattr(tree, "shape"):
+            out[prefix] = np.asarray(tree, np.float32)
+
+    walk(sd["module"])
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag=None):
+    """reference ``:524`` — writes a single consolidated .npz."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    print(f"saved {len(sd)} fp32 tensors to {output_file}")
+    return output_file
+
+
+def load_state_dict_from_zero_checkpoint(model_params, checkpoint_dir: str, tag=None):
+    """reference ``load_state_dict_from_zero_checkpoint``: return a params
+    pytree with the checkpoint's fp32 values (matched by flattened path)."""
+    import jax
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(model_params)
+    leaves = []
+    for path, leaf in flat:
+        name = ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        if name not in sd:
+            raise KeyError(f"checkpoint missing parameter '{name}'")
+        leaves.append(sd[name])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    a = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(a.checkpoint_dir, a.output_file, a.tag)
+
+
+if __name__ == "__main__":
+    main()

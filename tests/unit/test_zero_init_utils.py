@@ -1,0 +1,137 @@
+"""Tests: zero.Init sharded initialization, PLD, tensor_fragment, zero_to_fp32,
+OnDevice (reference tests/unit/runtime/zero/test_zero_context.py + utils tests)."""
+
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+
+def tiny_model(**kw):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=32)
+    base.update(kw)
+    return TransformerLM(gpt2_config("125m", **base))
+
+
+def batch(B=8):
+    rng = np.random.default_rng(0)
+    return {"input_ids": jnp.asarray(rng.integers(0, 128, (B, 32), dtype=np.int32))}
+
+
+class TestZeroInit:
+    def test_stage3_params_born_sharded(self):
+        topo_mod.reset_topology()
+        # leaves must exceed param_persistence_threshold to be stage-3 sharded
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=tiny_model(vocab_size=512, hidden_size=256), config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}, "mesh": {"data": 8}})
+        wte = engine.params["wte"]
+        assert not wte.sharding.is_fully_replicated
+        # and the engine still trains
+        l = engine(batch())
+        engine.backward(l)
+        engine.step()
+        assert jnp.isfinite(l)
+
+    def test_zero_init_context_api(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu import zero
+
+        m = tiny_model()
+        with zero.Init(dtype=jnp.bfloat16):
+            assert zero.is_zero_init_active()
+            p = zero.initialize_params(m, jax.random.PRNGKey(0), stage=3)
+        assert not zero.is_zero_init_active()
+        leaf = jax.tree.leaves(p)[0]
+        assert leaf.dtype == jnp.bfloat16
+
+    def test_sharded_init_matches_host_init(self):
+        topo_mod.reset_topology()
+        m = tiny_model()
+        ref = m.init_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_batch_size": 8, "optimizer": {"type": "sgd", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3}, "mesh": {"data": 8}})
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(engine.params["wte"])),
+            np.asarray(ref["wte"]), rtol=1e-6)
+
+
+class TestPLD:
+    def test_pld_trains_and_eval_deterministic(self):
+        topo_mod.reset_topology()
+        m = tiny_model(num_layers=4, progressive_layer_drop=True)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_batch_size": 8, "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.001}})
+        b = batch()
+        losses = []
+        for _ in range(5):
+            l = engine(b)
+            engine.backward(l)
+            engine.step()
+            losses.append(float(l))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+        engine.eval()
+        assert float(engine(b)) == float(engine(b))
+
+
+class TestParityUtils:
+    def test_tensor_fragment_api(self):
+        topo_mod.reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config={
+            "train_batch_size": 8, "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2}})
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param, safe_get_full_grad,
+            safe_get_full_optimizer_state, safe_set_full_fp32_param)
+
+        l = engine(batch())
+        engine.backward(l)
+        assert safe_get_full_grad(engine, "blocks/wq") is not None
+        engine.step()
+        assert safe_get_full_fp32_param(engine, "wte").shape == (128, 64)
+        assert safe_get_full_optimizer_state(engine, "wte", "exp_avg") is not None
+        new = np.zeros((128, 64), np.float32)
+        safe_set_full_fp32_param(engine, "wte", new)
+        np.testing.assert_allclose(safe_get_full_fp32_param(engine, "wte"), 0.0)
+
+    def test_zero_to_fp32_roundtrip(self, tmp_path):
+        topo_mod.reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config={
+            "train_batch_size": 8, "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2}})
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        from deepspeed_tpu.utils.zero_to_fp32 import (
+            convert_zero_checkpoint_to_fp32_state_dict,
+            get_fp32_state_dict_from_zero_checkpoint,
+            load_state_dict_from_zero_checkpoint)
+
+        sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path), "t")
+        assert "wte" in sd
+        np.testing.assert_allclose(
+            sd["wte"], np.asarray(jax.device_get(engine.params["wte"]), np.float32),
+            rtol=1e-6)
+        out = convert_zero_checkpoint_to_fp32_state_dict(
+            str(tmp_path), str(tmp_path / "out.npz"), "t")
+        assert (tmp_path / "out.npz").exists()
+        ref = tiny_model().init_params(jax.random.PRNGKey(0))
+        loaded = load_state_dict_from_zero_checkpoint(ref, str(tmp_path), "t")
+        assert jax.tree.structure(loaded) == jax.tree.structure(ref)
+
+    def test_on_device_meta(self):
+        from deepspeed_tpu.utils.init_on_device import OnDevice
+
+        m = tiny_model()
+        with OnDevice(device="meta"):
+            shapes = OnDevice.shape_of(m)
+        leaf = jax.tree.leaves(shapes)[0]
+        assert hasattr(leaf, "shape") and not hasattr(leaf, "device")
