@@ -1,3 +1,5 @@
 """Data efficiency (reference deepspeed/runtime/data_pipeline/)."""
 
 from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_routing import RandomLTDScheduler, random_ltd_apply  # noqa: F401
+from .data_sampling import DataAnalyzer, DeepSpeedDataSampler  # noqa: F401

@@ -1,0 +1,134 @@
+"""Curriculum data sampling.
+
+Reference: ``runtime/data_pipeline/data_sampling/`` — ``data_analyzer.py`` (828
+LoC: offline per-sample difficulty metrics, mmap index files) and
+``data_sampler.py:349 DeepSpeedDataSampler`` (difficulty-indexed curriculum
+sampler: at each step only samples whose difficulty ≤ the scheduler's current
+value are drawn).
+
+Lite re-design: the analyzer computes named metrics (built-in: sequence length,
+vocabulary rarity) into a numpy index; the sampler filters by the curriculum
+scheduler's difficulty each epoch segment and yields index batches for the
+dataloader. The mmap-backed ``indexed_dataset`` machinery is unnecessary —
+numpy arrays on the host fill that role.
+"""
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DataAnalyzer:
+    """Offline per-sample difficulty metrics (reference ``data_analyzer.py``)."""
+
+    BUILTIN = ("seqlen", "vocab_rarity")
+
+    def __init__(self, dataset: Sequence, metric_fns: Optional[Dict[str, Callable]] = None):
+        self.dataset = dataset
+        self.metric_fns = dict(metric_fns or {})
+
+    def _seqlen(self, sample) -> float:
+        ids = sample["input_ids"] if isinstance(sample, dict) else sample[0]
+        return float(np.asarray(ids).shape[-1] if np.asarray(ids).ndim else 1)
+
+    def _vocab_rarity(self, sample, freq: np.ndarray) -> float:
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict) else sample[0])
+        return float(-np.log(freq[ids.reshape(-1)] + 1e-12).mean())
+
+    def run(self, metrics: Sequence[str] = ("seqlen",)) -> Dict[str, np.ndarray]:
+        """Compute metric arrays indexed by sample position."""
+        out = {}
+        freq = None
+        needs_freq = "vocab_rarity" in metrics and "vocab_rarity" not in self.metric_fns
+        if needs_freq and len(self.dataset):
+            all_ids = np.concatenate([
+                np.asarray(s["input_ids"] if isinstance(s, dict) else s[0]).reshape(-1)
+                for s in self.dataset
+            ])
+            counts = np.bincount(all_ids)
+            freq = counts / max(1, all_ids.size)
+        elif needs_freq:
+            freq = np.zeros(1, np.float64)
+        for m in metrics:
+            if m in self.metric_fns:
+                vals = [self.metric_fns[m](s) for s in self.dataset]
+            elif m == "seqlen":
+                vals = [self._seqlen(s) for s in self.dataset]
+            elif m == "vocab_rarity":
+                vals = [self._vocab_rarity(s, freq) for s in self.dataset]
+            else:
+                raise ValueError(f"unknown metric '{m}' (builtin: {self.BUILTIN})")
+            out[m] = np.asarray(vals)
+        return out
+
+    def save(self, metrics: Dict[str, np.ndarray], path: str):
+        np.savez(path, **metrics)
+
+    @staticmethod
+    def load(path: str) -> Dict[str, np.ndarray]:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+
+class DeepSpeedDataSampler:
+    """Difficulty-gated batch sampler (reference ``data_sampler.py:349``).
+
+    Yields lists of dataset indices; only samples whose metric value is within
+    the scheduler's current difficulty are eligible. Deterministic per
+    (seed, epoch); difficulty advances with ``set_step``.
+    """
+
+    def __init__(self, difficulties: np.ndarray, scheduler: CurriculumScheduler,
+                 batch_size: int, seed: int = 0, drop_last: bool = True,
+                 data_parallel_rank: int = 0, data_parallel_size: int = 1):
+        self.difficulties = np.asarray(difficulties)
+        self.scheduler = scheduler
+        self.batch_size = batch_size  # GLOBAL batch; each rank gets its slice
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.global_step = 0
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        if batch_size % data_parallel_size:
+            raise ValueError(f"batch_size {batch_size} not divisible by "
+                             f"data_parallel_size {data_parallel_size}")
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def set_step(self, global_step: int):
+        self.global_step = global_step
+
+    def eligible_indices(self) -> np.ndarray:
+        cutoff = self.scheduler.get_difficulty(self.global_step)
+        idx = np.nonzero(self.difficulties <= cutoff)[0]
+        if idx.size == 0:  # always serve something: the easiest samples
+            k = max(1, self.batch_size)
+            idx = np.argsort(self.difficulties)[:k]
+        return idx
+
+    def __iter__(self) -> Iterator[List[int]]:
+        """Yields this rank's slice of each global batch. Difficulty is read
+        from the step set via ``set_step`` — the caller advances it at
+        optimizer-step rate (yielding does NOT mutate sampler state, so
+        multiprocess loader workers stay consistent)."""
+        rng = np.random.default_rng(self.seed + self.epoch)
+        idx = self.eligible_indices()
+        perm = rng.permutation(idx)
+        per_rank = self.batch_size // self.dp_size
+        n_full = len(perm) // self.batch_size
+        for b in range(n_full):
+            g = perm[b * self.batch_size:(b + 1) * self.batch_size]
+            yield g[self.dp_rank * per_rank:(self.dp_rank + 1) * per_rank].tolist()
+        if not self.drop_last and len(perm) % self.batch_size >= self.dp_size:
+            rest = perm[n_full * self.batch_size:]
+            n = (len(rest) // self.dp_size) * self.dp_size
+            rest = rest[:n]
+            yield rest[self.dp_rank::self.dp_size].tolist()
+
+    def __len__(self):
+        n = len(self.eligible_indices())
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)

@@ -282,3 +282,31 @@ class TestAutotuner:
         )
         assert best.throughput > 0
         assert len(tuner.results) == 4
+
+
+class TestDataSampling:
+    def test_analyzer_metrics(self):
+        from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+
+        rng = np.random.default_rng(0)
+        ds = [{"input_ids": rng.integers(0, 50, (int(l),))} for l in [4, 8, 16, 32]]
+        m = DataAnalyzer(ds).run(metrics=("seqlen", "vocab_rarity"))
+        assert list(m["seqlen"]) == [4, 8, 16, 32]
+        assert np.isfinite(m["vocab_rarity"]).all()
+
+    def test_curriculum_sampler_gates_difficulty(self):
+        from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                         DeepSpeedDataSampler)
+
+        lens = np.array([8] * 10 + [64] * 10)
+        sched = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        sampler = DeepSpeedDataSampler(lens, sched, batch_size=4, seed=0)
+        sampler.set_step(0)
+        first = next(iter(sampler))
+        assert all(lens[i] == 8 for i in first)  # early: only easy samples
+        sampler.set_step(100)
+        idx = sampler.eligible_indices()
+        assert len(idx) == 20  # late: everything eligible
