@@ -60,6 +60,29 @@ def initialize(
             "mpu argument is accepted for parity but ignored: tensor parallelism is "
             "configured via the 'mesh' config block on TPU"
         )
+    # ZeRO++ hpZ / MiCS secondary partition becomes the `hpz` mesh axis
+    zc = ds_config.zero_config
+    hpz = max(zc.zero_hpz_partition_size,
+              zc.mics_shard_size if zc.mics_shard_size and zc.mics_shard_size > 0 else 1)
+    if hpz > 1 and zc.stage < 3:
+        logger.warning(
+            f"zero_hpz_partition_size/mics_shard_size={hpz} only applies at ZeRO "
+            f"stage 3 (got stage {zc.stage}); ignoring — parity with reference")
+        hpz = 1
+    mc = ds_config.mesh_config
+    if hpz > 1 and mc.hpz != 1 and mc.hpz != hpz:
+        raise ValueError(
+            f"mesh.hpz={mc.hpz} conflicts with zero_hpz_partition_size/"
+            f"mics_shard_size={hpz}")
+    if hpz > 1 and mc.hpz == 1:
+        if mc.data:
+            if mc.data % hpz:
+                raise ValueError(
+                    f"zero_hpz_partition_size/mics_shard_size {hpz} does not "
+                    f"divide mesh.data {mc.data}")
+            mc.data //= hpz
+        mc.hpz = hpz
+
     comm.init_distributed(mesh_config=ds_config.mesh_config)
     comm.configure(config=ds_config)
 

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..utils.logging import logger
 
-MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+MESH_AXES = ("pipe", "data", "hpz", "expert", "seq", "model")
 
 # sharding-rule aliases
 PIPE_AXIS = "pipe"
@@ -28,8 +28,13 @@ DATA_AXIS = "data"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-# ZeRO shards parameters/optimizer state over the full DP degree = data×expert
-ZERO_AXES = ("data", "expert")
+# ZeRO shards gradients/optimizer state over the full DP degree =
+# data × hpz × expert. The optional ``hpz`` axis is the ZeRO++ hpZ / MiCS
+# secondary partition: when >1, stage-3 PARAMS shard over hpz ONLY, so the
+# fwd/bwd all-gathers stay inside an hpz-sized subgroup (contiguous devices →
+# ICI) while grads/optimizer states still shard over the full DP degree.
+ZERO_AXES = ("data", "hpz", "expert")
+HPZ_AXIS = "hpz"
 
 
 class MeshTopology:
@@ -42,6 +47,7 @@ class MeshTopology:
         pipe: int = 1,
         seq: int = 1,
         expert: int = 1,
+        hpz: int = 1,
         devices=None,
     ):
         import jax
@@ -49,20 +55,20 @@ class MeshTopology:
         if devices is None:
             devices = jax.devices()
         n = len(devices)
-        denom = model * pipe * seq * expert
+        denom = model * pipe * seq * expert * hpz
         if data in (0, None):
             if n % denom != 0:
                 raise ValueError(
-                    f"device count {n} not divisible by model*pipe*seq*expert={denom}"
+                    f"device count {n} not divisible by model*pipe*seq*expert*hpz={denom}"
                 )
             data = n // denom
         if data * denom != n:
             raise ValueError(
-                f"mesh {dict(pipe=pipe, data=data, expert=expert, seq=seq, model=model)} "
+                f"mesh {dict(pipe=pipe, data=data, hpz=hpz, expert=expert, seq=seq, model=model)} "
                 f"needs {data * denom} devices, have {n}"
             )
         self.axis_sizes: Dict[str, int] = dict(
-            pipe=pipe, data=data, expert=expert, seq=seq, model=model
+            pipe=pipe, data=data, hpz=hpz, expert=expert, seq=seq, model=model
         )
         shape = tuple(self.axis_sizes[a] for a in MESH_AXES)
         dev_array = np.asarray(devices).reshape(shape)
@@ -81,8 +87,10 @@ class MeshTopology:
 
     @property
     def data_parallel_size(self) -> int:
-        """Full ZeRO/DP degree (data × expert), reference ``groups._get_data_parallel_world_size``."""
-        return self.axis_sizes["data"] * self.axis_sizes["expert"]
+        """Full ZeRO/DP degree (data × hpz × expert), reference
+        ``groups._get_data_parallel_world_size``."""
+        return (self.axis_sizes["data"] * self.axis_sizes["hpz"]
+                * self.axis_sizes["expert"])
 
     @property
     def model_parallel_size(self) -> int:
@@ -139,6 +147,7 @@ def initialize_topology(mesh_config=None, devices=None, **kwargs) -> MeshTopolog
             pipe=mesh_config.pipe,
             seq=mesh_config.seq,
             expert=mesh_config.expert,
+            hpz=getattr(mesh_config, "hpz", 1),
         )
     _topology = MeshTopology(devices=devices, **kwargs)
     return _topology

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..comm.topology import ZERO_AXES
 from ..ops.transformer.attention import attention as _attention_op
 
 
@@ -354,12 +355,12 @@ class TransformerLM:
             return x
 
     def _act_spec(self, seq_sharded: bool):
-        # activations: batch over (data, expert); seq axis over "seq" when sharded
-        return P(("data", "expert"), self.seq_axis if seq_sharded else None, None)
+        # activations: batch over the full DP axes; seq axis when sharded
+        return P(ZERO_AXES, self.seq_axis if seq_sharded else None, None)
 
     def _heads_spec(self):
         # Ulysses: inside attention, seq gathered, heads sharded over seq×model
-        return P(("data", "expert"), None, (self.seq_axis, self.model_axis), None)
+        return P(ZERO_AXES, None, (self.seq_axis, self.model_axis), None)
 
     # ------------------------------------------------------------------
     def _block(self, x, blk, *, positions, rng, train, kv_cache=None, cache_index=None):
@@ -446,9 +447,9 @@ class TransformerLM:
             k=cfg.moe_top_k,
             capacity_factor=cfg.moe_capacity_factor if train else 1.0,
             activation="swiglu" if cfg.activation == "swiglu" else "gelu",
-            # batch arrives sharded over (data, expert); inside the expert
+            # batch arrives sharded over the DP axes; inside the expert
             # computation the expert axis moves to the expert dim (the all-to-all)
-            data_axes=("data",),
+            data_axes=("data", "hpz"),
         )
 
     # ------------------------------------------------------------------

@@ -32,7 +32,7 @@ def _constraint(x, spec):
 def routed_ffn(x, wg, wi, wo, wgate=None, *, k: int = 1,
                capacity_factor: float = 1.25, min_capacity: int = 4,
                drop_tokens: bool = True, activation: str = "gelu",
-               expert_axis: str = "expert", data_axes=("data",),
+               expert_axis: str = "expert", data_axes=("data", "hpz"),
                rng: Optional[jax.Array] = None, noise_eps: float = 0.0):
     """Shared routed-FFN core (used by ``MoE`` and ``TransformerLM``).
 
@@ -82,7 +82,7 @@ class MoE:
                  drop_tokens: bool = True, activation: str = "gelu",
                  noisy_gate_policy: Optional[str] = None,
                  expert_axis: str = "expert", model_axis: str = "model",
-                 data_axes=("data",)):
+                 data_axes=("data", "hpz")):
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.inter = expert_intermediate_size

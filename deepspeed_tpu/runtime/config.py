@@ -72,9 +72,10 @@ class MeshConfig(DeepSpeedConfigModel):
     pipe: int = 1
     seq: int = 1
     expert: int = 1
+    hpz: int = 1  # ZeRO++ hpZ / MiCS secondary partition (carved out of data)
 
     def _validate(self):
-        for name in ("model", "pipe", "seq", "expert"):
+        for name in ("model", "pipe", "seq", "expert", "hpz"):
             if getattr(self, name) < 1:
                 raise ValueError(f"mesh.{name} must be >= 1")
 

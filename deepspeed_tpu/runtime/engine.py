@@ -438,11 +438,12 @@ class DeepSpeedEngine:
     # (reference runtime/comm/nccl.py:52 + fp16/onebit/*; comm/compressed.py)
     # ------------------------------------------------------------------
     def _onebit_active(self) -> bool:
+        from ..comm.topology import ZERO_AXES
         from ..ops.adam.onebit_adam import OnebitAdam
 
         if not isinstance(self.optimizer, OnebitAdam):
             return False
-        axes = tuple(a for a in ("data", "expert") if self.topology.get_dim(a) > 1)
+        axes = tuple(a for a in ZERO_AXES if self.topology.get_dim(a) > 1)
         if not axes or self.zero_stage > 1:
             return False
         # warmup phase communicates full-precision (reference freeze_step)
@@ -452,10 +453,11 @@ class DeepSpeedEngine:
         """Local grads under shard_map over the DP axes + EF 1-bit allreduce."""
         from jax.sharding import PartitionSpec as P
 
+        from ..comm.topology import ZERO_AXES
         from .comm.compressed import compressed_allreduce_tree
 
         topo = self.topology
-        axes = tuple(a for a in ("data", "expert") if topo.get_dim(a) > 1)
+        axes = tuple(a for a in ZERO_AXES if topo.get_dim(a) > 1)
         dpn = int(np.prod([topo.get_dim(a) for a in axes]))
 
         if getattr(self, "_onebit_fn", None) is None:

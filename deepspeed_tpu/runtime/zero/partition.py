@@ -31,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...comm.topology import ZERO_AXES, MeshTopology
+from ...comm.topology import HPZ_AXIS, ZERO_AXES, MeshTopology
 
 
 def _spec_axes(spec) -> set:
@@ -53,15 +53,16 @@ def _zero_degree(topo: MeshTopology) -> int:
 
 
 def shard_leaf_spec(shape, tp_spec: Optional[PartitionSpec], topo: MeshTopology,
-                    min_size: int = 1) -> PartitionSpec:
+                    min_size: int = 1, axes=None) -> PartitionSpec:
     """Add ZeRO axes to a leaf's PartitionSpec (on top of its TP spec)."""
-    degree = _zero_degree(topo)
+    cand = ZERO_AXES if axes is None else axes
+    degree = int(np.prod([topo.get_dim(a) for a in cand]))
     entries = list(tp_spec) if tp_spec is not None else []
     entries += [None] * (len(shape) - len(entries))
     if degree == 1 or int(np.prod(shape or (1,))) < min_size:
         return PartitionSpec(*entries)
     used = _spec_axes(tp_spec)
-    zero_axes = tuple(a for a in ZERO_AXES if topo.get_dim(a) > 1 and a not in used)
+    zero_axes = tuple(a for a in cand if topo.get_dim(a) > 1 and a not in used)
     if not zero_axes:
         return PartitionSpec(*entries)
     zdeg = int(np.prod([topo.get_dim(a) for a in zero_axes]))
@@ -83,10 +84,21 @@ def shard_leaf_spec(shape, tp_spec: Optional[PartitionSpec], topo: MeshTopology,
 
 def stage_param_specs(params, stage: int, topo: MeshTopology, tp_specs=None,
                       persistence_threshold: int = 0):
-    """PartitionSpec pytree for the (lp) parameters at a given ZeRO stage."""
+    """PartitionSpec pytree for the (lp) parameters at a given ZeRO stage.
+
+    With an ``hpz`` mesh axis (>1), stage-3 params shard over ``hpz`` ONLY
+    (ZeRO++ hpZ / MiCS secondary partition): weights are replicated across the
+    outer data groups, so forward/backward all-gathers stay within the
+    hpz-sized subgroup; gradients and optimizer states keep the full-DP shard
+    (reference ``zero_hpz_partition_size``, ``config.py:264`` +
+    ``mics_shard_size``, ``engine.py:726``)."""
+    param_axes = (HPZ_AXIS,) if topo.get_dim(HPZ_AXIS) > 1 else None
+
     def leaf_spec(path_leaf, tp):
         if stage >= 3:
-            return shard_leaf_spec(path_leaf.shape, tp, topo, min_size=max(1, persistence_threshold))
+            return shard_leaf_spec(path_leaf.shape, tp, topo,
+                                   min_size=max(1, persistence_threshold),
+                                   axes=param_axes)
         return tp if tp is not None else PartitionSpec()
 
     if tp_specs is None:

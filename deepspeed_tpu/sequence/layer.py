@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..comm.topology import SEQ_AXIS, get_topology
+from ..comm.topology import SEQ_AXIS, ZERO_AXES, get_topology
 
 NEG_INF = -1e30
 
@@ -139,7 +139,7 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
 
 def ring_attention(q, k, v, *, causal: bool = True, num_kv_groups: int = 1,
                    scale: Optional[float] = None, axis_name: str = SEQ_AXIS,
-                   batch_axes: Any = ("data", "expert")):
+                   batch_axes: Any = ZERO_AXES):
     """Ring attention over the global mesh: q/k/v are global (B, S, h, d) arrays
     (sequence axis sharded over ``axis_name``)."""
     topo = get_topology()
