@@ -27,10 +27,15 @@ PEAK_BF16_FLOPS = {
 
 
 def main():
+    import logging
+
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
+
+    # keep stdout clean: the driver parses the single JSON line
+    logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
     from deepspeed_tpu.models import TransformerLM, gpt2_config
 
     n_chips = len(jax.devices())

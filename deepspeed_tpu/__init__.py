@@ -62,8 +62,12 @@ def initialize(
         )
     # ZeRO++ hpZ / MiCS secondary partition becomes the `hpz` mesh axis
     zc = ds_config.zero_config
-    hpz = max(zc.zero_hpz_partition_size,
-              zc.mics_shard_size if zc.mics_shard_size and zc.mics_shard_size > 0 else 1)
+    mics = zc.mics_shard_size if zc.mics_shard_size and zc.mics_shard_size > 0 else 1
+    if zc.zero_hpz_partition_size > 1 and mics > 1             and zc.zero_hpz_partition_size != mics:
+        raise ValueError(
+            f"zero_hpz_partition_size={zc.zero_hpz_partition_size} conflicts "
+            f"with mics_shard_size={mics}")
+    hpz = max(zc.zero_hpz_partition_size, mics)
     if hpz > 1 and zc.stage < 3:
         logger.warning(
             f"zero_hpz_partition_size/mics_shard_size={hpz} only applies at ZeRO "

@@ -18,8 +18,8 @@ model. Two surfaces:
    (reference ``timed_op`` decorator, ``comm/comm.py:101``).
 
 "Process group" arguments become mesh-axis names; ``group=None`` means the full
-ZeRO/DP degree (axes ``("data", "expert")``) to match the reference default of the
-world group for DP communication.
+ZeRO/DP degree (axes ``ZERO_AXES = ("data", "hpz", "expert")``) to match the
+reference default of the world group for DP communication.
 """
 
 import functools

@@ -3,11 +3,12 @@
 Reference analogue: ``deepspeed/utils/groups.py`` (``_create_model_parallel:64``,
 ``_create_expert_and_data_parallel:113``, ``_get_sequence_parallel_group:468``) and
 ``runtime/pipe/topology.py`` (``ProcessTopology:12``). On TPU the device grid is a
-``jax.sharding.Mesh`` with axes ``(pipe, data, expert, seq, model)``; a "process
+``jax.sharding.Mesh`` with axes ``(pipe, data, hpz, expert, seq, model)``; a "process
 group" over axis X is simply a collective over mesh axis X, and a rank's coordinates
 are its mesh position. The total data-parallel degree (what ZeRO shards over) is
-``data * expert`` — expert parallelism is carved out of the DP group exactly like the
-reference's expert-parallel groups are subsets of DP ranks.
+``data * hpz * expert`` — expert parallelism (and the hpZ secondary partition) is
+carved out of the DP group exactly like the reference's expert-parallel groups are
+subsets of DP ranks.
 
 Axis order is outermost-first = slowest-varying-first: ``pipe`` outermost so pipeline
 stages map to contiguous device blocks (DCN-friendly for multi-slice), ``model``

@@ -48,10 +48,6 @@ def _spec_axes(spec) -> set:
     return used
 
 
-def _zero_degree(topo: MeshTopology) -> int:
-    return int(np.prod([topo.get_dim(a) for a in ZERO_AXES]))
-
-
 def shard_leaf_spec(shape, tp_spec: Optional[PartitionSpec], topo: MeshTopology,
                     min_size: int = 1, axes=None) -> PartitionSpec:
     """Add ZeRO axes to a leaf's PartitionSpec (on top of its TP spec)."""
