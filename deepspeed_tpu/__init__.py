@@ -63,7 +63,8 @@ def initialize(
     # ZeRO++ hpZ / MiCS secondary partition becomes the `hpz` mesh axis
     zc = ds_config.zero_config
     mics = zc.mics_shard_size if zc.mics_shard_size and zc.mics_shard_size > 0 else 1
-    if zc.zero_hpz_partition_size > 1 and mics > 1             and zc.zero_hpz_partition_size != mics:
+    if (zc.zero_hpz_partition_size > 1 and mics > 1
+            and zc.zero_hpz_partition_size != mics):
         raise ValueError(
             f"zero_hpz_partition_size={zc.zero_hpz_partition_size} conflicts "
             f"with mics_shard_size={mics}")

@@ -160,6 +160,13 @@ class PipelinedLM:
             specs["blocks"],
             is_leaf=lambda s: isinstance(s, P),
         )
+        # vocab-parallel embedding gathers inside the (partial-manual) pipeline
+        # shard_map crash XLA's SPMD partitioner (PartitionGather check);
+        # embeddings are replicated across TP here — like across stages
+        if "wte" in specs:
+            specs["wte"] = P(*([None] * len(specs["wte"])))
+        if "lm_head" in specs:
+            specs["lm_head"] = P(*([None] * len(specs["lm_head"])))
         return specs
 
     # ------------------------------------------------------------------

@@ -28,7 +28,7 @@ def _create_logger(name: str = "DeepSpeedTPU", level=logging.INFO) -> logging.Lo
     lg = logging.getLogger(name)
     lg.setLevel(level)
     lg.propagate = False
-    handler = logging.StreamHandler(stream=sys.stdout)
+    handler = logging.StreamHandler(stream=sys.stderr)
     handler.setFormatter(logging.Formatter(fmt=_FormatterFactory.fmt))
     lg.addHandler(handler)
     return lg

@@ -127,3 +127,29 @@ class TestPipelineEngine:
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
         with pytest.raises(RuntimeError):
             engine({"input_ids": jnp.zeros((8, 32), jnp.int32)})
+
+
+class Test3DParallelism:
+    def test_pp_dp_tp_hybrid_trains(self):
+        """Full 3D: pipeline x data x tensor parallel in one mesh (reference
+        PipeModelDataParallelTopology, runtime/pipe/topology.py:244)."""
+        topo = topo_mod.initialize_topology(data=2, pipe=2, model=2)
+        cfg = tiny_cfg(num_layers=4, vocab_size=256, hidden_size=128)
+        model = PipelinedLM(TransformerLM(cfg), topology=topo)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 2e-3}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "mesh": {"data": 2, "pipe": 2, "model": 2},
+        })
+        rng = np.random.default_rng(0)
+        fixed = rng.integers(0, 256, (4, 32), dtype=np.int32)
+
+        def it():
+            while True:
+                yield {"input_ids": fixed}
+
+        losses = [float(engine.train_batch(it())) for _ in range(5)]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
