@@ -98,8 +98,62 @@ def from_hf_gpt2(model_or_state_dict, pad_vocab_to: Optional[int] = None
     return model, params
 
 
+def _stack(sd, fmt, L):
+    return jnp.asarray(np.stack([sd[fmt.format(i)] for i in range(L)]))
+
+
+def _stackT(sd, fmt, L):
+    # torch Linear (out, in) → ours (in, out)
+    return jnp.asarray(np.stack([sd[fmt.format(i)].T for i in range(L)]))
+
+
+def _act(hf_name: str) -> str:
+    """HF activation name → TransformerConfig.activation. HF 'gelu' is the exact
+    erf form; 'gelu_new'/'gelu_fast'/'gelu_pytorch_tanh' are the tanh approx."""
+    table = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_fast": "gelu", "gelu_pytorch_tanh": "gelu"}
+    if hf_name not in table:
+        raise ValueError(f"unsupported HF activation '{hf_name}'")
+    return table[hf_name]
+
+
+def _rotary_perm(rotary_dim: int, head_dim: int) -> np.ndarray:
+    """Column permutation turning interleaved-pair rotary weights (GPT-J
+    'rotate every two') into rotate-half layout: the q·k inner product is
+    invariant under a shared permutation of head dims, and pair (2i, 2i+1)
+    maps to pair (i, i + r/2) with the same frequency."""
+    r = rotary_dim
+    return np.concatenate([np.arange(0, r, 2), np.arange(1, r, 2),
+                           np.arange(r, head_dim)])
+
+
+def _permute_heads(w, perm, num_heads, head_dim):
+    """Apply a per-head column permutation to (L, in, num_heads*head_dim)."""
+    Lw, I, _ = w.shape
+    return np.ascontiguousarray(
+        w.reshape(Lw, I, num_heads, head_dim)[..., perm].reshape(Lw, I, -1))
+
+
+def _split_fused_qkv(sd, key, nh, hd):
+    """Split a per-head-interleaved fused [q;k;v] projection (GPT-NeoX/BLOOM/
+    classic-Falcon layout: out dim = nh·3·hd grouped per head) into our
+    (in, out) q/k/v weights and their biases (None when the checkpoint has no
+    bias)."""
+    w, b = sd[key + ".weight"], sd.get(key + ".bias")
+    H_in = w.shape[1]
+    wh = w.reshape(nh, 3, hd, H_in)
+    ws = [wh[:, j].reshape(nh * hd, H_in).T for j in range(3)]
+    if b is None:
+        return ws, None
+    bh = b.reshape(nh, 3, hd)
+    return ws, [bh[:, j].reshape(nh * hd) for j in range(3)]
+
+
 def from_hf_llama(model) -> Tuple[TransformerLM, Dict[str, Any]]:
-    """Convert an HF LLaMA/Mistral-family causal LM (``LlamaForCausalLM``)."""
+    """Convert an HF LLaMA/Mistral/Qwen2-family causal LM (``LlamaForCausalLM``,
+    ``Qwen2ForCausalLM`` — Qwen2 is LLaMA plus q/k/v biases). Reference
+    containers: ``module_inject/containers/llama.py``, v2 model_implementations
+    ``{llama_v2,mistral,qwen_v2}``."""
     hf_cfg = model.config
     sd = {k: _np(v) for k, v in model.state_dict().items()}
     H, L = hf_cfg.hidden_size, hf_cfg.num_hidden_layers
@@ -107,52 +161,494 @@ def from_hf_llama(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     kvh = getattr(hf_cfg, "num_key_value_heads", nh)
     V = hf_cfg.vocab_size
     tie = bool(getattr(hf_cfg, "tie_word_embeddings", False))
+    qkv_bias = "model.layers.0.self_attn.q_proj.bias" in sd
+    o_bias = "model.layers.0.self_attn.o_proj.bias" in sd  # InternLM bias=True
     cfg = TransformerConfig(
         vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=kvh,
         intermediate_size=hf_cfg.intermediate_size,
         max_seq_len=getattr(hf_cfg, "max_position_embeddings", 4096),
         pos_embedding="rope", norm="rmsnorm", activation="swiglu",
         tie_embeddings=tie, norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
+        qkv_bias=qkv_bias, attn_out_bias=o_bias,
         rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)), name="llama-hf",
     )
+    pre = "model.layers.{}"
+    params = {
+        "wte": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "wq": _stackT(sd, pre + ".self_attn.q_proj.weight", L),
+            "wk": _stackT(sd, pre + ".self_attn.k_proj.weight", L),
+            "wv": _stackT(sd, pre + ".self_attn.v_proj.weight", L),
+            "wo": _stackT(sd, pre + ".self_attn.o_proj.weight", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "w_gate": _stackT(sd, pre + ".mlp.gate_proj.weight", L),
+            "w_up": _stackT(sd, pre + ".mlp.up_proj.weight", L),
+            "w_down": _stackT(sd, pre + ".mlp.down_proj.weight", L),
+        },
+        "lnf_scale": jnp.asarray(sd["model.norm.weight"]),
+    }
+    if qkv_bias:
+        blocks = params["blocks"]
+        blocks["wq_bias"] = _stack(sd, pre + ".self_attn.q_proj.bias", L)
+        blocks["wk_bias"] = _stack(sd, pre + ".self_attn.k_proj.bias", L)
+        blocks["wv_bias"] = _stack(sd, pre + ".self_attn.v_proj.bias", L)
+    if o_bias:
+        params["blocks"]["attn_bias"] = _stack(sd, pre + ".self_attn.o_proj.bias", L)
+    if not tie:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T)
+    model_out = TransformerLM(cfg)
+    log_dist(f"converted HF LLaMA-family: H={H} L={L} heads={nh}/{kvh} vocab={V}",
+             ranks=[0])
+    return model_out, params
 
-    def stackT(fmt):
-        # torch Linear (out, in) → ours (in, out)
-        return jnp.asarray(np.stack(
-            [sd[fmt.format(i)].T for i in range(L)]))
 
-    def stack(fmt):
-        return jnp.asarray(np.stack([sd[fmt.format(i)] for i in range(L)]))
+def from_hf_opt(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF OPT causal LM (reference ``module_inject/containers/opt.py``,
+    v2 ``model_implementations/opt``). Learned positions carry a +2 offset in the
+    HF weight table; we bake it out by dropping the first two rows."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
+    V = hf_cfg.vocab_size
+    if getattr(hf_cfg, "word_embed_proj_dim", H) != H:
+        raise ValueError("OPT word_embed_proj_dim != hidden_size (350m variant) unsupported")
+    if not getattr(hf_cfg, "do_layer_norm_before", True):
+        raise ValueError("OPT do_layer_norm_before=False unsupported")
+    tie = bool(getattr(hf_cfg, "tie_word_embeddings", True))
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        intermediate_size=hf_cfg.ffn_dim, max_seq_len=hf_cfg.max_position_embeddings,
+        pos_embedding="learned", norm="layernorm",
+        activation=_act(hf_cfg.activation_function),
+        tie_embeddings=tie, qkv_bias=True, name="opt-hf",
+    )
+    pre = "model.decoder.layers.{}"
+    params = {
+        "wte": jnp.asarray(sd["model.decoder.embed_tokens.weight"]),
+        "wpe": jnp.asarray(sd["model.decoder.embed_positions.weight"][2:]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".self_attn_layer_norm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".self_attn_layer_norm.bias", L),
+            "wq": _stackT(sd, pre + ".self_attn.q_proj.weight", L),
+            "wk": _stackT(sd, pre + ".self_attn.k_proj.weight", L),
+            "wv": _stackT(sd, pre + ".self_attn.v_proj.weight", L),
+            "wq_bias": _stack(sd, pre + ".self_attn.q_proj.bias", L),
+            "wk_bias": _stack(sd, pre + ".self_attn.k_proj.bias", L),
+            "wv_bias": _stack(sd, pre + ".self_attn.v_proj.bias", L),
+            "wo": _stackT(sd, pre + ".self_attn.out_proj.weight", L),
+            "attn_bias": _stack(sd, pre + ".self_attn.out_proj.bias", L),
+            "ln2_scale": _stack(sd, pre + ".final_layer_norm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".final_layer_norm.bias", L),
+            "w_up": _stackT(sd, pre + ".fc1.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".fc1.bias", L),
+            "w_down": _stackT(sd, pre + ".fc2.weight", L),
+            "mlp_bias": _stack(sd, pre + ".fc2.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["model.decoder.final_layer_norm.weight"]),
+        "lnf_bias": jnp.asarray(sd["model.decoder.final_layer_norm.bias"]),
+    }
+    if not tie:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T)
+    log_dist(f"converted HF OPT: H={H} L={L} heads={nh} vocab={V}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_gptj(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF GPT-J causal LM (reference ``module_inject/containers/gptj.py``).
+    Parallel attention+MLP off one shared LayerNorm; partial interleaved rotary
+    (converted to rotate-half via ``_rotary_perm``); untied LM head with bias."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.n_embd, hf_cfg.n_layer, hf_cfg.n_head
+    hd = H // nh
+    r = hf_cfg.rotary_dim or hd
+    V = hf_cfg.vocab_size
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        max_seq_len=hf_cfg.n_positions, pos_embedding="rope", rotary_dim=r,
+        norm="layernorm", activation=_act(hf_cfg.activation_function),
+        tie_embeddings=False, lm_head_bias=True,
+        parallel_block=True, parallel_shared_ln=True, name="gptj-hf",
+    )
+    pre = "transformer.h.{}"
+    perm = _rotary_perm(r, hd)
+    wq = _permute_heads(np.stack([sd[pre.format(i) + ".attn.q_proj.weight"].T
+                                  for i in range(L)]), perm, nh, hd)
+    wk = _permute_heads(np.stack([sd[pre.format(i) + ".attn.k_proj.weight"].T
+                                  for i in range(L)]), perm, nh, hd)
+    zeros_h = jnp.zeros((L, H), jnp.float32)
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".ln_1.weight", L),
+            "ln1_bias": _stack(sd, pre + ".ln_1.bias", L),
+            "wq": jnp.asarray(wq), "wk": jnp.asarray(wk),
+            "wv": _stackT(sd, pre + ".attn.v_proj.weight", L),
+            "wo": _stackT(sd, pre + ".attn.out_proj.weight", L),
+            "attn_bias": zeros_h,  # GPT-J out_proj has no bias
+            "w_up": _stackT(sd, pre + ".mlp.fc_in.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".mlp.fc_in.bias", L),
+            "w_down": _stackT(sd, pre + ".mlp.fc_out.weight", L),
+            "mlp_bias": _stack(sd, pre + ".mlp.fc_out.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+        "lm_head": jnp.asarray(sd["lm_head.weight"].T),
+        "lm_head_bias": jnp.asarray(sd["lm_head.bias"]),
+    }
+    log_dist(f"converted HF GPT-J: H={H} L={L} heads={nh} rotary={r}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_gptneox(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF GPT-NeoX/Pythia causal LM (reference
+    ``module_inject/containers/gptneox.py``). Fused per-head [q;k;v] projection,
+    partial rotate-half rotary, parallel residual with two LayerNorms."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
+    hd = H // nh
+    r = int(hd * hf_cfg.rotary_pct)
+    V = hf_cfg.vocab_size
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        intermediate_size=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        pos_embedding="rope", rotary_dim=r,
+        rope_theta=float(getattr(hf_cfg, "rotary_emb_base", 10000.0)),
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
+        activation=_act(hf_cfg.hidden_act), tie_embeddings=False, qkv_bias=True,
+        parallel_block=bool(hf_cfg.use_parallel_residual),
+        parallel_shared_ln=False, name="gptneox-hf",
+    )
+    pre = "gpt_neox.layers.{}"
+    qkv = [_split_fused_qkv(sd, pre.format(i) + ".attention.query_key_value",
+                            nh, hd) for i in range(L)]
+    params = {
+        "wte": jnp.asarray(sd["gpt_neox.embed_in.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".input_layernorm.bias", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".post_attention_layernorm.bias", L),
+            "wq": jnp.asarray(np.stack([w[0] for w, _ in qkv])),
+            "wk": jnp.asarray(np.stack([w[1] for w, _ in qkv])),
+            "wv": jnp.asarray(np.stack([w[2] for w, _ in qkv])),
+            "wq_bias": jnp.asarray(np.stack([b[0] for _, b in qkv])),
+            "wk_bias": jnp.asarray(np.stack([b[1] for _, b in qkv])),
+            "wv_bias": jnp.asarray(np.stack([b[2] for _, b in qkv])),
+            "wo": _stackT(sd, pre + ".attention.dense.weight", L),
+            "attn_bias": _stack(sd, pre + ".attention.dense.bias", L),
+            "w_up": _stackT(sd, pre + ".mlp.dense_h_to_4h.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L),
+            "w_down": _stackT(sd, pre + ".mlp.dense_4h_to_h.weight", L),
+            "mlp_bias": _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["gpt_neox.final_layer_norm.weight"]),
+        "lnf_bias": jnp.asarray(sd["gpt_neox.final_layer_norm.bias"]),
+        "lm_head": jnp.asarray(sd["embed_out.weight"].T),
+    }
+    log_dist(f"converted HF GPT-NeoX: H={H} L={L} heads={nh} rotary={r} "
+             f"parallel={cfg.parallel_block}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_bloom(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF BLOOM causal LM (reference
+    ``module_inject/containers/bloom.py``). ALiBi positions, embedding
+    LayerNorm, fused per-head [q;k;v] projection."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.n_layer, hf_cfg.n_head
+    hd = H // nh
+    V = hf_cfg.vocab_size
+    if getattr(hf_cfg, "apply_residual_connection_post_layernorm", False):
+        raise ValueError("BLOOM apply_residual_connection_post_layernorm unsupported")
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        max_seq_len=2048, pos_embedding="alibi", embed_layernorm=True,
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation="gelu",  # BloomGelu = tanh approximation
+        tie_embeddings=True, qkv_bias=True, name="bloom-hf",
+    )
+    pre = "transformer.h.{}"
+    qkv = [_split_fused_qkv(sd, pre.format(i) + ".self_attention.query_key_value",
+                            nh, hd) for i in range(L)]
+    params = {
+        "wte": jnp.asarray(sd["transformer.word_embeddings.weight"]),
+        "ln_emb_scale": jnp.asarray(sd["transformer.word_embeddings_layernorm.weight"]),
+        "ln_emb_bias": jnp.asarray(sd["transformer.word_embeddings_layernorm.bias"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".input_layernorm.bias", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".post_attention_layernorm.bias", L),
+            "wq": jnp.asarray(np.stack([w[0] for w, _ in qkv])),
+            "wk": jnp.asarray(np.stack([w[1] for w, _ in qkv])),
+            "wv": jnp.asarray(np.stack([w[2] for w, _ in qkv])),
+            "wq_bias": jnp.asarray(np.stack([b[0] for _, b in qkv])),
+            "wk_bias": jnp.asarray(np.stack([b[1] for _, b in qkv])),
+            "wv_bias": jnp.asarray(np.stack([b[2] for _, b in qkv])),
+            "wo": _stackT(sd, pre + ".self_attention.dense.weight", L),
+            "attn_bias": _stack(sd, pre + ".self_attention.dense.bias", L),
+            "w_up": _stackT(sd, pre + ".mlp.dense_h_to_4h.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L),
+            "w_down": _stackT(sd, pre + ".mlp.dense_4h_to_h.weight", L),
+            "mlp_bias": _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+    }
+    log_dist(f"converted HF BLOOM: H={H} L={L} heads={nh} vocab={V}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_falcon(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF Falcon causal LM (reference v2
+    ``model_implementations/falcon``). Handles all three fused-QKV layouts:
+    new-decoder grouped (kv, ratio+2, hd), multi-query flat [q…,k,v], and
+    classic per-head [q;k;v]; rotary or ALiBi positions; optional parallel
+    attention with one or two LayerNorms."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
+    hd = H // nh
+    V = hf_cfg.vocab_size
+    new_arch = bool(getattr(hf_cfg, "new_decoder_architecture", False))
+    multi_query = bool(getattr(hf_cfg, "multi_query", True))
+    # HF FalconDecoderLayer runs the parallel residual whenever either flag is set
+    parallel = new_arch or bool(getattr(hf_cfg, "parallel_attn", True))
+    use_alibi = bool(getattr(hf_cfg, "alibi", False))
+    has_bias = bool(getattr(hf_cfg, "bias", False))
+    if new_arch:
+        kvh = getattr(hf_cfg, "num_kv_heads", nh) or nh
+    else:
+        kvh = 1 if multi_query else nh
+    tie = bool(getattr(hf_cfg, "tie_word_embeddings", True))
+    two_ln = new_arch and getattr(hf_cfg, "num_ln_in_parallel_attn", 2) != 1
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=kvh,
+        max_seq_len=getattr(hf_cfg, "max_position_embeddings", 2048),
+        pos_embedding="alibi" if use_alibi else "rope",
+        alibi_slope_scale=hd ** -0.5,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation="gelu_exact", tie_embeddings=tie, qkv_bias=has_bias,
+        parallel_block=parallel, parallel_shared_ln=not two_ln, name="falcon-hf",
+    )
+    pre = "transformer.h.{}"
+    ratio = nh // kvh
+
+    def split_qkv(i):
+        if not (new_arch or multi_query):  # classic per-head [q;k;v]
+            return _split_fused_qkv(
+                sd, pre.format(i) + ".self_attention.query_key_value", nh, hd)[0]
+        # grouped: (kvh, ratio+2, hd, H) — q rows kv-major, matching our GQA order
+        w = sd[pre.format(i) + ".self_attention.query_key_value.weight"]
+        wh = w.reshape(kvh, ratio + 2, hd, H)
+        return (wh[:, :ratio].reshape(nh * hd, H).T,
+                wh[:, ratio].reshape(kvh * hd, H).T,
+                wh[:, ratio + 1].reshape(kvh * hd, H).T)
+
+    def split_qkv_bias(i):
+        if not (new_arch or multi_query):
+            return _split_fused_qkv(
+                sd, pre.format(i) + ".self_attention.query_key_value", nh, hd)[1]
+        b = sd[pre.format(i) + ".self_attention.query_key_value.bias"]
+        bh = b.reshape(kvh, ratio + 2, hd)
+        return (bh[:, :ratio].reshape(-1), bh[:, ratio].reshape(-1),
+                bh[:, ratio + 1].reshape(-1))
+
+    qkv = [split_qkv(i) for i in range(L)]
+    blocks = {
+        "wq": jnp.asarray(np.stack([q for q, _, _ in qkv])),
+        "wk": jnp.asarray(np.stack([k for _, k, _ in qkv])),
+        "wv": jnp.asarray(np.stack([v for _, _, v in qkv])),
+        "wo": _stackT(sd, pre + ".self_attention.dense.weight", L),
+        "w_up": _stackT(sd, pre + ".mlp.dense_h_to_4h.weight", L),
+        "w_down": _stackT(sd, pre + ".mlp.dense_4h_to_h.weight", L),
+    }
+    if two_ln:
+        blocks["ln1_scale"] = _stack(sd, pre + ".ln_attn.weight", L)
+        blocks["ln1_bias"] = _stack(sd, pre + ".ln_attn.bias", L)
+        blocks["ln2_scale"] = _stack(sd, pre + ".ln_mlp.weight", L)
+        blocks["ln2_bias"] = _stack(sd, pre + ".ln_mlp.bias", L)
+    else:
+        blocks["ln1_scale"] = _stack(sd, pre + ".input_layernorm.weight", L)
+        blocks["ln1_bias"] = _stack(sd, pre + ".input_layernorm.bias", L)
+        if not parallel:
+            blocks["ln2_scale"] = _stack(sd, pre + ".post_attention_layernorm.weight", L)
+            blocks["ln2_bias"] = _stack(sd, pre + ".post_attention_layernorm.bias", L)
+    if has_bias:
+        qkvb = [split_qkv_bias(i) for i in range(L)]
+        blocks["wq_bias"] = jnp.asarray(np.stack([b[0] for b in qkvb]))
+        blocks["wk_bias"] = jnp.asarray(np.stack([b[1] for b in qkvb]))
+        blocks["wv_bias"] = jnp.asarray(np.stack([b[2] for b in qkvb]))
+        blocks["attn_bias"] = _stack(sd, pre + ".self_attention.dense.bias", L)
+        blocks["mlp_up_bias"] = _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L)
+        blocks["mlp_bias"] = _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L)
+    else:
+        I = blocks["w_up"].shape[-1]
+        blocks["attn_bias"] = jnp.zeros((L, H), jnp.float32)
+        blocks["mlp_up_bias"] = jnp.zeros((L, I), jnp.float32)
+        blocks["mlp_bias"] = jnp.zeros((L, H), jnp.float32)
+    params = {
+        "wte": jnp.asarray(sd["transformer.word_embeddings.weight"]),
+        "blocks": blocks,
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+    }
+    if not tie:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T)
+    log_dist(f"converted HF Falcon: H={H} L={L} heads={nh}/{kvh} "
+             f"parallel={parallel} alibi={use_alibi}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_phi(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF Phi causal LM (reference v2 ``model_implementations/phi``).
+    Parallel attention+MLP off one shared LayerNorm, partial rotate-half rotary,
+    biases on every projection, untied LM head with bias."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
+    kvh = getattr(hf_cfg, "num_key_value_heads", nh) or nh
+    hd = H // nh
+    r = int(hd * getattr(hf_cfg, "partial_rotary_factor", 0.5))
+    V = hf_cfg.vocab_size
+    if getattr(hf_cfg, "qk_layernorm", False):
+        raise ValueError("Phi qk_layernorm unsupported")
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=kvh,
+        intermediate_size=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        pos_embedding="rope", rotary_dim=r,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
+        activation=_act(hf_cfg.hidden_act), tie_embeddings=False,
+        qkv_bias=True, lm_head_bias=True,
+        parallel_block=True, parallel_shared_ln=True, name="phi-hf",
+    )
+    pre = "model.layers.{}"
+    params = {
+        "wte": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".input_layernorm.bias", L),
+            "wq": _stackT(sd, pre + ".self_attn.q_proj.weight", L),
+            "wk": _stackT(sd, pre + ".self_attn.k_proj.weight", L),
+            "wv": _stackT(sd, pre + ".self_attn.v_proj.weight", L),
+            "wq_bias": _stack(sd, pre + ".self_attn.q_proj.bias", L),
+            "wk_bias": _stack(sd, pre + ".self_attn.k_proj.bias", L),
+            "wv_bias": _stack(sd, pre + ".self_attn.v_proj.bias", L),
+            "wo": _stackT(sd, pre + ".self_attn.dense.weight", L),
+            "attn_bias": _stack(sd, pre + ".self_attn.dense.bias", L),
+            "w_up": _stackT(sd, pre + ".mlp.fc1.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".mlp.fc1.bias", L),
+            "w_down": _stackT(sd, pre + ".mlp.fc2.weight", L),
+            "mlp_bias": _stack(sd, pre + ".mlp.fc2.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["model.final_layernorm.weight"]),
+        "lnf_bias": jnp.asarray(sd["model.final_layernorm.bias"]),
+        "lm_head": jnp.asarray(sd["lm_head.weight"].T),
+        "lm_head_bias": jnp.asarray(sd["lm_head.bias"]),
+    }
+    log_dist(f"converted HF Phi: H={H} L={L} heads={nh}/{kvh} rotary={r}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_mixtral(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF Mixtral MoE causal LM (reference v2
+    ``model_implementations/mixtral``). LLaMA skeleton + top-k routed SwiGLU
+    experts; gating matches HF exactly (softmax → top-k → renormalize) and
+    token dropping is disabled for parity."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L = hf_cfg.hidden_size, hf_cfg.num_hidden_layers
+    nh = hf_cfg.num_attention_heads
+    kvh = getattr(hf_cfg, "num_key_value_heads", nh)
+    E, topk = hf_cfg.num_local_experts, hf_cfg.num_experts_per_tok
+    V = hf_cfg.vocab_size
+    tie = bool(getattr(hf_cfg, "tie_word_embeddings", False))
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=kvh,
+        intermediate_size=hf_cfg.intermediate_size,
+        max_seq_len=getattr(hf_cfg, "max_position_embeddings", 4096),
+        pos_embedding="rope", norm="rmsnorm", activation="swiglu",
+        tie_embeddings=tie, norm_eps=hf_cfg.rms_norm_eps,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)),
+        num_experts=E, moe_top_k=topk, moe_drop_tokens=False,
+        moe_aux_loss_coef=float(getattr(hf_cfg, "router_aux_loss_coef", 0.01)),
+        name="mixtral-hf",
+    )
+    pre = "model.layers.{}"
+
+    def experts(i, which):
+        return np.stack([
+            sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight"].T
+            for e in range(E)])
 
     params = {
         "wte": jnp.asarray(sd["model.embed_tokens.weight"]),
         "blocks": {
-            "ln1_scale": stack("model.layers.{}.input_layernorm.weight"),
-            "wq": stackT("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stackT("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stackT("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stackT("model.layers.{}.self_attn.o_proj.weight"),
-            "ln2_scale": stack("model.layers.{}.post_attention_layernorm.weight"),
-            "w_gate": stackT("model.layers.{}.mlp.gate_proj.weight"),
-            "w_up": stackT("model.layers.{}.mlp.up_proj.weight"),
-            "w_down": stackT("model.layers.{}.mlp.down_proj.weight"),
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "wq": _stackT(sd, pre + ".self_attn.q_proj.weight", L),
+            "wk": _stackT(sd, pre + ".self_attn.k_proj.weight", L),
+            "wv": _stackT(sd, pre + ".self_attn.v_proj.weight", L),
+            "wo": _stackT(sd, pre + ".self_attn.o_proj.weight", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "moe_wg": _stackT(sd, pre + ".block_sparse_moe.gate.weight", L),
+            "w_gate": jnp.asarray(np.stack([experts(i, "w1") for i in range(L)])),
+            "w_down": jnp.asarray(np.stack([experts(i, "w2") for i in range(L)])),
+            "wi": jnp.asarray(np.stack([experts(i, "w3") for i in range(L)])),
         },
         "lnf_scale": jnp.asarray(sd["model.norm.weight"]),
     }
     if not tie:
         params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T)
-    model_out = TransformerLM(cfg)
-    log_dist(f"converted HF LLaMA: H={H} L={L} heads={nh}/{kvh} vocab={V}", ranks=[0])
-    return model_out, params
+    log_dist(f"converted HF Mixtral: H={H} L={L} heads={nh}/{kvh} experts={E} "
+             f"top{topk}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+_CONVERTERS = {
+    "gpt2": from_hf_gpt2,
+    "llama": from_hf_llama,
+    "mistral": from_hf_llama,
+    "qwen2": from_hf_llama,
+    "internlm": from_hf_llama,
+    "mixtral": from_hf_mixtral,
+    "opt": from_hf_opt,
+    "gptj": from_hf_gptj,
+    "gptneox": from_hf_gptneox,
+    "bloom": from_hf_bloom,
+    "falcon": from_hf_falcon,
+    "rwforcausallm": from_hf_falcon,  # pre-rename Falcon checkpoints
+    "phi": from_hf_phi,
+}
+
+# look-alike architectures with incompatible weight layouts — reject cleanly
+# instead of dispatching to a converter that would die on missing keys
+_UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm"]
+
+# match order matters: more specific names first ("gptneox" before "gptneo",
+# "mixtral" before "llama"-substring families)
+_MATCH_ORDER = ["gptneox", "gptj", "gpt2", "mixtral", "qwen2", "internlm",
+                "mistral", "llama", "opt", "bloom", "falcon", "rwforcausallm",
+                "phi"]
 
 
 def from_hf(model, **kw):
-    """Dispatch on HF architecture (reference ``replace_module`` policy match)."""
+    """Dispatch on HF architecture (reference ``replace_module`` policy match,
+    ``module_inject/replace_policy.py``)."""
     arch = getattr(getattr(model, "config", None), "architectures", None) or []
     name = (arch[0] if arch else type(model).__name__).lower()
-    if "gpt2" in name:
-        return from_hf_gpt2(model, **kw)
-    if "llama" in name or "mistral" in name:
-        return from_hf_llama(model, **kw)
+    if any(key in name for key in _UNSUPPORTED):
+        raise ValueError(f"no converter for HF architecture '{name}' "
+                         f"(supported: {sorted(set(_MATCH_ORDER))})")
+    for key in _MATCH_ORDER:
+        if key in name:
+            return _CONVERTERS[key](model, **kw)
     raise ValueError(f"no converter for HF architecture '{name}' "
-                     "(supported: gpt2, llama, mistral)")
+                     f"(supported: {sorted(set(_MATCH_ORDER))})")

@@ -48,19 +48,32 @@ class TransformerConfig:
     intermediate_size: Optional[int] = None  # None → 4*H (gelu) or 8/3*H (swiglu)
     max_seq_len: int = 1024
     # family knobs
-    pos_embedding: str = "learned"  # "learned" | "rope" | "none"
+    pos_embedding: str = "learned"  # "learned" | "rope" | "alibi" | "none"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
-    activation: str = "gelu"  # "gelu" | "swiglu"
+    activation: str = "gelu"  # "gelu" (tanh) | "gelu_exact" | "relu" | "swiglu"
     tie_embeddings: bool = True
     qkv_bias: bool = False  # GPT-2-style biases on q/k/v projections
+    attn_out_bias: bool = False  # bias on the attention out-proj even under rmsnorm (InternLM)
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    rotary_dim: Optional[int] = None  # partial rotary (GPT-J/NeoX/Phi); None = head_dim
+    # parallel residual: x + attn(ln(x)) + mlp(ln(x)) (GPT-J/NeoX/Falcon/Phi,
+    # reference containers ``module_inject/containers/{gptj,gptneox,...}.py``)
+    parallel_block: bool = False
+    parallel_shared_ln: bool = True  # one LN feeds both branches (GPT-J/Falcon/Phi); False = two LNs (NeoX)
+    embed_layernorm: bool = False  # LayerNorm after token embedding (BLOOM)
+    # ALiBi slope multiplier: 1.0 (BLOOM adds the bias post-scale); Falcon folds
+    # the bias in BEFORE the 1/sqrt(head_dim) scaling, so its converter sets this
+    # to head_dim**-0.5
+    alibi_slope_scale: float = 1.0
+    lm_head_bias: bool = False  # untied LM head carries a bias (GPT-J, Phi)
     dropout: float = 0.0
     # MoE (0 experts = dense MLP; >0 replaces every MLP with a routed MoE FFN)
     num_experts: int = 0
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    moe_drop_tokens: bool = True  # False = capacity C=T, no drops (Mixtral parity)
     # progressive layer drop (PLD): stochastic depth driven by a per-step theta
     # injected as batch["pld_theta"] (reference progressive_layer_drop.py)
     progressive_layer_drop: bool = False
@@ -98,7 +111,8 @@ class TransformerConfig:
         mlp = (3 if self.activation == "swiglu" else 2) * H * I
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + H * self.num_experts  # experts + router
-        norms = (2 if self.norm == "rmsnorm" else 4) * H
+        n_ln = 1 if (self.parallel_block and self.parallel_shared_ln) else 2
+        norms = n_ln * (1 if self.norm == "rmsnorm" else 2) * H
         per_layer = attn + mlp + norms
         emb = V * H + (0 if self.pos_embedding != "learned" else self.max_seq_len * H)
         head = 0 if self.tie_embeddings else V * H
@@ -200,20 +214,44 @@ def _norm(x, scale, bias, kind: str, eps: float):
     return y.astype(x.dtype)
 
 
-def _rope(q, k, positions, head_dim, theta):
-    """Rotary embedding applied to (B,S,h,d) q/k at integer positions (B,S)."""
-    half = head_dim // 2
+def _rope(q, k, positions, head_dim, theta, rotary_dim=None):
+    """Rotary embedding applied to (B,S,h,d) q/k at integer positions (B,S).
+
+    ``rotary_dim`` < head_dim rotates only the leading dims (GPT-J/NeoX/Phi
+    partial rotary); the tail passes through. Rotate-half convention —
+    interleaved-pair checkpoints (GPT-J) are handled by a column permutation
+    at conversion time (``hf_converters._rotary_perm``).
+    """
+    d = rotary_dim or head_dim
+    half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     ang = positions[..., None].astype(jnp.float32) * freqs  # (B,S,half)
     cos = jnp.cos(ang)[:, :, None, :]
     sin = jnp.sin(ang)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        x1, x2 = jnp.split(x[..., :d].astype(jnp.float32), 2, axis=-1)
         out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        if d < x.shape[-1]:
+            out = jnp.concatenate([out, x[..., d:].astype(jnp.float32)], axis=-1)
         return out.astype(x.dtype)
 
     return rot(q), rot(k)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (geometric sequence, closest-power-of-2 rule —
+    same formula as HF ``build_alibi_tensor`` used by the reference's BLOOM
+    container ``module_inject/containers/bloom.py``)."""
+    import math
+
+    closest = 2 ** int(math.floor(math.log2(n_heads)))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** (i + 1) for i in range(closest)]
+    if closest != n_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** (2 * i + 1) for i in range(n_heads - closest)]
+    return np.asarray(slopes, np.float32)
 
 
 def _dropout(x, rate, rng, train):
@@ -246,6 +284,7 @@ class TransformerLM:
         def stacked(key, shape, initializer=init):
             return initializer(key, (L,) + shape, dt)
 
+        single_ln = cfg.parallel_block and cfg.parallel_shared_ln
         params: Dict[str, Any] = {
             "wte": init(k[0], (V, H), dt),
             "blocks": {
@@ -254,10 +293,11 @@ class TransformerLM:
                 "wk": stacked(k[2], (H, kvh * hd)),
                 "wv": stacked(k[3], (H, kvh * hd)),
                 "wo": stacked(k[4], (nh * hd, H), resid_init),
-                "ln2_scale": jnp.ones((L, H), dt),
             },
             "lnf_scale": jnp.ones((H,), dt),
         }
+        if not single_ln:
+            params["blocks"]["ln2_scale"] = jnp.ones((L, H), dt)
         blocks = params["blocks"]
         E = cfg.num_experts
         if E > 0:
@@ -275,20 +315,29 @@ class TransformerLM:
                 blocks["w_up"] = stacked(k[5], (H, I))
         if cfg.norm == "layernorm":
             blocks["ln1_bias"] = jnp.zeros((L, H), dt)
-            blocks["ln2_bias"] = jnp.zeros((L, H), dt)
+            if not single_ln:
+                blocks["ln2_bias"] = jnp.zeros((L, H), dt)
             blocks["attn_bias"] = jnp.zeros((L, H), dt)
             blocks["mlp_bias"] = jnp.zeros((L, H), dt)
             if cfg.activation != "swiglu" and E == 0:
                 blocks["mlp_up_bias"] = jnp.zeros((L, I), dt)
             params["lnf_bias"] = jnp.zeros((H,), dt)
+        elif cfg.attn_out_bias:
+            blocks["attn_bias"] = jnp.zeros((L, H), dt)
         if cfg.qkv_bias:
             blocks["wq_bias"] = jnp.zeros((L, nh * hd), dt)
             blocks["wk_bias"] = jnp.zeros((L, kvh * hd), dt)
             blocks["wv_bias"] = jnp.zeros((L, kvh * hd), dt)
+        if cfg.embed_layernorm:
+            params["ln_emb_scale"] = jnp.ones((H,), dt)
+            if cfg.norm == "layernorm":
+                params["ln_emb_bias"] = jnp.zeros((H,), dt)
         if cfg.pos_embedding == "learned":
             params["wpe"] = init(k[8], (cfg.max_seq_len, H), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = init(k[9], (H, V), dt)
+            if cfg.lm_head_bias:
+                params["lm_head_bias"] = jnp.zeros((V,), dt)
         return params
 
     # ------------------------------------------------------------------
@@ -302,6 +351,7 @@ class TransformerLM:
         """
         cfg = self.config
         m = self.model_axis
+        single_ln = cfg.parallel_block and cfg.parallel_shared_ln
         specs: Dict[str, Any] = {
             "wte": P(m, None),
             "blocks": {
@@ -310,11 +360,12 @@ class TransformerLM:
                 "wk": P(None, None, m),
                 "wv": P(None, None, m),
                 "wo": P(None, m, None),
-                "ln2_scale": P(None, None),
             },
             "lnf_scale": P(None),
         }
         blocks = specs["blocks"]
+        if not single_ln:
+            blocks["ln2_scale"] = P(None, None)
         if cfg.num_experts > 0:
             # experts over the expert axis, expert-internal dims over model axis
             e = "expert"
@@ -330,20 +381,29 @@ class TransformerLM:
                 blocks["w_gate"] = P(None, None, m)
         if cfg.norm == "layernorm":
             blocks["ln1_bias"] = P(None, None)
-            blocks["ln2_bias"] = P(None, None)
+            if not single_ln:
+                blocks["ln2_bias"] = P(None, None)
             blocks["attn_bias"] = P(None, None)
             blocks["mlp_bias"] = P(None, None)
             if cfg.activation != "swiglu" and cfg.num_experts == 0:
                 blocks["mlp_up_bias"] = P(None, m)
             specs["lnf_bias"] = P(None)
+        elif cfg.attn_out_bias:
+            blocks["attn_bias"] = P(None, None)
         if cfg.qkv_bias:
             blocks["wq_bias"] = P(None, m)
             blocks["wk_bias"] = P(None, m)
             blocks["wv_bias"] = P(None, m)
+        if cfg.embed_layernorm:
+            specs["ln_emb_scale"] = P(None)
+            if cfg.norm == "layernorm":
+                specs["ln_emb_bias"] = P(None)
         if cfg.pos_embedding == "learned":
             specs["wpe"] = P(None, None)
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, m)
+            if cfg.lm_head_bias:
+                specs["lm_head_bias"] = P(m)
         return specs
 
     # ------------------------------------------------------------------
@@ -382,7 +442,15 @@ class TransformerLM:
         kk = kk.reshape(B, S, kvh, hd)
         v = v.reshape(B, S, kvh, hd)
         if cfg.pos_embedding == "rope":
-            q, kk = _rope(q, kk, positions, hd, cfg.rope_theta)
+            q, kk = _rope(q, kk, positions, hd, cfg.rope_theta, cfg.rotary_dim)
+
+        def _alibi_bias(kv_len):
+            # slopes · key-position; equivalent to slopes · (k-q) distance under
+            # softmax's per-query shift invariance. (1, kvh, groups, 1, kv_len)
+            slopes = jnp.asarray(alibi_slopes(nh) * cfg.alibi_slope_scale
+                                 ).reshape(kvh, nh // kvh)
+            kpos = jnp.arange(kv_len, dtype=jnp.float32)
+            return (slopes[..., None, None] * kpos)[None]
 
         new_kv = None
         if kv_cache is not None:
@@ -390,18 +458,20 @@ class TransformerLM:
             ck = jax.lax.dynamic_update_slice(ck, kk.astype(ck.dtype), (0, cache_index, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
             new_kv = (ck, cv)
+            bias = _alibi_bias(ck.shape[1]) if cfg.pos_embedding == "alibi" else None
             attn_out = _attention_op(
                 q, ck, cv, causal=True, q_offset=cache_index,
-                num_kv_groups=nh // kvh, softcap=cfg.logit_softcap,
+                num_kv_groups=nh // kvh, softcap=cfg.logit_softcap, bias=bias,
             )
         else:
             # Ulysses reshard: gather seq, shard heads (no-op when seq axis == 1)
             q = self._constraint(q, self._heads_spec())
             kk = self._constraint(kk, self._heads_spec())
             v = self._constraint(v, self._heads_spec())
+            bias = _alibi_bias(S) if cfg.pos_embedding == "alibi" else None
             attn_out = _attention_op(
                 q, kk, v, causal=True, num_kv_groups=nh // kvh,
-                softcap=cfg.logit_softcap,
+                softcap=cfg.logit_softcap, bias=bias,
             )
         attn_out = attn_out.reshape(B, S, nh * hd)
         attn_out = attn_out @ blk["wo"].astype(h.dtype)
@@ -411,22 +481,29 @@ class TransformerLM:
         if rng is not None:
             rng, r1 = jax.random.split(rng)
             attn_out = _dropout(attn_out, cfg.dropout, r1, train)
-        x = x + attn_out
 
-        h = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.parallel_block:
+            h2 = h if cfg.parallel_shared_ln else _norm(
+                x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+        else:
+            x = x + attn_out
+            h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
         aux = jnp.zeros((), jnp.float32)
         if cfg.num_experts > 0:
-            mlp_out, aux = self._moe_ffn(h, blk, train)
+            mlp_out, aux = self._moe_ffn(h2, blk, train)
         else:
             if cfg.activation == "swiglu":
-                g = h @ blk["w_gate"].astype(h.dtype)
-                u = h @ blk["w_up"].astype(h.dtype)
+                g = h2 @ blk["w_gate"].astype(h.dtype)
+                u = h2 @ blk["w_up"].astype(h.dtype)
                 inter = jax.nn.silu(g) * u
             else:
-                up = h @ blk["w_up"].astype(h.dtype)
+                up = h2 @ blk["w_up"].astype(h.dtype)
                 if "mlp_up_bias" in blk:
                     up = up + blk["mlp_up_bias"].astype(h.dtype)
-                inter = jax.nn.gelu(up, approximate=True)
+                if cfg.activation == "relu":
+                    inter = jax.nn.relu(up)
+                else:
+                    inter = jax.nn.gelu(up, approximate=cfg.activation != "gelu_exact")
             mlp_out = inter @ blk["w_down"].astype(h.dtype)
         if "mlp_bias" in blk:
             mlp_out = mlp_out + blk["mlp_bias"].astype(h.dtype)
@@ -434,6 +511,8 @@ class TransformerLM:
         if rng is not None:
             rng, r2 = jax.random.split(rng)
             mlp_out = _dropout(mlp_out, cfg.dropout, r2, train)
+        if cfg.parallel_block:
+            return x + attn_out + mlp_out, new_kv, aux
         return x + mlp_out, new_kv, aux
 
     def _moe_ffn(self, h, blk, train):
@@ -445,6 +524,7 @@ class TransformerLM:
         return routed_ffn(
             h, blk["moe_wg"], blk["wi"], blk["w_down"], blk.get("w_gate"),
             k=cfg.moe_top_k,
+            drop_tokens=cfg.moe_drop_tokens,
             capacity_factor=cfg.moe_capacity_factor if train else 1.0,
             activation="swiglu" if cfg.activation == "swiglu" else "gelu",
             # batch arrives sharded over the DP axes; inside the expert
@@ -458,6 +538,9 @@ class TransformerLM:
         x = jnp.take(params["wte"], input_ids, axis=0).astype(dtype)
         if cfg.pos_embedding == "learned":
             x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
+        if cfg.embed_layernorm:
+            x = _norm(x, params["ln_emb_scale"], params.get("ln_emb_bias"),
+                      cfg.norm, cfg.norm_eps)
         return x
 
     def _ckpt(self, fn):
@@ -508,7 +591,10 @@ class TransformerLM:
         cfg = self.config
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm, cfg.norm_eps)
         w = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
-        return x @ w.astype(x.dtype)  # (B,S,V)
+        out = x @ w.astype(x.dtype)  # (B,S,V)
+        if "lm_head_bias" in params:
+            out = out + params["lm_head_bias"].astype(x.dtype)
+        return out
 
     # ------------------------------------------------------------------
     def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None,
