@@ -311,6 +311,7 @@ def from_hf_gptneox(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     hd = H // nh
     r = int(hd * hf_cfg.rotary_pct)
     V = hf_cfg.vocab_size
+    attn_bias = bool(getattr(hf_cfg, "attention_bias", True))
     cfg = TransformerConfig(
         vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
         intermediate_size=hf_cfg.intermediate_size,
@@ -318,7 +319,8 @@ def from_hf_gptneox(model) -> Tuple[TransformerLM, Dict[str, Any]]:
         pos_embedding="rope", rotary_dim=r,
         rope_theta=float(getattr(hf_cfg, "rotary_emb_base", 10000.0)),
         norm="layernorm", norm_eps=hf_cfg.layer_norm_eps,
-        activation=_act(hf_cfg.hidden_act), tie_embeddings=False, qkv_bias=True,
+        activation=_act(hf_cfg.hidden_act), tie_embeddings=False,
+        qkv_bias=attn_bias,
         parallel_block=bool(hf_cfg.use_parallel_residual),
         parallel_shared_ln=False, name="gptneox-hf",
     )
@@ -335,11 +337,9 @@ def from_hf_gptneox(model) -> Tuple[TransformerLM, Dict[str, Any]]:
             "wq": jnp.asarray(np.stack([w[0] for w, _ in qkv])),
             "wk": jnp.asarray(np.stack([w[1] for w, _ in qkv])),
             "wv": jnp.asarray(np.stack([w[2] for w, _ in qkv])),
-            "wq_bias": jnp.asarray(np.stack([b[0] for _, b in qkv])),
-            "wk_bias": jnp.asarray(np.stack([b[1] for _, b in qkv])),
-            "wv_bias": jnp.asarray(np.stack([b[2] for _, b in qkv])),
             "wo": _stackT(sd, pre + ".attention.dense.weight", L),
-            "attn_bias": _stack(sd, pre + ".attention.dense.bias", L),
+            "attn_bias": (_stack(sd, pre + ".attention.dense.bias", L)
+                          if attn_bias else jnp.zeros((L, H), jnp.float32)),
             "w_up": _stackT(sd, pre + ".mlp.dense_h_to_4h.weight", L),
             "mlp_up_bias": _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L),
             "w_down": _stackT(sd, pre + ".mlp.dense_4h_to_h.weight", L),
@@ -349,6 +349,11 @@ def from_hf_gptneox(model) -> Tuple[TransformerLM, Dict[str, Any]]:
         "lnf_bias": jnp.asarray(sd["gpt_neox.final_layer_norm.bias"]),
         "lm_head": jnp.asarray(sd["embed_out.weight"].T),
     }
+    if attn_bias:
+        blocks = params["blocks"]
+        blocks["wq_bias"] = jnp.asarray(np.stack([b[0] for _, b in qkv]))
+        blocks["wk_bias"] = jnp.asarray(np.stack([b[1] for _, b in qkv]))
+        blocks["wv_bias"] = jnp.asarray(np.stack([b[2] for _, b in qkv]))
     log_dist(f"converted HF GPT-NeoX: H={H} L={L} heads={nh} rotary={r} "
              f"parallel={cfg.parallel_block}", ranks=[0])
     return TransformerLM(cfg), params
@@ -441,30 +446,28 @@ def from_hf_falcon(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     ratio = nh // kvh
 
     def split_qkv(i):
+        """→ ((wq, wk, wv), biases-or-None) for one layer."""
         if not (new_arch or multi_query):  # classic per-head [q;k;v]
             return _split_fused_qkv(
-                sd, pre.format(i) + ".self_attention.query_key_value", nh, hd)[0]
+                sd, pre.format(i) + ".self_attention.query_key_value", nh, hd)
         # grouped: (kvh, ratio+2, hd, H) — q rows kv-major, matching our GQA order
         w = sd[pre.format(i) + ".self_attention.query_key_value.weight"]
         wh = w.reshape(kvh, ratio + 2, hd, H)
-        return (wh[:, :ratio].reshape(nh * hd, H).T,
-                wh[:, ratio].reshape(kvh * hd, H).T,
-                wh[:, ratio + 1].reshape(kvh * hd, H).T)
-
-    def split_qkv_bias(i):
-        if not (new_arch or multi_query):
-            return _split_fused_qkv(
-                sd, pre.format(i) + ".self_attention.query_key_value", nh, hd)[1]
-        b = sd[pre.format(i) + ".self_attention.query_key_value.bias"]
+        ws = (wh[:, :ratio].reshape(nh * hd, H).T,
+              wh[:, ratio].reshape(kvh * hd, H).T,
+              wh[:, ratio + 1].reshape(kvh * hd, H).T)
+        b = sd.get(pre.format(i) + ".self_attention.query_key_value.bias")
+        if b is None:
+            return ws, None
         bh = b.reshape(kvh, ratio + 2, hd)
-        return (bh[:, :ratio].reshape(-1), bh[:, ratio].reshape(-1),
-                bh[:, ratio + 1].reshape(-1))
+        return ws, (bh[:, :ratio].reshape(-1), bh[:, ratio].reshape(-1),
+                    bh[:, ratio + 1].reshape(-1))
 
     qkv = [split_qkv(i) for i in range(L)]
     blocks = {
-        "wq": jnp.asarray(np.stack([q for q, _, _ in qkv])),
-        "wk": jnp.asarray(np.stack([k for _, k, _ in qkv])),
-        "wv": jnp.asarray(np.stack([v for _, _, v in qkv])),
+        "wq": jnp.asarray(np.stack([w[0] for w, _ in qkv])),
+        "wk": jnp.asarray(np.stack([w[1] for w, _ in qkv])),
+        "wv": jnp.asarray(np.stack([w[2] for w, _ in qkv])),
         "wo": _stackT(sd, pre + ".self_attention.dense.weight", L),
         "w_up": _stackT(sd, pre + ".mlp.dense_h_to_4h.weight", L),
         "w_down": _stackT(sd, pre + ".mlp.dense_4h_to_h.weight", L),
@@ -481,10 +484,9 @@ def from_hf_falcon(model) -> Tuple[TransformerLM, Dict[str, Any]]:
             blocks["ln2_scale"] = _stack(sd, pre + ".post_attention_layernorm.weight", L)
             blocks["ln2_bias"] = _stack(sd, pre + ".post_attention_layernorm.bias", L)
     if has_bias:
-        qkvb = [split_qkv_bias(i) for i in range(L)]
-        blocks["wq_bias"] = jnp.asarray(np.stack([b[0] for b in qkvb]))
-        blocks["wk_bias"] = jnp.asarray(np.stack([b[1] for b in qkvb]))
-        blocks["wv_bias"] = jnp.asarray(np.stack([b[2] for b in qkvb]))
+        blocks["wq_bias"] = jnp.asarray(np.stack([b[0] for _, b in qkv]))
+        blocks["wk_bias"] = jnp.asarray(np.stack([b[1] for _, b in qkv]))
+        blocks["wv_bias"] = jnp.asarray(np.stack([b[2] for _, b in qkv]))
         blocks["attn_bias"] = _stack(sd, pre + ".self_attention.dense.bias", L)
         blocks["mlp_up_bias"] = _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L)
         blocks["mlp_bias"] = _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L)

@@ -271,6 +271,31 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
+        # ---- ZeRO++ qgZ validation (zero/zeropp.py) ----
+        self._qgz_enabled = bool(config.zero_config.zero_quantized_gradients)
+        if self._qgz_enabled:
+            tp_like = [a for a in ("model", "seq", "pipe", "expert")
+                       if topo.get_dim(a) > 1]
+            if stage >= 3 or tp_like:
+                raise ValueError(
+                    "zero_quantized_gradients rides the explicit-collective "
+                    "shard_map path (replicated lp params over pure DP axes): "
+                    "requires stage<=2 and no model/seq/pipe/expert axes "
+                    f"(got stage={stage}, axes={tp_like}). For stage-3 gather "
+                    "compression use zero_quantized_weights (qwZ)."
+                )
+            if config.optimizer_name in ("onebitadam", "zerooneadam", "onebitlamb"):
+                raise ValueError(
+                    "zero_quantized_gradients and 1-bit optimizers both own the "
+                    "gradient reduction — enable one or the other"
+                )
+            if self._compression is not None:
+                raise ValueError(
+                    "zero_quantized_gradients and compression (QAT) cannot be "
+                    "combined: the qgZ fwd/bwd path bypasses the compression "
+                    "schedule's fake-quant forward"
+                )
+
         # ---- compiled fns ----
         self._build_compiled_fns()
 
@@ -312,6 +337,15 @@ class DeepSpeedEngine:
         # pipeline engines consume all microbatches in ONE apply → no loss division
         gas = getattr(self, "_gas_divisor", cfg.gradient_accumulation_steps)
         apply_fn = self._apply_fn
+
+        # ZeRO++ qwZ: stage-3 parameter gathers move int8 codes instead of
+        # bf16/fp32 (zero/zeropp.py; reference zero_quantized_weights)
+        self._qwz = None
+        if self.zero_stage >= 3 and cfg.zero_config.zero_quantized_weights:
+            from .zero.zeropp import make_qwz_transform
+
+            self._qwz = make_qwz_transform(self._param_specs, self.topology)
+        qwz = self._qwz
         # prescale_gradients / gradient_predivide_factor order pre- vs post-divide
         # around the reference's allreduce; here the DP average is a single mean
         # over the global batch inside one compiled program, so both orderings are
@@ -328,6 +362,8 @@ class DeepSpeedEngine:
                 rng = jax.random.fold_in(base_rng, step_idx)
 
                 def loss_fn(p):
+                    if qwz is not None:
+                        p = qwz(p)
                     if comp_key is not None and comp_key[0]:
                         from ..compression.compress import compress_params
 
@@ -409,6 +445,8 @@ class DeepSpeedEngine:
             rng = jax.random.fold_in(base_rng, step_idx)
 
             def loss_fn(p):
+                if qwz is not None:
+                    p = qwz(p)
                 out = apply_fn(p, batch, train=True, rng=rng)
                 loss = self._loss_of(out)
                 return loss.astype(jnp.float32) * scaler_state.cur_scale, loss
@@ -432,6 +470,21 @@ class DeepSpeedEngine:
             )
         else:
             self._fused_step_fn = None
+
+    # ------------------------------------------------------------------
+    # explicit-collective (shard_map) gradient paths: 1-bit EF and ZeRO++ qgZ
+    # ------------------------------------------------------------------
+    def _dp_shardmap_batch_specs(self, batch, axes):
+        """Mirror ``_shard_batch``: leaves whose dim 0 divides the DP degree
+        are split over the axes; scalars / non-divisible leaves replicate
+        (e.g. the injected ``pld_theta`` scalar)."""
+        from jax.sharding import PartitionSpec as P
+
+        dpn = int(np.prod([self.topology.get_dim(a) for a in axes]))
+        return jax.tree.map(
+            lambda x: P(axes) if (getattr(x, "ndim", 0) >= 1
+                                  and x.shape[0] % dpn == 0) else P(),
+            batch)
 
     # ------------------------------------------------------------------
     # 1-bit optimizers: error-feedback sign-compressed gradient allreduce
@@ -491,7 +544,7 @@ class DeepSpeedEngine:
                 return jax.lax.pmean(loss, axes), red, new_err
 
             param_specs = jax.tree.map(lambda _: P(), self.params)
-            batch_spec_ = jax.tree.map(lambda _: P(axes), batch)
+            batch_spec_ = self._dp_shardmap_batch_specs(batch, axes)
             err_spec = jax.tree.map(lambda _: P(axes), self.params)
             self._onebit_fn = jax.jit(jax.shard_map(
                 body, mesh=topo.mesh,
@@ -512,6 +565,61 @@ class DeepSpeedEngine:
             jnp.asarray(self.micro_steps, jnp.int32),
         )
         return loss, grads
+
+    # ------------------------------------------------------------------
+    # ZeRO++ qgZ: int8 block-quantized gradient reduction over the DP axes
+    # (reference runtime/comm/coalesced_collectives.py all_to_all_quant_reduce;
+    # zero/zeropp.py quantized_grad_reduce_tree)
+    # ------------------------------------------------------------------
+    def _qgz_active(self) -> bool:
+        if not getattr(self, "_qgz_enabled", False):
+            return False
+        from ..comm.topology import ZERO_AXES
+
+        return any(self.topology.get_dim(a) > 1 for a in ZERO_AXES)
+
+    def _qgz_fwd_bwd(self, batch):
+        """Local grads under shard_map over the DP axes + quantized reduce."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..comm.topology import ZERO_AXES
+        from .zero.zeropp import quantized_grad_reduce_tree
+
+        topo = self.topology
+        axes = tuple(a for a in ZERO_AXES if topo.get_dim(a) > 1)
+        dpn = int(np.prod([topo.get_dim(a) for a in axes]))
+
+        if getattr(self, "_qgz_fn", None) is None:
+            apply_fn = self._apply_fn
+            base_rng = self._rng
+            gas = getattr(self, "_gas_divisor", self.config.gradient_accumulation_steps)
+
+            def body(lp, batch_local, scale, step_idx):
+                rng = jax.random.fold_in(base_rng, step_idx)
+
+                def loss_fn(p):
+                    out = apply_fn(p, batch_local, train=True, rng=rng)
+                    loss = self._loss_of(out)
+                    return loss.astype(jnp.float32) * scale / gas, loss
+
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp)
+                red = quantized_grad_reduce_tree(grads, axes, dpn)
+                return jax.lax.pmean(loss, axes), red
+
+            param_specs = jax.tree.map(lambda _: P(), self.params)
+            batch_spec_ = self._dp_shardmap_batch_specs(batch, axes)
+            # check_vma off: the quantized reduce ends in an all_gather whose
+            # replication the static checker cannot infer
+            self._qgz_fn = jax.jit(jax.shard_map(
+                body, mesh=topo.mesh,
+                in_specs=(param_specs, batch_spec_, P(), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P(), self.params)),
+                axis_names=set(axes), check_vma=False,
+            ))
+        return self._qgz_fn(
+            self.params, batch, self.scaler_state.cur_scale,
+            jnp.asarray(self.micro_steps, jnp.int32),
+        )
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / Offload++ / ZeRO-Infinity (reference stage_1_and_2.py
@@ -693,6 +801,8 @@ class DeepSpeedEngine:
                 fwd_bwd = self._fwd_bwd_variants[key] = self._make_fwd_bwd(key)
         if self._onebit_active():
             loss, grads = self._onebit_fwd_bwd(batch)
+        elif self._qgz_active():
+            loss, grads = self._qgz_fwd_bwd(batch)
         else:
             loss, grads = fwd_bwd(
                 self.params, batch, self.scaler_state.cur_scale,
@@ -824,7 +934,7 @@ class DeepSpeedEngine:
         if (self.config.gradient_accumulation_steps == 1
                 and self._fused_step_fn is not None
                 and self._offload_mgr is None and self._compression is None
-                and not self._onebit_active()
+                and not self._onebit_active() and not self._qgz_active()
                 and getattr(self, "_training", True)):
             loss = self._fused_micro_step(next(it))
             self.tput_timer.stop(global_step=True)

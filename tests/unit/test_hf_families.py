@@ -74,6 +74,16 @@ class TestHFFamilies:
         m = _parity(hf, 100)
         assert not m.config.parallel_block
 
+    def test_gptneox_no_attention_bias(self):
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        hf = GPTNeoXForCausalLM(GPTNeoXConfig(
+            vocab_size=100, hidden_size=64, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+            max_position_embeddings=64, attention_bias=False))
+        m = _parity(hf, 100)
+        assert not m.config.qkv_bias
+
     def test_bloom_alibi(self):
         from transformers import BloomConfig, BloomForCausalLM
 
