@@ -73,21 +73,36 @@ class InferenceEngine:
                 params = model.params
             else:
                 params = model.init_params(jax.random.PRNGKey(0))
+
+        def cast(path, a):
+            # weight-only-quantized leaves (ops/quantizer/woq.py) keep their
+            # storage dtype: int8 codes, fp32 group scales
+            a = jnp.asarray(a)
+            if jnp.issubdtype(a.dtype, jnp.integer):
+                return a
+            key = getattr(path[-1], "key", "") if path else ""
+            if isinstance(key, str) and key.endswith("::scale"):
+                return a
+            return a.astype(dtype)
+
         # TP placement: model-axis sharding from the model's specs (AutoTP analogue)
         tp_specs = getattr(model, "tp_specs", None)
+        quantized = isinstance(params, dict) and any(
+            "::q" in k for k in params.get("blocks", {}))
+        if quantized and tp_specs is not None:
+            from ..ops.quantizer.woq import quantized_tp_specs
+
+            tp_specs = quantized_tp_specs(tp_specs, params)
+        params = jax.tree_util.tree_map_with_path(cast, params)
+
         if tp_specs is not None:
             shardings = jax.tree.map(
                 lambda s: NamedSharding(self._mesh, s), tp_specs,
                 is_leaf=lambda s: isinstance(s, P),
             )
-            self.params = jax.device_put(
-                jax.tree.map(lambda a: jnp.asarray(a, dtype), params), shardings
-            )
+            self.params = jax.device_put(params, shardings)
         else:
-            self.params = jax.device_put(
-                jax.tree.map(lambda a: jnp.asarray(a, dtype), params),
-                NamedSharding(self._mesh, P()),
-            )
+            self.params = jax.device_put(params, NamedSharding(self._mesh, P()))
         self._decode_fns = {}
         log_dist(
             f"InferenceEngine: dtype={dtype.__name__} tp={self.topology.model_parallel_size}",
@@ -163,8 +178,13 @@ class InferenceEngine:
     __call__ = forward
 
 
-def init_inference(model, config=None, **kwargs) -> InferenceEngine:
-    """Build an inference engine (reference ``deepspeed/__init__.py:273``)."""
+def init_inference(model, config=None, params=None, **kwargs) -> InferenceEngine:
+    """Build an inference engine (reference ``deepspeed/__init__.py:273``).
+
+    ``params`` overrides the model's own parameters — e.g. a converted HF
+    checkpoint or a weight-only-quantized tree from
+    ``inference.quantization.quantize_model``.
+    """
     if config is None:
         config = DeepSpeedInferenceConfig.from_dict(kwargs)
     elif isinstance(config, dict):
@@ -184,4 +204,4 @@ def init_inference(model, config=None, **kwargs) -> InferenceEngine:
     topo = get_topology(required=False)
     if tp > 1 and (topo is None or topo.model_parallel_size != tp):
         topo = initialize_topology(model=tp)
-    return InferenceEngine(model, config, topology=topo)
+    return InferenceEngine(model, config, params=params, topology=topo)

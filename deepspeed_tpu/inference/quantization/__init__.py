@@ -1,0 +1,33 @@
+"""Post-training weight-only quantization for inference.
+
+Reference: ``deepspeed/inference/quantization/quantization.py`` (group-wise
+4/8-bit weight quantization applied to a built model post-init) and the FP6
+weight-only GEMM path (``inference/v2/kernels/core_ops/cuda_linear``). The op
+layer lives in ``ops/quantizer/woq.py``; this module is the user-facing API.
+
+Usage::
+
+    model, params = from_hf(hf_model)
+    model, qparams = quantize_model(model, params, num_bits=4)
+    engine = deepspeed_tpu.init_inference(model, params=qparams, dtype="bf16")
+"""
+
+from ...ops.quantizer.woq import (  # noqa: F401
+    DEFAULT_TARGETS,
+    dequant_params,
+    quantize_param_tree,
+    quantized_tp_specs,
+)
+
+
+def quantize_model(model, params, num_bits: int = 8, group_size: int = 128,
+                   targets=DEFAULT_TARGETS):
+    """Quantize a ``TransformerLM``'s matmul weights for serving.
+
+    Returns ``(model, quantized_params)`` — the model is unchanged (its blocks
+    dequantize ``::q4``/``::q8`` leaves transparently); pass the quantized tree
+    to ``init_inference(model, params=...)`` or use it directly with
+    ``model.logits``/``forward_with_cache``.
+    """
+    return model, quantize_param_tree(params, num_bits=num_bits,
+                                      group_size=group_size, targets=targets)

@@ -53,7 +53,18 @@ class InferenceEngineV2:
         self.dtype = dtype
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
-        self.params = jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+        def cast(path, a):
+            # keep weight-only-quantized leaves in their storage dtype
+            # (int8 codes / fp32 group scales — ops/quantizer/woq.py)
+            a = jnp.asarray(a)
+            key = getattr(path[-1], "key", "") if path else ""
+            if jnp.issubdtype(a.dtype, jnp.integer) or (
+                    isinstance(key, str) and key.endswith("::scale")):
+                return a
+            return a.astype(dtype)
+
+        self.params = jax.tree_util.tree_map_with_path(cast, params)
         self.state = DSStateManager(max_seqs, self.max_seq_len)
         # slot-pooled KV cache: (L, max_seqs, T, kvh, hd)
         self.kv = model.init_kv_cache(max_seqs, self.max_seq_len, dtype=dtype)

@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..comm.topology import ZERO_AXES
+from ..ops.quantizer.woq import dequant_params as _dequant_woq
 from ..ops.transformer.attention import attention as _attention_op
 
 
@@ -429,6 +430,9 @@ class TransformerLM:
         cfg = self.config
         nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         B, S, H = x.shape
+        # weight-only-quantized params (ops/quantizer/woq.py): dequant this
+        # layer's slice only — XLA fuses the dequant into the matmul reads
+        blk = _dequant_woq(blk, x.dtype)
 
         h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
         q = h @ blk["wq"].astype(h.dtype)
