@@ -78,6 +78,12 @@ class TransformerConfig:
     # progressive layer drop (PLD): stochastic depth driven by a per-step theta
     # injected as batch["pld_theta"] (reference progressive_layer_drop.py)
     progressive_layer_drop: bool = False
+    # random-LTD: middle layers process a random token subset of scheduled size,
+    # injected as a STATIC int batch["ltd_keep"] by the engine (reference
+    # data_routing/basic_layer.py RandomLayerTokenDrop); first/last
+    # ``random_ltd_skip_ends`` layers always see the full sequence
+    random_ltd: bool = False
+    random_ltd_skip_ends: int = 1
     # training knobs
     remat: bool = False  # per-block activation rematerialisation
     remat_policy: str = "full"  # "full" (min memory) | "dots" (save matmul outputs, faster)
@@ -448,13 +454,17 @@ class TransformerLM:
         if cfg.pos_embedding == "rope":
             q, kk = _rope(q, kk, positions, hd, cfg.rope_theta, cfg.rotary_dim)
 
-        def _alibi_bias(kv_len):
+        def _alibi_bias(kpos):
             # slopes · key-position; equivalent to slopes · (k-q) distance under
-            # softmax's per-query shift invariance. (1, kvh, groups, 1, kv_len)
+            # softmax's per-query shift invariance. kpos (Skv,) → bias
+            # (1, kvh, groups, 1, Skv), or (B, Skv) → (B, kvh, groups, 1, Skv)
+            # (random-LTD passes the kept tokens' ORIGINAL positions per batch)
             slopes = jnp.asarray(alibi_slopes(nh) * cfg.alibi_slope_scale
                                  ).reshape(kvh, nh // kvh)
-            kpos = jnp.arange(kv_len, dtype=jnp.float32)
-            return (slopes[..., None, None] * kpos)[None]
+            kpos = kpos.astype(jnp.float32)
+            if kpos.ndim == 1:
+                kpos = kpos[None]
+            return kpos[:, None, None, None, :] * slopes[None, :, :, None, None]
 
         new_kv = None
         if kv_cache is not None:
@@ -462,7 +472,8 @@ class TransformerLM:
             ck = jax.lax.dynamic_update_slice(ck, kk.astype(ck.dtype), (0, cache_index, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
             new_kv = (ck, cv)
-            bias = _alibi_bias(ck.shape[1]) if cfg.pos_embedding == "alibi" else None
+            bias = (_alibi_bias(jnp.arange(ck.shape[1]))
+                    if cfg.pos_embedding == "alibi" else None)
             attn_out = _attention_op(
                 q, ck, cv, causal=True, q_offset=cache_index,
                 num_kv_groups=nh // kvh, softcap=cfg.logit_softcap, bias=bias,
@@ -472,7 +483,7 @@ class TransformerLM:
             q = self._constraint(q, self._heads_spec())
             kk = self._constraint(kk, self._heads_spec())
             v = self._constraint(v, self._heads_spec())
-            bias = _alibi_bias(S) if cfg.pos_embedding == "alibi" else None
+            bias = _alibi_bias(positions) if cfg.pos_embedding == "alibi" else None
             attn_out = _attention_op(
                 q, kk, v, causal=True, num_kv_groups=nh // kvh,
                 softcap=cfg.logit_softcap, bias=bias,
@@ -591,6 +602,55 @@ class TransformerLM:
             x, auxes = jax.lax.scan(block_fn, x, params["blocks"])
         return x, jnp.sum(auxes)
 
+    def _trunk_ltd(self, params, x, positions, rng, keep: int):
+        """Random-LTD trunk (reference ``data_routing/basic_layer.py``): the
+        first/last ``skip_ends`` layers run full-sequence (unrolled); the
+        middle layers run under ``lax.scan`` on a random ``keep``-token subset
+        each (uniform static shapes across the scan)."""
+        from ..runtime.data_pipeline.data_routing import random_ltd_block
+
+        cfg = self.config
+        L, skip = cfg.num_layers, cfg.random_ltd_skip_ends
+        use_drop = cfg.dropout > 0
+        rngs = jax.random.split(rng, L)  # rng is never None here (_logits_aux)
+        aux_total = jnp.zeros((), jnp.float32)
+
+        def run_full(h, i):
+            blk = jax.tree.map(lambda a: a[i], params["blocks"])
+            r = rngs[i] if use_drop else None
+            y, _, aux = self._block(h, blk, positions=positions, rng=r, train=True)
+            return y, aux
+
+        for i in range(skip):
+            x, aux = run_full(x, i)
+            aux_total = aux_total + aux
+
+        if skip < L - skip:
+            mid = jax.tree.map(lambda a: a[skip:L - skip], params["blocks"])
+            mid_rngs = rngs[skip:L - skip]
+
+            def body(h, layer):
+                blk, r = layer
+                r_drop, r_ltd = jax.random.split(r)
+
+                def fn(hs, ps):
+                    y, _, aux = self._block(
+                        hs, blk, positions=ps,
+                        rng=r_drop if use_drop else None, train=True)
+                    return y, aux
+
+                return random_ltd_block(fn, h, positions, keep, r_ltd)
+
+            block_fn = self._ckpt(body) if cfg.remat else body
+            x, auxes = jax.lax.scan(block_fn, x, (mid, mid_rngs))
+            aux_total = aux_total + jnp.sum(auxes)
+
+        # max() guards tiny models where 2*skip > L — never run a layer twice
+        for i in range(max(skip, L - skip), L):
+            x, aux = run_full(x, i)
+            aux_total = aux_total + aux
+        return x, aux_total
+
     def _head(self, params, x):
         cfg = self.config
         x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm, cfg.norm_eps)
@@ -602,14 +662,26 @@ class TransformerLM:
 
     # ------------------------------------------------------------------
     def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None,
-                    pld_theta=None):
+                    pld_theta=None, ltd_keep=None):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-        dtype = jax.tree.leaves(params)[0].dtype
+        # first floating leaf decides compute dtype (skips int8 WOQ codes)
+        dtype = next(
+            (l.dtype for l in jax.tree.leaves(params)
+             if jnp.issubdtype(l.dtype, jnp.floating)), jnp.float32)
         x = self._embed(params, input_ids, positions, dtype)
         x = self._constraint(x, self._act_spec(True))
-        x, aux = self._trunk(params, x, positions, rng, train, pld_theta=pld_theta)
+        if ltd_keep is not None and train:
+            if pld_theta is not None:
+                raise ValueError(
+                    "random-LTD and progressive layer drop cannot be combined "
+                    "(the LTD trunk has no stochastic-depth path)")
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            x, aux = self._trunk_ltd(params, x, positions, rng, int(ltd_keep))
+        else:
+            x, aux = self._trunk(params, x, positions, rng, train, pld_theta=pld_theta)
         return self._head(params, x), aux
 
     def logits(self, params, input_ids, positions=None, train=False, rng=None):
@@ -623,12 +695,16 @@ class TransformerLM:
         or an (input_ids, labels) tuple.
         """
         pld_theta = None
+        ltd_keep = None
         if isinstance(batch, dict):
             input_ids = batch["input_ids"]
             labels = batch.get("labels")
             positions = batch.get("positions")
             if self.config.progressive_layer_drop:
                 pld_theta = batch.get("pld_theta")
+            if self.config.random_ltd:
+                # static python int injected by the engine's variant machinery
+                ltd_keep = batch.get("ltd_keep")
         elif isinstance(batch, (tuple, list)):
             input_ids, labels = batch
             positions = None
@@ -636,7 +712,8 @@ class TransformerLM:
             input_ids, labels, positions = batch, None, None
 
         lg, aux = self._logits_aux(params, input_ids, positions=positions,
-                                   train=train, rng=rng, pld_theta=pld_theta)
+                                   train=train, rng=rng, pld_theta=pld_theta,
+                                   ltd_keep=ltd_keep)
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1

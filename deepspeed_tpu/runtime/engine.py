@@ -175,6 +175,54 @@ class DeepSpeedEngine:
                     "silently ignored"
                 )
 
+        # ---- random-LTD (reference data_pipeline/data_routing: middle layers
+        # process a scheduled-size random token subset; the kept count is a
+        # STATIC int, so each quantized schedule value gets its own jit variant
+        # like the compression schedule) ----
+        self._ltd_scheduler = None
+        routing = (config.data_efficiency_config or {}).get("data_routing", {})
+        ltd_cfg = routing.get("random_ltd", {})
+        if routing.get("enabled") and ltd_cfg.get("enabled"):
+            from .data_pipeline.data_routing import RandomLTDScheduler
+
+            mc = getattr(model, "config", None)
+            if (mc is not None and hasattr(mc, "random_ltd")
+                    and not mc.random_ltd):
+                raise ValueError(
+                    "random_ltd is enabled in the ds_config but the model was "
+                    "built without TransformerConfig(random_ltd=True) — the "
+                    "injected ltd_keep would be silently ignored"
+                )
+            pld_cfg_ = config.progressive_layer_drop
+            if pld_cfg_ and pld_cfg_.get("enabled"):
+                raise ValueError(
+                    "random_ltd and progressive_layer_drop cannot be combined: "
+                    "the LTD trunk has no stochastic-depth path, so PLD would "
+                    "be silently ignored"
+                )
+            if config.optimizer_name in ("onebitadam", "zerooneadam", "onebitlamb") \
+                    or config.zero_config.zero_quantized_gradients:
+                raise ValueError(
+                    "random_ltd uses schedule-keyed jit variants of the standard "
+                    "fwd/bwd; the 1-bit / zero_quantized_gradients shard_map "
+                    "paths bypass them, so LTD would be silently ignored"
+                )
+            sched = ltd_cfg.get("random_ltd_schedule", {})
+            sc = sched.get("schedule_config", {})
+            seq_len = int(sched.get("max_value")
+                          or getattr(mc, "max_seq_len", 0) or 0)
+            if seq_len <= 0:
+                raise ValueError("random_ltd needs random_ltd_schedule."
+                                 "max_value or a model config max_seq_len")
+            self._ltd_scheduler = RandomLTDScheduler(
+                total_layers=int(ltd_cfg.get("total_layer_num")
+                                 or getattr(mc, "num_layers", 0) or 0),
+                start_length=int(sched.get("min_value", 128)),
+                seq_length=seq_len,
+                schedule_steps=int(sc.get("require_steps", 1000)),
+                increment=int(sc.get("seq_per_step", 16)),
+            )
+
         # ---- sharding rules per ZeRO stage ----
         stage = config.zero_config.stage
         self.zero_stage = stage
@@ -353,9 +401,10 @@ class DeepSpeedEngine:
 
         base_rng = self._rng
 
-        def make_fwd_bwd(comp_key):
-            """comp_key: None, or (active, bits) compression schedule state —
-            a new jit variant per state keeps the schedule effective under jit."""
+        def make_fwd_bwd(comp_key, ltd_keep=None):
+            """comp_key: None, or (active, bits) compression schedule state;
+            ltd_keep: None, or the static random-LTD kept-token count — a new
+            jit variant per state keeps the schedules effective under jit."""
 
             def fwd_bwd(lp_params, batch, scale, step_idx):
                 # per-micro-step rng derived on device (no host-side split dispatch)
@@ -368,7 +417,10 @@ class DeepSpeedEngine:
                         from ..compression.compress import compress_params
 
                         p = compress_params(p, self._compression, num_bits=comp_key[1])
-                    out = apply_fn(p, batch, train=True, rng=rng)
+                    b = batch
+                    if ltd_keep is not None and isinstance(batch, dict):
+                        b = dict(batch, ltd_keep=ltd_keep)
+                    out = apply_fn(p, b, train=True, rng=rng)
                     loss = self._loss_of(out)
                     scaled = loss.astype(jnp.float32) * scale / gas
                     return scaled, loss
@@ -794,11 +846,16 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).stop()
             return loss
         fwd_bwd = self._fwd_bwd
+        comp_key = None
         if self._compression is not None:
-            key = (self._compression.active(), self._compression.weight_bits())
-            fwd_bwd = self._fwd_bwd_variants.get(key)
+            comp_key = (self._compression.active(), self._compression.weight_bits())
+        ltd_keep = self._ltd_keep_now()
+        if comp_key is not None or ltd_keep is not None:
+            vkey = (comp_key, ltd_keep)
+            fwd_bwd = self._fwd_bwd_variants.get(vkey)
             if fwd_bwd is None:
-                fwd_bwd = self._fwd_bwd_variants[key] = self._make_fwd_bwd(key)
+                fwd_bwd = self._fwd_bwd_variants[vkey] = self._make_fwd_bwd(
+                    comp_key, ltd_keep)
         if self._onebit_active():
             loss, grads = self._onebit_fwd_bwd(batch)
         elif self._qgz_active():
@@ -811,6 +868,14 @@ class DeepSpeedEngine:
         self._cached = (loss, grads)
         self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
+
+    def _ltd_keep_now(self):
+        """Current random-LTD kept-token count (None = full sequence)."""
+        s = self._ltd_scheduler
+        if s is None or not getattr(self, "_training", True):
+            return None
+        keep = s.update(self.global_steps)
+        return None if keep >= s.full else int(keep)
 
     def backward(self, loss=None, retain_graph: bool = False):
         """Fold the cached gradients into the accumulation buffer. With
@@ -934,6 +999,7 @@ class DeepSpeedEngine:
         if (self.config.gradient_accumulation_steps == 1
                 and self._fused_step_fn is not None
                 and self._offload_mgr is None and self._compression is None
+                and self._ltd_keep_now() is None
                 and not self._onebit_active() and not self._qgz_active()
                 and getattr(self, "_training", True)):
             loss = self._fused_micro_step(next(it))
