@@ -75,7 +75,16 @@ def init_distributed(
     n_expected = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
     if n_expected > 1 and jax.process_count() == 1:
         try:
-            jax.distributed.initialize()
+            # ssh/pdsh path: explicit coordinator env from the launcher;
+            # SLURM/OMPI/TPU-pod envs are auto-detected by JAX
+            kw = {}
+            if "COORDINATOR_ADDRESS" in os.environ and "DSTPU_PROCESS_ID" in os.environ:
+                kw = dict(
+                    coordinator_address=os.environ["COORDINATOR_ADDRESS"],
+                    num_processes=n_expected,
+                    process_id=int(os.environ["DSTPU_PROCESS_ID"]),
+                )
+            jax.distributed.initialize(**kw)
             if verbose:
                 logger.info(
                     f"Initialized JAX distributed: process {jax.process_index()}/{jax.process_count()}"

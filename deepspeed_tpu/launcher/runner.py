@@ -38,6 +38,13 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--no_ssh_check", action="store_true")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        help="multi-node backend: ssh (default), pdsh, openmpi, "
+                        "mpich, impi, slurm, mvapich "
+                        "(reference multinode_runner.py)")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra flags passed through to the backend")
+    parser.add_argument("--slurm_comment", type=str, default="")
     parser.add_argument("user_script", type=str, help="training script to launch")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args=args)
@@ -104,6 +111,17 @@ def encode_world_info(world_info: dict) -> str:
     return base64.urlsafe_b64encode(json.dumps(world_info).encode()).decode()
 
 
+def _run_and_exit(cmd, env):
+    """Launch one command, forward SIGINT, exit with its return code."""
+    result = subprocess.Popen(cmd, env=env)
+    try:
+        result.wait()
+    except KeyboardInterrupt:
+        result.send_signal(signal.SIGINT)
+        result.wait()
+    sys.exit(result.returncode)
+
+
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
@@ -115,21 +133,32 @@ def main(args=None):
         # single-host: exec in place, one controller process for all local chips
         env.setdefault("DSTPU_NUM_PROCESSES", "1")
         logger.info(f"launching (single host): {' '.join(map(shlex.quote, cmd))}")
-        result = subprocess.Popen(cmd, env=env)
-        try:
-            result.wait()
-        except KeyboardInterrupt:
-            result.send_signal(signal.SIGINT)
-            result.wait()
-        sys.exit(result.returncode)
+        _run_and_exit(cmd, env)
 
-    # multi-host: one process per host over ssh, coordinator = first host
+    # multi-host: one process per host, coordinator = first host
     active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
     hosts = list(active.keys())
     if args.num_nodes > 0:
         hosts = hosts[: args.num_nodes]
     master_addr = args.master_addr or hosts[0]
     world_info = encode_world_info({h: active[h] for h in hosts})
+
+    if args.launcher != "ssh":
+        # backend runners (pdsh/mpi/slurm — reference multinode_runner.py)
+        from .multinode_runner import build_runner
+
+        runner = build_runner(args.launcher, args, world_info)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"launcher backend '{runner.name}' not found on PATH")
+        runner.add_export("DSTPU_NUM_PROCESSES", str(len(hosts)))
+        runner.add_export("COORDINATOR_ADDRESS", f"{master_addr}:{args.master_port}")
+        runner.add_export("DSTPU_WORLD_INFO", world_info)
+        launch_cmd = runner.get_cmd(env, {h: active[h] for h in hosts})
+        if args.launcher_args:
+            launch_cmd = launch_cmd[:1] + shlex.split(args.launcher_args) + launch_cmd[1:]
+        logger.info(f"launching via {runner.name}: {' '.join(launch_cmd)}")
+        _run_and_exit(launch_cmd, env)
 
     procs = []
     for i, host in enumerate(hosts):

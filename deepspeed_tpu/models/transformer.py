@@ -621,7 +621,9 @@ class TransformerLM:
             y, _, aux = self._block(h, blk, positions=positions, rng=r, train=True)
             return y, aux
 
-        for i in range(skip):
+        # min()/max() guards tiny models where 2*skip > L — never run a layer
+        # twice (JAX clamps out-of-range indices silently)
+        for i in range(min(skip, L)):
             x, aux = run_full(x, i)
             aux_total = aux_total + aux
 
@@ -645,7 +647,6 @@ class TransformerLM:
             x, auxes = jax.lax.scan(block_fn, x, (mid, mid_rngs))
             aux_total = aux_total + jnp.sum(auxes)
 
-        # max() guards tiny models where 2*skip > L — never run a layer twice
         for i in range(max(skip, L - skip), L):
             x, aux = run_full(x, i)
             aux_total = aux_total + aux

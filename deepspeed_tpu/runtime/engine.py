@@ -850,6 +850,10 @@ class DeepSpeedEngine:
         if self._compression is not None:
             comp_key = (self._compression.active(), self._compression.weight_bits())
         ltd_keep = self._ltd_keep_now()
+        if ltd_keep is not None and not isinstance(batch, dict):
+            raise ValueError(
+                "random_ltd needs dict batches (the kept-token count is "
+                f"injected as batch['ltd_keep']); got {type(batch).__name__}")
         if comp_key is not None or ltd_keep is not None:
             vkey = (comp_key, ltd_keep)
             fwd_bwd = self._fwd_bwd_variants.get(vkey)

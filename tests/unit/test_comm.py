@@ -179,3 +179,78 @@ def test_launcher_bad_hostfile(tmp_path):
     hf.write_text("worker-0 slots=four\n")
     with pytest.raises(ValueError):
         fetch_hostfile(str(hf))
+
+
+def _runner_args(hostfile="/job/hostfile", **kw):
+    from deepspeed_tpu.launcher.runner import parse_args
+
+    argv = ["-H", hostfile]
+    for k, v in kw.items():
+        argv += [f"--{k}", str(v)]
+    return parse_args(argv + ["train.py", "--lr", "0.1"])
+
+
+class TestMultinodeRunners:
+    """Command construction for each backend (reference
+    ``tests/unit/launcher/test_multinode_runner.py``)."""
+
+    ACTIVE = {"worker-0": [0], "worker-1": [0]}
+
+    def _build(self, name, **kw):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        r = build_runner(name, _runner_args(**kw), world_info_base64="V0lORk8=")
+        r.add_export("DSTPU_NUM_PROCESSES", "2")
+        r.add_export("COORDINATOR_ADDRESS", "worker-0:29500")
+        return r
+
+    def test_pdsh_cmd(self):
+        cmd = self._build("pdsh").get_cmd({}, self.ACTIVE)
+        assert cmd[0] == "pdsh" and "-w" in cmd
+        assert cmd[cmd.index("-w") + 1] == "worker-0,worker-1"
+        remote = cmd[-1]
+        assert "DSTPU_PROCESS_ID=%n" in remote and "train.py" in remote
+        assert "COORDINATOR_ADDRESS=worker-0:29500" in remote
+
+    def test_openmpi_cmd(self):
+        cmd = self._build("openmpi").get_cmd({}, self.ACTIVE)
+        assert cmd[:3] == ["mpirun", "-n", "2"]
+        # explicit host list + one rank per node (no slot packing)
+        assert cmd[cmd.index("-host") + 1] == "worker-0,worker-1"
+        assert cmd[cmd.index("--map-by") + 1] == "ppr:1:node"
+        assert "-x" in cmd and "train.py" in cmd
+
+    def test_mpich_and_impi_cmd(self):
+        for name, exe in (("mpich", "mpirun"), ("impi", "mpiexec.hydra")):
+            cmd = self._build(name).get_cmd({}, self.ACTIVE)
+            assert cmd[0] == exe
+            assert cmd[cmd.index("-hosts") + 1] == "worker-0,worker-1"
+            assert "-ppn" in cmd and "-genv" in cmd
+
+    def test_slurm_cmd(self):
+        cmd = self._build("slurm").get_cmd({}, self.ACTIVE)
+        assert cmd[:3] == ["srun", "--ntasks", "2"]
+        assert cmd[cmd.index("--nodelist") + 1] == "worker-0,worker-1"
+        assert any(a.startswith("--export=ALL,") and
+                   "COORDINATOR_ADDRESS=worker-0:29500" in a for a in cmd)
+
+    def test_mvapich_cmd(self):
+        import os
+
+        cmd = self._build("mvapich").get_cmd({}, self.ACTIVE)
+        assert cmd[:3] == ["mpirun_rsh", "-np", "2"]
+        # converted hostfile: plain hostnames, one per line
+        path = cmd[cmd.index("-hostfile") + 1]
+        assert open(path).read().split() == ["worker-0", "worker-1"]
+        os.unlink(path)
+
+    def test_pdsh_sets_rcmd_type_in_callers_env(self):
+        env = {}
+        self._build("pdsh").get_cmd(env, self.ACTIVE)
+        assert env.get("PDSH_RCMD_TYPE") == "ssh"
+
+    def test_unknown_launcher_rejected(self):
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+
+        with pytest.raises(ValueError, match="unknown launcher"):
+            build_runner("pbs", _runner_args(), "")
