@@ -1,0 +1,168 @@
+// Host-side shared-memory collectives.
+//
+// Reference: csrc/cpu/comm/shm.cpp + ccl.cpp (639 LoC) — low-latency
+// intra-node allreduce used by the CPU inference backend and as the host
+// staging layer under the oneCCL backend. TPU-native role: same-host
+// control-plane collectives between per-host launcher processes (config
+// exchange, elastic re-rendezvous, host-offloaded optimizer fragments)
+// without routing tiny host tensors through the accelerator ICI.
+//
+// Design: one POSIX shm segment per communicator. Layout =
+//   [Header | world * max_bytes data slots]
+// Header holds a magic/init flag and two sense-reversing barriers (arrival
+// counter + generation, std::atomic on shared memory). Collectives are
+// copy-in -> barrier -> reduce/copy-out -> barrier; the second barrier keeps
+// slot reuse safe for the next call.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <new>
+
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Barrier {
+  std::atomic<int32_t> count;
+  std::atomic<int32_t> gen;
+};
+
+struct Header {
+  std::atomic<uint32_t> magic;  // set by rank 0 after init
+  int32_t world;
+  uint64_t max_bytes;
+  Barrier b0;
+  Barrier b1;
+};
+
+constexpr uint32_t kMagic = 0x44535053;  // "DSPS"
+
+struct Ctx {
+  Header* hdr = nullptr;
+  char* data = nullptr;   // world * max_bytes
+  int rank = -1;
+  int world = 0;
+  uint64_t max_bytes = 0;
+  char name[256] = {0};
+  size_t map_len = 0;
+};
+
+Ctx g_ctx;
+
+inline void barrier_wait(Barrier* b, int world) {
+  int g = b->gen.load(std::memory_order_acquire);
+  if (b->count.fetch_add(1, std::memory_order_acq_rel) + 1 == world) {
+    b->count.store(0, std::memory_order_relaxed);
+    b->gen.fetch_add(1, std::memory_order_release);
+  } else {
+    while (b->gen.load(std::memory_order_acquire) == g) sched_yield();
+  }
+}
+
+inline char* slot(int rank) { return g_ctx.data + (uint64_t)rank * g_ctx.max_bytes; }
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. All ranks call with identical (name, world, max_bytes).
+int dstpu_shm_init(const char* name, int rank, int world, uint64_t max_bytes) {
+  if (g_ctx.hdr) return -2;  // already initialized
+  size_t len = sizeof(Header) + (uint64_t)world * max_bytes;
+  int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, (off_t)len) != 0) { close(fd); return -1; }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -1;
+  Header* hdr = (Header*)mem;
+  if (rank == 0) {
+    hdr->world = world;
+    hdr->max_bytes = max_bytes;
+    hdr->b0.count.store(0);
+    hdr->b0.gen.store(0);
+    hdr->b1.count.store(0);
+    hdr->b1.gen.store(0);
+    hdr->magic.store(kMagic, std::memory_order_release);
+  } else {
+    while (hdr->magic.load(std::memory_order_acquire) != kMagic) sched_yield();
+    if (hdr->world != world || hdr->max_bytes != max_bytes) {
+      munmap(mem, len);
+      return -3;  // mismatched communicator parameters
+    }
+  }
+  g_ctx.hdr = hdr;
+  g_ctx.data = (char*)mem + sizeof(Header);
+  g_ctx.rank = rank;
+  g_ctx.world = world;
+  g_ctx.max_bytes = max_bytes;
+  g_ctx.map_len = len;
+  snprintf(g_ctx.name, sizeof(g_ctx.name), "%s", name);
+  barrier_wait(&hdr->b0, world);  // everyone mapped before first collective
+  return 0;
+}
+
+void dstpu_shm_barrier() {
+  barrier_wait(&g_ctx.hdr->b0, g_ctx.world);
+}
+
+// In-place sum-allreduce of n floats (n*4 <= max_bytes).
+int dstpu_shm_allreduce_f32(float* buf, uint64_t n) {
+  if (!g_ctx.hdr || n * 4 > g_ctx.max_bytes) return -1;
+  std::memcpy(slot(g_ctx.rank), buf, n * 4);
+  barrier_wait(&g_ctx.hdr->b0, g_ctx.world);
+  // every rank reduces all slots into its private buffer
+  for (int r = 0; r < g_ctx.world; ++r) {
+    if (r == g_ctx.rank) continue;
+    const float* other = (const float*)slot(r);
+#pragma omp simd
+    for (uint64_t i = 0; i < n; ++i) buf[i] += other[i];
+  }
+  barrier_wait(&g_ctx.hdr->b1, g_ctx.world);
+  return 0;
+}
+
+// Gather bytes from every rank: dst must hold world*bytes.
+int dstpu_shm_allgather(const void* src, uint64_t bytes, void* dst) {
+  if (!g_ctx.hdr || bytes > g_ctx.max_bytes) return -1;
+  std::memcpy(slot(g_ctx.rank), src, bytes);
+  barrier_wait(&g_ctx.hdr->b0, g_ctx.world);
+  for (int r = 0; r < g_ctx.world; ++r)
+    std::memcpy((char*)dst + (uint64_t)r * bytes, slot(r), bytes);
+  barrier_wait(&g_ctx.hdr->b1, g_ctx.world);
+  return 0;
+}
+
+// In-place broadcast from root.
+int dstpu_shm_broadcast(void* buf, uint64_t bytes, int root) {
+  if (!g_ctx.hdr || bytes > g_ctx.max_bytes) return -1;
+  if (g_ctx.rank == root) std::memcpy(slot(root), buf, bytes);
+  barrier_wait(&g_ctx.hdr->b0, g_ctx.world);
+  if (g_ctx.rank != root) std::memcpy(buf, slot(root), bytes);
+  barrier_wait(&g_ctx.hdr->b1, g_ctx.world);
+  return 0;
+}
+
+int dstpu_shm_rank() { return g_ctx.rank; }
+int dstpu_shm_world() { return g_ctx.world; }
+
+// Final barrier, unmap; rank 0 unlinks the segment.
+int dstpu_shm_finalize() {
+  if (!g_ctx.hdr) return -1;
+  barrier_wait(&g_ctx.hdr->b0, g_ctx.world);
+  int rank = g_ctx.rank;
+  char name[256];
+  std::memcpy(name, g_ctx.name, sizeof(name));
+  munmap((void*)g_ctx.hdr, g_ctx.map_len);
+  g_ctx = Ctx{};
+  if (rank == 0) shm_unlink(name);
+  return 0;
+}
+
+}  // extern "C"

@@ -73,24 +73,51 @@ def init_distributed(
             initialize_topology(mesh_config=mesh_config)
         return
     n_expected = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
-    if n_expected > 1 and jax.process_count() == 1:
+    if n_expected > 1:
+        # NOTE: initialize() must run BEFORE anything touches the XLA backend
+        # (even jax.process_count()), so attempt it first and sort failures out
+        # after. Explicit coordinator env comes from the launcher; the rank var
+        # differs per backend (pdsh/ssh export DSTPU_PROCESS_ID; MPICH/Intel
+        # MPI set PMI_RANK; OpenMPI sets OMPI_COMM_WORLD_RANK — the latter is
+        # also auto-detected by JAX, the PMI family is NOT).
+        kw = {}
+        rank_var = next((v for v in ("DSTPU_PROCESS_ID", "PMI_RANK",
+                                     "OMPI_COMM_WORLD_RANK")
+                         if v in os.environ), None)
+        if "COORDINATOR_ADDRESS" in os.environ and rank_var is not None:
+            kw = dict(
+                coordinator_address=os.environ["COORDINATOR_ADDRESS"],
+                num_processes=n_expected,
+                process_id=int(os.environ[rank_var]),
+            )
         try:
-            # ssh/pdsh path: explicit coordinator env from the launcher;
-            # SLURM/OMPI/TPU-pod envs are auto-detected by JAX
-            kw = {}
-            if "COORDINATOR_ADDRESS" in os.environ and "DSTPU_PROCESS_ID" in os.environ:
-                kw = dict(
-                    coordinator_address=os.environ["COORDINATOR_ADDRESS"],
-                    num_processes=n_expected,
-                    process_id=int(os.environ["DSTPU_PROCESS_ID"]),
-                )
             jax.distributed.initialize(**kw)
-            if verbose:
-                logger.info(
-                    f"Initialized JAX distributed: process {jax.process_index()}/{jax.process_count()}"
-                )
-        except Exception as e:  # already initialized or single-process
-            logger.warning(f"jax.distributed.initialize skipped: {e}")
+        except RuntimeError as e:
+            msg = str(e).lower()
+            already = "already" in msg or "only be called once" in msg
+            pre_initialized_world = False
+            if not already:
+                try:  # a TPU-pod runtime may already hold the full world
+                    pre_initialized_world = jax.process_count() == n_expected
+                except Exception:
+                    pass
+            if not (already or pre_initialized_world):
+                # a silent fall-through would train N divergent single-host
+                # jobs — rendezvous failure is fatal in a multi-node launch
+                raise RuntimeError(
+                    f"multi-node rendezvous failed (expected {n_expected} "
+                    "processes). Call deepspeed_tpu.init_distributed() before "
+                    "any other JAX usage, and check COORDINATOR_ADDRESS/"
+                    f"{rank_var or 'DSTPU_PROCESS_ID'}."
+                ) from e
+        if jax.process_count() != n_expected:
+            raise RuntimeError(
+                f"rendezvous produced {jax.process_count()} processes, "
+                f"expected {n_expected}")
+        if verbose:
+            logger.info(
+                f"Initialized JAX distributed: process "
+                f"{jax.process_index()}/{jax.process_count()}")
     initialize_topology(mesh_config=mesh_config)
     _initialized = True
 

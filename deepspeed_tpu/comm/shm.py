@@ -1,0 +1,78 @@
+"""Shared-memory host collectives (same-host control plane).
+
+Reference: ``csrc/cpu/comm/shm.cpp`` + ``ccl.cpp`` — the low-latency
+intra-node allreduce the CPU backend uses. Here it serves the host side of a
+TPU pod: per-host launcher processes exchange small control tensors (config
+dicts, elastic re-rendezvous state, host-offloaded optimizer fragments)
+without a device round-trip. Device collectives stay XLA-over-ICI.
+
+Usage (one communicator per same-host process group)::
+
+    comm = ShmComm("job42", rank=r, world=4)
+    comm.allreduce(np_f32_array)        # in place, sum
+    parts = comm.allgather(b"state")    # list of bytes per rank
+    comm.broadcast(arr, root=0)
+    comm.finalize()
+"""
+
+import ctypes
+from typing import List
+
+import numpy as np
+
+from ..ops.op_builder import get_builder
+
+
+class ShmComm:
+    def __init__(self, name: str, rank: int, world: int,
+                 max_bytes: int = 1 << 20):
+        builder = get_builder("shm_comm")
+        if builder is None:
+            raise RuntimeError("shm_comm builder unavailable")
+        self._lib = builder().load()
+        self._lib.dstpu_shm_init.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+        self._lib.dstpu_shm_allreduce_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        self._lib.dstpu_shm_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+        self._lib.dstpu_shm_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+        rc = self._lib.dstpu_shm_init(
+            f"/dstpu_{name}".encode(), rank, world, max_bytes)
+        if rc != 0:
+            raise RuntimeError(f"shm init failed (rc={rc})")
+        self.rank, self.world, self.max_bytes = rank, world, max_bytes
+
+    def barrier(self):
+        self._lib.dstpu_shm_barrier()
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        """In-place sum-allreduce of a float32 array."""
+        assert arr.dtype == np.float32 and arr.flags["C_CONTIGUOUS"]
+        rc = self._lib.dstpu_shm_allreduce_f32(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), arr.size)
+        if rc != 0:
+            raise RuntimeError(f"allreduce failed (rc={rc}; size>max_bytes?)")
+        return arr
+
+    def allgather(self, payload: bytes) -> List[bytes]:
+        """Gather equal-size byte strings from every rank."""
+        n = len(payload)
+        dst = (ctypes.c_char * (n * self.world))()
+        rc = self._lib.dstpu_shm_allgather(payload, n, dst)
+        if rc != 0:
+            raise RuntimeError(f"allgather failed (rc={rc})")
+        raw = bytes(dst)
+        return [raw[i * n:(i + 1) * n] for i in range(self.world)]
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        assert arr.flags["C_CONTIGUOUS"]
+        rc = self._lib.dstpu_shm_broadcast(
+            arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, root)
+        if rc != 0:
+            raise RuntimeError(f"broadcast failed (rc={rc})")
+        return arr
+
+    def finalize(self):
+        self._lib.dstpu_shm_finalize()

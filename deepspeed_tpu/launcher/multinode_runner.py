@@ -6,14 +6,13 @@ Reference: ``deepspeed/launcher/multinode_runner.py`` (``PDSHRunner:51``,
 that starts ONE process per host (JAX is single-controller-per-host, unlike
 the reference's one-process-per-GPU model).
 
-Rank discovery at runtime:
-- pdsh / ssh: the launcher exports ``DSTPU_PROCESS_ID`` (pdsh substitutes
-  ``%n`` with the node's rank) + ``COORDINATOR_ADDRESS``; ``init_distributed``
-  passes them to ``jax.distributed.initialize`` explicitly.
-- OpenMPI / MPICH / Intel MPI: ranks come from the MPI environment
-  (``OMPI_COMM_WORLD_RANK`` / ``PMI_RANK``), which JAX's cluster
-  auto-detection already understands.
-- SLURM: ``SLURM_PROCID`` etc., also auto-detected by JAX.
+Rank discovery at runtime: every backend exports ``COORDINATOR_ADDRESS`` +
+``DSTPU_NUM_PROCESSES``; the per-process rank comes from ``DSTPU_PROCESS_ID``
+(pdsh substitutes ``%n``), ``PMI_RANK`` (MPICH / Intel MPI) or
+``OMPI_COMM_WORLD_RANK`` (OpenMPI) — ``init_distributed`` reads whichever is
+present and passes explicit args to ``jax.distributed.initialize``. SLURM is
+additionally auto-detected by JAX (``SLURM_PROCID``); the PMI family is NOT
+auto-detected, hence the explicit path.
 """
 
 import os
@@ -25,11 +24,10 @@ from typing import Dict, List
 
 
 class MultiNodeRunner(ABC):
-    def __init__(self, args, world_info_base64: str):
+    def __init__(self, args):
         self.args = args
         self.user_arguments = list(args.user_args)
         self.user_script = args.user_script
-        self.world_info_base64 = world_info_base64
         self.exports: Dict[str, str] = {}
 
     @abstractmethod
@@ -152,14 +150,18 @@ class MVAPICHRunner(MultiNodeRunner):
         return shutil.which("mpirun_rsh") is not None
 
     def get_cmd(self, environment, active_resources):
+        import atexit
         import tempfile
 
         n = len(active_resources)
         # mpirun_rsh wants PLAIN hostnames, one per line (the reference
-        # likewise writes a converted hostfile, multinode_runner.py:376)
-        fd, path = tempfile.mkstemp(prefix="dstpu_mvapich_hosts_")
-        with os.fdopen(fd, "w") as f:
+        # likewise writes a converted hostfile, multinode_runner.py:376);
+        # one file per launcher process, removed at exit
+        path = os.path.join(tempfile.gettempdir(),
+                            f"dstpu_mvapich_hosts_{os.getpid()}")
+        with open(path, "w") as f:
             f.write("\n".join(active_resources.keys()) + "\n")
+        atexit.register(lambda: os.path.exists(path) and os.unlink(path))
         cmd = ["mpirun_rsh", "-np", str(n), "-hostfile", path]
         for k, v in self.exports.items():
             cmd.append(f"{k}={v}")
@@ -176,9 +178,9 @@ RUNNERS = {
 }
 
 
-def build_runner(launcher: str, args, world_info_base64: str) -> MultiNodeRunner:
+def build_runner(launcher: str, args) -> MultiNodeRunner:
     key = launcher.lower()
     if key not in RUNNERS:
         raise ValueError(
             f"unknown launcher '{launcher}' (known: {sorted(RUNNERS)})")
-    return RUNNERS[key](args, world_info_base64)
+    return RUNNERS[key](args)

@@ -147,7 +147,7 @@ def main(args=None):
         # backend runners (pdsh/mpi/slurm — reference multinode_runner.py)
         from .multinode_runner import build_runner
 
-        runner = build_runner(args.launcher, args, world_info)
+        runner = build_runner(args.launcher, args)
         if not runner.backend_exists():
             raise RuntimeError(
                 f"launcher backend '{runner.name}' not found on PATH")

@@ -1,0 +1,1 @@
+from .shm_builder import ShmCommBuilder  # noqa: F401

@@ -1,0 +1,15 @@
+"""Builder for the shared-memory host collectives library (reference
+``op_builder/cpu/comm.py CCLCommBuilder`` compiling ``csrc/cpu/comm/``)."""
+
+from ..op_builder import OpBuilder, register_builder
+
+
+@register_builder
+class ShmCommBuilder(OpBuilder):
+    NAME = "shm_comm"
+
+    def sources(self):
+        return ["csrc/comm/shm.cpp"]
+
+    def libraries_args(self):
+        return ["-lpthread", "-lrt"]

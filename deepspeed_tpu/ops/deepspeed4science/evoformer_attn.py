@@ -1,0 +1,79 @@
+"""DS4Science Evoformer attention.
+
+Reference: ``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+(``DS4Sci_EvoformerAttention``) backed by ~15k lines of CUTLASS kernels
+(``csrc/deepspeed4science/evoformer_attn/kernel_forward.h:986``,
+``kernel_backward.h:1965``). The contract: Q/K/V of shape ``[*, L, H, D]``
+(typically ``[B, N_seq, L_res, H, D]``) attend over the residue dim ``L`` with
+up to two additive logit biases — an MSA mask bias ``(B, N, 1, 1, L)`` and a
+pair bias ``(B, 1, H, L, L)``.
+
+TPU-native: the fused CUDA fwd/bwd pair collapses to one jnp expression —
+the MXU runs the two einsums, XLA fuses the bias adds + fp32 softmax, and
+autodiff derives the backward (including bias gradients, which the reference
+implements by hand). ``query_chunk_size`` bounds the materialized logits for
+long-sequence triangle attention (lse-free chunking is fine since softmax is
+computed per chunk over the FULL key dim).
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _attend(Q, K, V, biases):
+    d = Q.shape[-1]
+    logits = jnp.einsum("...qhd,...khd->...hqk", Q.astype(jnp.float32),
+                        K.astype(jnp.float32)) * (d ** -0.5)
+    for b in biases:
+        if b is not None:
+            logits = logits + b.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("...hqk,...khd->...qhd", probs, V.astype(jnp.float32))
+    return out.astype(Q.dtype)
+
+
+def DS4Sci_EvoformerAttention(Q, K, V, biases: Sequence = (),
+                              query_chunk_size: Optional[int] = None):
+    """Evoformer attention with up to two additive logit biases.
+
+    Q/K/V: ``[*, L, H, D]``; each bias must broadcast against the
+    ``[*, H, Lq, Lk]`` logits (the reference's two accepted layouts —
+    ``(B, N, 1, 1, L)`` and ``(B, 1, H, L, L)`` — both do). Returns
+    ``[*, Lq, H, D]`` in Q's dtype; differentiable in Q/K/V and the biases.
+    """
+    biases = list(biases)
+    if len(biases) > 2:
+        raise ValueError("at most 2 biases (reference contract)")
+    logit_shape = Q.shape[:-3] + (Q.shape[-2], Q.shape[-3], K.shape[-3])
+    for b in biases:
+        if b is None:
+            continue
+        try:
+            jnp.broadcast_shapes(b.shape, logit_shape)
+        except ValueError as e:
+            raise ValueError(
+                f"bias shape {b.shape} does not broadcast against logits "
+                f"{logit_shape}") from e
+
+    if query_chunk_size is None or Q.shape[-3] <= query_chunk_size:
+        return _attend(Q, K, V, biases)
+
+    L = Q.shape[-3]
+    if L % query_chunk_size:
+        raise ValueError(f"query_chunk_size must divide L={L}")
+
+    def chunk(start):
+        qs = jax.lax.dynamic_slice_in_dim(Q, start, query_chunk_size, axis=-3)
+        bs = []
+        for b in biases:
+            if b is not None and b.shape[-2] == L:  # sliced along the q dim
+                b = jax.lax.dynamic_slice_in_dim(b, start, query_chunk_size,
+                                                 axis=-2)
+            bs.append(b)
+        return _attend(qs, K, V, bs)
+
+    starts = jnp.arange(0, L, query_chunk_size)
+    out = jax.lax.map(chunk, starts)  # (n_chunks, *, chunk, H, D)
+    return jnp.moveaxis(out, 0, -4).reshape(Q.shape[:-3] + (L,) + Q.shape[-2:])

@@ -107,3 +107,7 @@ def _populate():
         from .aio import aio_builder  # noqa: F401
     except Exception as e:  # pragma: no cover
         logger.debug(f"aio builder unavailable: {e}")
+    try:
+        from .comm import shm_builder  # noqa: F401
+    except Exception as e:  # pragma: no cover
+        logger.debug(f"shm_comm builder unavailable: {e}")

@@ -199,7 +199,7 @@ class TestMultinodeRunners:
     def _build(self, name, **kw):
         from deepspeed_tpu.launcher.multinode_runner import build_runner
 
-        r = build_runner(name, _runner_args(**kw), world_info_base64="V0lORk8=")
+        r = build_runner(name, _runner_args(**kw))
         r.add_export("DSTPU_NUM_PROCESSES", "2")
         r.add_export("COORDINATOR_ADDRESS", "worker-0:29500")
         return r
@@ -253,4 +253,4 @@ class TestMultinodeRunners:
         from deepspeed_tpu.launcher.multinode_runner import build_runner
 
         with pytest.raises(ValueError, match="unknown launcher"):
-            build_runner("pbs", _runner_args(), "")
+            build_runner("pbs", _runner_args())
