@@ -7,9 +7,12 @@ runs prefill+decode of mixed requests in one forward over a ragged batch;
 TPU re-design (SURVEY.md §7 "hard parts" #1): XLA needs static shapes, so the
 ragged batch becomes **bucketed static shapes**:
 
-- KV cache: one slot per live sequence, (L, max_seqs, max_seq_len, kvh, hd) —
-  the paged-blocks indirection is unnecessary when slots are dense and XLA keeps
-  the pool donated in HBM.
+- KV cache, two layouts: dense per-sequence slots
+  (L, max_seqs, max_seq_len, kvh, hd), or ``paged=True`` blocked pool
+  (L, num_blocks, block_size, kvh, hd) with per-sequence block tables
+  (reference ``BlockedKVCache``) — total KV memory is shared across
+  sequences, so many short sequences fit where dedicated slots would not;
+  attention runs on the table-gathered logical cache with position masks.
 - prefill: prompts are padded to power-of-two length buckets and processed by a
   per-bucket compiled program, vmapped over sequences with per-sequence cache
   offsets (chunked split-fuse: long prompts go through in ``prefill_chunk``
@@ -44,13 +47,15 @@ class InferenceEngineV2:
 
     def __init__(self, model, params=None, *, max_seqs: int = 8,
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, paged: bool = False, block_size: int = 64,
+                 num_blocks: Optional[int] = None):
         self.model = model
         self.cfg = model.config
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len or model.config.max_seq_len
         self.prefill_chunk = prefill_chunk
         self.dtype = dtype
+        self.paged = paged
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
 
@@ -66,14 +71,33 @@ class InferenceEngineV2:
 
         self.params = jax.tree_util.tree_map_with_path(cast, params)
         self.state = DSStateManager(max_seqs, self.max_seq_len)
-        # slot-pooled KV cache: (L, max_seqs, T, kvh, hd)
-        self.kv = model.init_kv_cache(max_seqs, self.max_seq_len, dtype=dtype)
         self._prefill_fns = {}
         self._decode_fn = None
-        log_dist(
-            f"InferenceEngineV2: slots={max_seqs} ctx={self.max_seq_len} "
-            f"chunk={prefill_chunk}", ranks=[0],
-        )
+        if paged:
+            # paged-block pool (reference BlockedKVCache): total KV memory is
+            # num_blocks*block_size tokens shared across sequences instead of
+            # max_seqs*max_seq_len dedicated slots
+            from .ragged_manager import BlockedKVCache
+
+            max_blocks_per_seq = -(-self.max_seq_len // block_size)
+            if num_blocks is None:
+                num_blocks = 1 + max_seqs * max_blocks_per_seq  # = slot capacity
+            self.block_mgr = BlockedKVCache(num_blocks, block_size,
+                                            max_blocks_per_seq)
+            self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
+            log_dist(
+                f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
+                f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk}",
+                ranks=[0],
+            )
+        else:
+            self.block_mgr = None
+            # slot-pooled KV cache: (L, max_seqs, T, kvh, hd)
+            self.kv = model.init_kv_cache(max_seqs, self.max_seq_len, dtype=dtype)
+            log_dist(
+                f"InferenceEngineV2: slots={max_seqs} ctx={self.max_seq_len} "
+                f"chunk={prefill_chunk}", ranks=[0],
+            )
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -133,6 +157,32 @@ class InferenceEngineV2:
         self._decode_fn = jax.jit(decode, donate_argnums=(1,))
         return self._decode_fn
 
+    def _get_prefill_paged(self):
+        # one compiled wrapper; jit retraces per (n_seq, S) shape on its own
+        if "paged" in self._prefill_fns:
+            return self._prefill_fns["paged"]
+        model = self.model
+
+        def prefill(params, pool, ids, tables, starts, n_valid):
+            return model.forward_paged(params, ids, pool, tables, starts, n_valid)
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns["paged"] = fn
+        return fn
+
+    def _get_decode_paged(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model = self.model
+
+        def decode(params, pool, toks, tables, poss):
+            # inactive rows carry an all-zero table (trash block 0) + pos 0:
+            # their writes land in the trash block, their logits are ignored
+            return model.forward_paged(params, toks[:, None], pool, tables, poss)
+
+        self._decode_fn = jax.jit(decode, donate_argnums=(1,))
+        return self._decode_fn
+
     # ------------------------------------------------------------------
     # reference surface
     # ------------------------------------------------------------------
@@ -178,6 +228,16 @@ class InferenceEngineV2:
                 starts = np.zeros((len(grp),), np.int32)
                 slots = np.zeros((len(grp),), np.int32)
                 nval = np.zeros((len(grp),), np.int32)
+                tables = None
+                if self.paged:
+                    # allocate blocks for the WHOLE group before mutating any
+                    # sequence state — an exhaustion raise must leave every
+                    # descriptor exactly as it was (padded tail positions also
+                    # land in allocated blocks)
+                    tables = np.zeros(
+                        (len(grp), self.block_mgr.max_blocks_per_seq), np.int32)
+                    for d in grp:
+                        self.block_mgr.ensure(d, d.seen_tokens + S)
                 for i, d in enumerate(grp):
                     take = min(S, d.in_flight, self.prefill_chunk)
                     ids[i, :take] = d.pending[:take]
@@ -185,11 +245,19 @@ class InferenceEngineV2:
                     starts[i] = d.seen_tokens
                     slots[i] = d.slot
                     nval[i] = take
+                    if self.paged:
+                        tables[i] = self.block_mgr.table_row(d)
                     d.seen_tokens += take
-                fn = self._get_prefill(S)
-                lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
-                                 jnp.asarray(slots), jnp.asarray(starts),
-                                 jnp.asarray(nval))
+                if self.paged:
+                    fn = self._get_prefill_paged()
+                    lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
+                                     jnp.asarray(tables), jnp.asarray(starts),
+                                     jnp.asarray(nval))
+                else:
+                    fn = self._get_prefill(S)
+                    lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
+                                     jnp.asarray(slots), jnp.asarray(starts),
+                                     jnp.asarray(nval))
                 lg = np.asarray(lg)
                 for i, d in enumerate(grp):
                     if d.in_flight == 0:  # prompt fully consumed → logits are live
@@ -202,32 +270,68 @@ class InferenceEngineV2:
         toks = np.zeros((self.max_seqs,), np.int32)
         poss = np.zeros((self.max_seqs,), np.int32)
         active = np.zeros((self.max_seqs,), bool)
+        tables = None
+        if self.paged:
+            tables = np.zeros((self.max_seqs, self.block_mgr.max_blocks_per_seq),
+                              np.int32)
         by_slot: Dict[int, int] = {}
-        for uid, tok in tokens.items():
+        # validation + block allocation for EVERY uid first: a raise here must
+        # leave all sequence state untouched (no half-advanced positions)
+        for uid in tokens:
             d = self.state.seqs[uid]
             if d.seen_tokens >= self.max_seq_len:
                 raise RuntimeError(
                     f"uid {uid}: context full ({d.seen_tokens} >= {self.max_seq_len}); "
                     "flush the sequence or raise max_seq_len"
                 )
+            if self.paged:
+                self.block_mgr.ensure(d, d.seen_tokens + 1)
+        for uid, tok in tokens.items():
+            d = self.state.seqs[uid]
             toks[d.slot] = tok
             poss[d.slot] = d.seen_tokens
             active[d.slot] = True
             by_slot[d.slot] = uid
+            if self.paged:
+                tables[d.slot] = self.block_mgr.table_row(d)
             d.seen_tokens += 1
-        lg, self.kv = self._get_decode()(
-            self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
-            jnp.asarray(active),
-        )
+        if self.paged:
+            lg, self.kv = self._get_decode_paged()(
+                self.params, self.kv, jnp.asarray(toks), jnp.asarray(tables),
+                jnp.asarray(poss),
+            )
+        else:
+            lg, self.kv = self._get_decode()(
+                self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
+                jnp.asarray(active),
+            )
         lg = np.asarray(lg)
         return {uid: lg[slot] for slot, uid in by_slot.items()}
 
     def flush(self, uid: int):
+        if self.paged and uid in self.state.seqs:
+            self.block_mgr.free(self.state.seqs[uid])
         self.state.flush_sequence(uid)
 
     # reference ``query``/``can_schedule`` surface
     def query(self) -> Tuple[int, int]:
-        return self.state.max_seqs - self.state.n_active, self.max_seq_len
+        """(free sequence slots, per-sequence token capacity). In paged mode
+        the token capacity is additionally bounded by the free block pool."""
+        free_slots = self.state.max_seqs - self.state.n_active
+        if self.paged:
+            return free_slots, min(self.max_seq_len,
+                                   self.block_mgr.free_blocks
+                                   * self.block_mgr.block_size)
+        return free_slots, self.max_seq_len
 
     def can_schedule(self, n_new: int = 1) -> bool:
-        return self.state.can_allocate(n_new)
+        if not self.state.can_allocate(n_new):
+            return False
+        if self.paged:
+            # admit only if every new sequence can get one prefill chunk of
+            # blocks (the reference consults KV block availability likewise,
+            # engine_v2.py:184 query / can_schedule:184)
+            per_seq = self.block_mgr.blocks_needed(
+                min(self.prefill_chunk, self.max_seq_len))
+            return self.block_mgr.free_blocks >= n_new * per_seq
+        return True

@@ -26,11 +26,54 @@ class SequenceDescriptor:
     slot: int
     seen_tokens: int = 0  # tokens already in the KV cache
     pending: List[int] = field(default_factory=list)  # tokens not yet prefilled
+    blocks: List[int] = field(default_factory=list)  # paged mode: pool block ids
     done: bool = False
 
     @property
     def in_flight(self) -> int:
         return len(self.pending)
+
+
+class BlockedKVCache:
+    """Paged-block allocator (reference ``ragged/kv_cache.py:40
+    BlockedKVCache``): a fixed pool of fixed-size blocks handed to sequences
+    on demand. Block 0 is reserved as the trash block masked writes target."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self._free: List[int] = list(range(1, num_blocks))[::-1]  # 0 reserved
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.block_size)
+
+    def ensure(self, desc: SequenceDescriptor, n_tokens: int):
+        """Grow ``desc.blocks`` to cover ``n_tokens`` logical positions."""
+        need = self.blocks_needed(n_tokens)
+        if need > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"uid {desc.uid}: {n_tokens} tokens need {need} blocks > "
+                f"max {self.max_blocks_per_seq} per sequence")
+        while len(desc.blocks) < need:
+            if not self._free:
+                raise RuntimeError(
+                    f"KV block pool exhausted (uid {desc.uid}; "
+                    f"{self.num_blocks - 1} usable blocks)")
+            desc.blocks.append(self._free.pop())
+
+    def table_row(self, desc: SequenceDescriptor) -> np.ndarray:
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[: len(desc.blocks)] = desc.blocks
+        return row
+
+    def free(self, desc: SequenceDescriptor):
+        self._free.extend(desc.blocks)
+        desc.blocks = []
 
 
 class DSStateManager:

@@ -430,9 +430,18 @@ class TransformerLM:
         return P(ZERO_AXES, None, (self.seq_axis, self.model_axis), None)
 
     # ------------------------------------------------------------------
-    def _block(self, x, blk, *, positions, rng, train, kv_cache=None, cache_index=None):
+    def _block(self, x, blk, *, positions, rng, train, kv_cache=None, cache_index=None,
+               paged=None):
         """One transformer block on (B, S, H). Returns (y, new_kv) where new_kv is
-        the updated (k, v) when decoding with a cache."""
+        the updated (k, v) when decoding with a cache.
+
+        ``paged``: (kp, vp, tables) for a blocked KV pool — kp/vp
+        (NB, BS, kvh, hd), tables (B, MAXB) of pool block ids (0 = reserved
+        trash block). Tokens write at their ``positions`` via block-table
+        scatter; attention runs against the table-gathered logical cache with
+        a per-sequence position mask (covers chunked prefill AND decode —
+        reference ``inference/v2/ragged_ops/blocked_flash`` + ``kv_cache.py
+        BlockedKVCache``)."""
         cfg = self.config
         nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         B, S, H = x.shape
@@ -467,7 +476,28 @@ class TransformerLM:
             return kpos[:, None, None, None, :] * slopes[None, :, :, None, None]
 
         new_kv = None
-        if kv_cache is not None:
+        if paged is not None:
+            kp, vp, tables = paged
+            BS = kp.shape[1]
+            # scatter this segment's k/v into the pool at its block/offset
+            blk_idx = jnp.take_along_axis(tables, positions // BS, axis=1)  # (B,S)
+            off = positions % BS
+            kp = kp.at[blk_idx, off].set(kk.astype(kp.dtype))
+            vp = vp.at[blk_idx, off].set(v.astype(vp.dtype))
+            new_kv = (kp, vp)
+            gk = kp[tables].reshape(B, -1, kvh, hd)  # (B, T=MAXB*BS, kvh, hd)
+            gv = vp[tables].reshape(B, -1, kvh, hd)
+            T = gk.shape[1]
+            kpos = jnp.arange(T)
+            mask = kpos[None, None, :] <= positions[:, :, None]  # (B,S,T)
+            bias = jnp.where(mask, 0.0, -1e30)[:, None, None]  # (B,1,1,S,T)
+            if cfg.pos_embedding == "alibi":
+                bias = bias + _alibi_bias(kpos)
+            attn_out = _attention_op(
+                q, gk, gv, causal=False, num_kv_groups=nh // kvh,
+                softcap=cfg.logit_softcap, bias=bias,
+            )
+        elif kv_cache is not None:
             ck, cv = kv_cache  # (B, T, kvh, hd)
             ck = jax.lax.dynamic_update_slice(ck, kk.astype(ck.dtype), (0, cache_index, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
@@ -766,6 +796,49 @@ class TransformerLM:
         x, new_kv = self._trunk_with_cache(params, input_ids, kv_cache,
                                            cache_index, positions)
         return self._head(params, x), new_kv
+
+    # ------------------------------------------------------------------
+    # paged (blocked) KV cache — reference inference/v2 BlockedKVCache path
+    # ------------------------------------------------------------------
+    def init_kv_pool(self, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+        """Blocked KV pool (L, NB, BS, kvh, hd); block 0 is the reserved trash
+        block that masked/padded writes land in."""
+        cfg = self.config
+        shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def forward_paged(self, params, input_ids, kv_pool, tables, starts,
+                      n_valid=None):
+        """Run a (B, S) segment against the blocked pool.
+
+        tables: (B, MAXB) pool block ids per sequence (0-padded); starts: (B,)
+        first logical position of the segment. Returns ((B, V) logits at each
+        sequence's LAST VALID position, new pool).
+        """
+        B, S = input_ids.shape
+        positions = starts[:, None] + jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (B, S))
+        dtype = kv_pool[0].dtype
+        x = self._embed(params, input_ids, positions, dtype)
+
+        def body(h, layer):
+            blk, kp_l, vp_l = layer
+            y, new_kv, _ = self._block(
+                h, blk, positions=positions, rng=None, train=False,
+                paged=(kp_l, vp_l, tables),
+            )
+            return y, new_kv
+
+        x, (nkp, nvp) = jax.lax.scan(
+            body, x, (params["blocks"], kv_pool[0], kv_pool[1]))
+        logits = self._head(params, x)  # (B, S, V)
+        if n_valid is None:
+            last = jnp.full((B,), S - 1, jnp.int32)
+        else:
+            last = jnp.clip(n_valid - 1, 0, S - 1)
+        lg = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1)[:, 0]
+        return lg, (nkp, nvp)
 
     def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
         """Like ``forward_with_cache_all`` but projects only the LAST position
