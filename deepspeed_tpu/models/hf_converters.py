@@ -614,6 +614,130 @@ def from_hf_mixtral(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     return TransformerLM(cfg), params
 
 
+def from_hf_bert(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF BERT/RoBERTa MaskedLM (reference
+    ``module_inject/containers/bert.py`` + the fused BERT training kernel
+    ``ops/transformer/transformer.py:296``). Post-LN encoder trunk with
+    segment embeddings, embedding LayerNorm and the MLM prediction head;
+    RoBERTa's +2 position offset is baked out like OPT's."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    roberta = "roberta" in type(model).__name__.lower() or \
+        hf_cfg.model_type == "roberta"
+    base = "roberta" if roberta else "bert"
+    H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
+    V = hf_cfg.vocab_size
+    pos_off = 2 if roberta else 0  # roberta: padding_idx+1 baked into wpe
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        intermediate_size=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings - pos_off,
+        causal=False, norm_position="post", mlm_head=True,
+        token_type_embedding=hf_cfg.type_vocab_size,
+        embed_layernorm=True, pos_embedding="learned", norm="layernorm",
+        norm_eps=hf_cfg.layer_norm_eps, activation=_act(hf_cfg.hidden_act),
+        tie_embeddings=True, qkv_bias=True, name=f"{base}-hf",
+    )
+    pre = base + ".encoder.layer.{}"
+    params = {
+        "wte": jnp.asarray(sd[f"{base}.embeddings.word_embeddings.weight"]),
+        "wpe": jnp.asarray(
+            sd[f"{base}.embeddings.position_embeddings.weight"][pos_off:]),
+        "wtt": jnp.asarray(sd[f"{base}.embeddings.token_type_embeddings.weight"]),
+        "ln_emb_scale": jnp.asarray(sd[f"{base}.embeddings.LayerNorm.weight"]),
+        "ln_emb_bias": jnp.asarray(sd[f"{base}.embeddings.LayerNorm.bias"]),
+        "blocks": {
+            "wq": _stackT(sd, pre + ".attention.self.query.weight", L),
+            "wk": _stackT(sd, pre + ".attention.self.key.weight", L),
+            "wv": _stackT(sd, pre + ".attention.self.value.weight", L),
+            "wq_bias": _stack(sd, pre + ".attention.self.query.bias", L),
+            "wk_bias": _stack(sd, pre + ".attention.self.key.bias", L),
+            "wv_bias": _stack(sd, pre + ".attention.self.value.bias", L),
+            "wo": _stackT(sd, pre + ".attention.output.dense.weight", L),
+            "attn_bias": _stack(sd, pre + ".attention.output.dense.bias", L),
+            "ln1_scale": _stack(sd, pre + ".attention.output.LayerNorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".attention.output.LayerNorm.bias", L),
+            "w_up": _stackT(sd, pre + ".intermediate.dense.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".intermediate.dense.bias", L),
+            "w_down": _stackT(sd, pre + ".output.dense.weight", L),
+            "mlp_bias": _stack(sd, pre + ".output.dense.bias", L),
+            "ln2_scale": _stack(sd, pre + ".output.LayerNorm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".output.LayerNorm.bias", L),
+        },
+    }
+    if roberta:
+        params.update({
+            "mlm_dense": jnp.asarray(sd["lm_head.dense.weight"].T),
+            "mlm_dense_bias": jnp.asarray(sd["lm_head.dense.bias"]),
+            "mlm_ln_scale": jnp.asarray(sd["lm_head.layer_norm.weight"]),
+            "mlm_ln_bias": jnp.asarray(sd["lm_head.layer_norm.bias"]),
+            "mlm_bias": jnp.asarray(sd["lm_head.bias"]),
+        })
+    else:
+        params.update({
+            "mlm_dense": jnp.asarray(sd["cls.predictions.transform.dense.weight"].T),
+            "mlm_dense_bias": jnp.asarray(sd["cls.predictions.transform.dense.bias"]),
+            "mlm_ln_scale": jnp.asarray(sd["cls.predictions.transform.LayerNorm.weight"]),
+            "mlm_ln_bias": jnp.asarray(sd["cls.predictions.transform.LayerNorm.bias"]),
+            "mlm_bias": jnp.asarray(sd["cls.predictions.bias"]),
+        })
+    log_dist(f"converted HF {base.upper()}: H={H} L={L} heads={nh} vocab={V}",
+             ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_distilbert(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF DistilBERT MaskedLM (reference
+    ``module_inject/containers/distil_bert.py``). BERT trunk without segment
+    embeddings; MLM head = vocab_transform + vocab_layer_norm + tied projector."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.dim, hf_cfg.n_layers, hf_cfg.n_heads
+    V = hf_cfg.vocab_size
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        intermediate_size=hf_cfg.hidden_dim,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        causal=False, norm_position="post", mlm_head=True,
+        embed_layernorm=True, pos_embedding="learned", norm="layernorm",
+        norm_eps=1e-12, activation=_act(hf_cfg.activation),
+        tie_embeddings=True, qkv_bias=True, name="distilbert-hf",
+    )
+    pre = "distilbert.transformer.layer.{}"
+    params = {
+        "wte": jnp.asarray(sd["distilbert.embeddings.word_embeddings.weight"]),
+        "wpe": jnp.asarray(sd["distilbert.embeddings.position_embeddings.weight"]),
+        "ln_emb_scale": jnp.asarray(sd["distilbert.embeddings.LayerNorm.weight"]),
+        "ln_emb_bias": jnp.asarray(sd["distilbert.embeddings.LayerNorm.bias"]),
+        "blocks": {
+            "wq": _stackT(sd, pre + ".attention.q_lin.weight", L),
+            "wk": _stackT(sd, pre + ".attention.k_lin.weight", L),
+            "wv": _stackT(sd, pre + ".attention.v_lin.weight", L),
+            "wq_bias": _stack(sd, pre + ".attention.q_lin.bias", L),
+            "wk_bias": _stack(sd, pre + ".attention.k_lin.bias", L),
+            "wv_bias": _stack(sd, pre + ".attention.v_lin.bias", L),
+            "wo": _stackT(sd, pre + ".attention.out_lin.weight", L),
+            "attn_bias": _stack(sd, pre + ".attention.out_lin.bias", L),
+            "ln1_scale": _stack(sd, pre + ".sa_layer_norm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".sa_layer_norm.bias", L),
+            "w_up": _stackT(sd, pre + ".ffn.lin1.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".ffn.lin1.bias", L),
+            "w_down": _stackT(sd, pre + ".ffn.lin2.weight", L),
+            "mlp_bias": _stack(sd, pre + ".ffn.lin2.bias", L),
+            "ln2_scale": _stack(sd, pre + ".output_layer_norm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".output_layer_norm.bias", L),
+        },
+        "mlm_dense": jnp.asarray(sd["vocab_transform.weight"].T),
+        "mlm_dense_bias": jnp.asarray(sd["vocab_transform.bias"]),
+        "mlm_ln_scale": jnp.asarray(sd["vocab_layer_norm.weight"]),
+        "mlm_ln_bias": jnp.asarray(sd["vocab_layer_norm.bias"]),
+        "mlm_bias": jnp.asarray(sd["vocab_projector.bias"]),
+    }
+    log_dist(f"converted HF DistilBERT: H={H} L={L} heads={nh} vocab={V}",
+             ranks=[0])
+    return TransformerLM(cfg), params
+
+
 _CONVERTERS = {
     "gpt2": from_hf_gpt2,
     "llama": from_hf_llama,
@@ -628,17 +752,21 @@ _CONVERTERS = {
     "falcon": from_hf_falcon,
     "rwforcausallm": from_hf_falcon,  # pre-rename Falcon checkpoints
     "phi": from_hf_phi,
+    "distilbert": from_hf_distilbert,
+    "roberta": from_hf_bert,
+    "bert": from_hf_bert,
 }
 
 # look-alike architectures with incompatible weight layouts — reject cleanly
 # instead of dispatching to a converter that would die on missing keys
-_UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm"]
+_UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm",
+                "albert", "camembert"]  # look-alike names, different layouts
 
 # match order matters: more specific names first ("gptneox" before "gptneo",
 # "mixtral" before "llama"-substring families)
 _MATCH_ORDER = ["gptneox", "gptj", "gpt2", "mixtral", "qwen2", "internlm",
                 "mistral", "llama", "opt", "bloom", "falcon", "rwforcausallm",
-                "phi"]
+                "phi", "distilbert", "roberta", "bert"]
 
 
 def from_hf(model, **kw):

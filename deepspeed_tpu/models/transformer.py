@@ -49,6 +49,10 @@ class TransformerConfig:
     intermediate_size: Optional[int] = None  # None → 4*H (gelu) or 8/3*H (swiglu)
     max_seq_len: int = 1024
     # family knobs
+    causal: bool = True  # False = bidirectional encoder (BERT family)
+    norm_position: str = "pre"  # "pre" | "post" (BERT-style residual-then-LN)
+    token_type_embedding: int = 0  # >0: BERT segment embeddings (type vocab size)
+    mlm_head: bool = False  # BERT MLM head: dense+act+LN before the tied decoder
     pos_embedding: str = "learned"  # "learned" | "rope" | "alibi" | "none"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
     activation: str = "gelu"  # "gelu" (tanh) | "gelu_exact" | "relu" | "swiglu"
@@ -292,6 +296,7 @@ class TransformerLM:
             return initializer(key, (L,) + shape, dt)
 
         single_ln = cfg.parallel_block and cfg.parallel_shared_ln
+        post_ln = cfg.norm_position == "post"
         params: Dict[str, Any] = {
             "wte": init(k[0], (V, H), dt),
             "blocks": {
@@ -301,8 +306,9 @@ class TransformerLM:
                 "wv": stacked(k[3], (H, kvh * hd)),
                 "wo": stacked(k[4], (nh * hd, H), resid_init),
             },
-            "lnf_scale": jnp.ones((H,), dt),
         }
+        if not post_ln:  # post-LN trunks end normalized; no final LN
+            params["lnf_scale"] = jnp.ones((H,), dt)
         if not single_ln:
             params["blocks"]["ln2_scale"] = jnp.ones((L, H), dt)
         blocks = params["blocks"]
@@ -328,7 +334,8 @@ class TransformerLM:
             blocks["mlp_bias"] = jnp.zeros((L, H), dt)
             if cfg.activation != "swiglu" and E == 0:
                 blocks["mlp_up_bias"] = jnp.zeros((L, I), dt)
-            params["lnf_bias"] = jnp.zeros((H,), dt)
+            if cfg.norm_position != "post":
+                params["lnf_bias"] = jnp.zeros((H,), dt)
         elif cfg.attn_out_bias:
             blocks["attn_bias"] = jnp.zeros((L, H), dt)
         if cfg.qkv_bias:
@@ -339,6 +346,14 @@ class TransformerLM:
             params["ln_emb_scale"] = jnp.ones((H,), dt)
             if cfg.norm == "layernorm":
                 params["ln_emb_bias"] = jnp.zeros((H,), dt)
+        if cfg.token_type_embedding > 0:
+            params["wtt"] = init(k[11], (cfg.token_type_embedding, H), dt)
+        if cfg.mlm_head:
+            params["mlm_dense"] = init(k[10], (H, H), dt)
+            params["mlm_dense_bias"] = jnp.zeros((H,), dt)
+            params["mlm_ln_scale"] = jnp.ones((H,), dt)
+            params["mlm_ln_bias"] = jnp.zeros((H,), dt)
+            params["mlm_bias"] = jnp.zeros((V,), dt)
         if cfg.pos_embedding == "learned":
             params["wpe"] = init(k[8], (cfg.max_seq_len, H), dt)
         if not cfg.tie_embeddings:
@@ -368,8 +383,9 @@ class TransformerLM:
                 "wv": P(None, None, m),
                 "wo": P(None, m, None),
             },
-            "lnf_scale": P(None),
         }
+        if cfg.norm_position != "post":
+            specs["lnf_scale"] = P(None)
         blocks = specs["blocks"]
         if not single_ln:
             blocks["ln2_scale"] = P(None, None)
@@ -394,7 +410,8 @@ class TransformerLM:
             blocks["mlp_bias"] = P(None, None)
             if cfg.activation != "swiglu" and cfg.num_experts == 0:
                 blocks["mlp_up_bias"] = P(None, m)
-            specs["lnf_bias"] = P(None)
+            if cfg.norm_position != "post":
+                specs["lnf_bias"] = P(None)
         elif cfg.attn_out_bias:
             blocks["attn_bias"] = P(None, None)
         if cfg.qkv_bias:
@@ -405,6 +422,14 @@ class TransformerLM:
             specs["ln_emb_scale"] = P(None)
             if cfg.norm == "layernorm":
                 specs["ln_emb_bias"] = P(None)
+        if cfg.token_type_embedding > 0:
+            specs["wtt"] = P(None, None)
+        if cfg.mlm_head:
+            specs["mlm_dense"] = P(None, None)
+            specs["mlm_dense_bias"] = P(None)
+            specs["mlm_ln_scale"] = P(None)
+            specs["mlm_ln_bias"] = P(None)
+            specs["mlm_bias"] = P(m)
         if cfg.pos_embedding == "learned":
             specs["wpe"] = P(None, None)
         if not cfg.tie_embeddings:
@@ -431,7 +456,7 @@ class TransformerLM:
 
     # ------------------------------------------------------------------
     def _block(self, x, blk, *, positions, rng, train, kv_cache=None, cache_index=None,
-               paged=None):
+               paged=None, attn_mask_bias=None):
         """One transformer block on (B, S, H). Returns (y, new_kv) where new_kv is
         the updated (k, v) when decoding with a cache.
 
@@ -449,7 +474,11 @@ class TransformerLM:
         # layer's slice only — XLA fuses the dequant into the matmul reads
         blk = _dequant_woq(blk, x.dtype)
 
-        h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+        # post-LN (BERT family): attention reads the raw residual stream and
+        # ln1/ln2 normalize AFTER each residual add
+        post_ln = cfg.norm_position == "post"
+        h = x if post_ln else _norm(
+            x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
         q = h @ blk["wq"].astype(h.dtype)
         kk = h @ blk["wk"].astype(h.dtype)
         v = h @ blk["wv"].astype(h.dtype)
@@ -514,8 +543,10 @@ class TransformerLM:
             kk = self._constraint(kk, self._heads_spec())
             v = self._constraint(v, self._heads_spec())
             bias = _alibi_bias(positions) if cfg.pos_embedding == "alibi" else None
+            if attn_mask_bias is not None:  # encoder padding mask (B,1,1,1,S)
+                bias = attn_mask_bias if bias is None else bias + attn_mask_bias
             attn_out = _attention_op(
-                q, kk, v, causal=True, num_kv_groups=nh // kvh,
+                q, kk, v, causal=cfg.causal, num_kv_groups=nh // kvh,
                 softcap=cfg.logit_softcap, bias=bias,
             )
         attn_out = attn_out.reshape(B, S, nh * hd)
@@ -527,7 +558,11 @@ class TransformerLM:
             rng, r1 = jax.random.split(rng)
             attn_out = _dropout(attn_out, cfg.dropout, r1, train)
 
-        if cfg.parallel_block:
+        if post_ln:
+            x = _norm(x + attn_out, blk["ln1_scale"], blk.get("ln1_bias"),
+                      cfg.norm, cfg.norm_eps)
+            h2 = x
+        elif cfg.parallel_block:
             h2 = h if cfg.parallel_shared_ln else _norm(
                 x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
         else:
@@ -556,6 +591,10 @@ class TransformerLM:
         if rng is not None:
             rng, r2 = jax.random.split(rng)
             mlp_out = _dropout(mlp_out, cfg.dropout, r2, train)
+        if post_ln:
+            y = _norm(x + mlp_out, blk["ln2_scale"], blk.get("ln2_bias"),
+                      cfg.norm, cfg.norm_eps)
+            return y, new_kv, aux
         if cfg.parallel_block:
             return x + attn_out + mlp_out, new_kv, aux
         return x + mlp_out, new_kv, aux
@@ -578,11 +617,15 @@ class TransformerLM:
         )
 
     # ------------------------------------------------------------------
-    def _embed(self, params, input_ids, positions, dtype):
+    def _embed(self, params, input_ids, positions, dtype, token_type_ids=None):
         cfg = self.config
         x = jnp.take(params["wte"], input_ids, axis=0).astype(dtype)
         if cfg.pos_embedding == "learned":
             x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
+        if cfg.token_type_embedding > 0:
+            tt = token_type_ids if token_type_ids is not None \
+                else jnp.zeros_like(input_ids)
+            x = x + jnp.take(params["wtt"], tt, axis=0).astype(dtype)
         if cfg.embed_layernorm:
             x = _norm(x, params["ln_emb_scale"], params.get("ln_emb_bias"),
                       cfg.norm, cfg.norm_eps)
@@ -595,7 +638,8 @@ class TransformerLM:
             )
         return jax.checkpoint(fn)
 
-    def _trunk(self, params, x, positions, rng, train, pld_theta=None):
+    def _trunk(self, params, x, positions, rng, train, pld_theta=None,
+               attn_mask_bias=None):
         """Run all blocks via scan (remat optional). With ``pld_theta``
         (progressive layer drop, reference ``progressive_layer_drop.py``),
         layer l keeps with prob 1 - (l/L)(1 - theta) — deeper layers dropped more."""
@@ -612,7 +656,8 @@ class TransformerLM:
                 r_drop, r_pld = jax.random.split(rsub)
                 y, _, aux = self._block(h, blk, positions=positions,
                                         rng=r_drop if cfg.dropout > 0 else None,
-                                        train=train)
+                                        train=train,
+                                        attn_mask_bias=attn_mask_bias)
                 if use_pld:
                     keep_p = 1.0 - (idx.astype(jnp.float32) / L) * (1.0 - pld_theta)
                     keep = jax.random.bernoulli(r_pld, keep_p)
@@ -625,14 +670,16 @@ class TransformerLM:
                 block_fn, x, (params["blocks"], rngs, jnp.arange(L)))
         else:
             def body(h, blk):
-                y, _, aux = self._block(h, blk, positions=positions, rng=None, train=train)
+                y, _, aux = self._block(h, blk, positions=positions, rng=None,
+                                        train=train,
+                                        attn_mask_bias=attn_mask_bias)
                 return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
             x, auxes = jax.lax.scan(block_fn, x, params["blocks"])
         return x, jnp.sum(auxes)
 
-    def _trunk_ltd(self, params, x, positions, rng, keep: int):
+    def _trunk_ltd(self, params, x, positions, rng, keep: int, attn_mask=None):
         """Random-LTD trunk (reference ``data_routing/basic_layer.py``): the
         first/last ``skip_ends`` layers run full-sequence (unrolled); the
         middle layers run under ``lax.scan`` on a random ``keep``-token subset
@@ -645,10 +692,16 @@ class TransformerLM:
         rngs = jax.random.split(rng, L)  # rng is never None here (_logits_aux)
         aux_total = jnp.zeros((), jnp.float32)
 
+        def mask_bias_of(m):
+            if m is None:
+                return None
+            return jnp.where(m.astype(bool), 0.0, -1e30)[:, None, None, None, :]
+
         def run_full(h, i):
             blk = jax.tree.map(lambda a: a[i], params["blocks"])
             r = rngs[i] if use_drop else None
-            y, _, aux = self._block(h, blk, positions=positions, rng=r, train=True)
+            y, _, aux = self._block(h, blk, positions=positions, rng=r, train=True,
+                                    attn_mask_bias=mask_bias_of(attn_mask))
             return y, aux
 
         # min()/max() guards tiny models where 2*skip > L — never run a layer
@@ -665,13 +718,15 @@ class TransformerLM:
                 blk, r = layer
                 r_drop, r_ltd = jax.random.split(r)
 
-                def fn(hs, ps):
+                def fn(hs, ps, ms):
                     y, _, aux = self._block(
                         hs, blk, positions=ps,
-                        rng=r_drop if use_drop else None, train=True)
+                        rng=r_drop if use_drop else None, train=True,
+                        attn_mask_bias=mask_bias_of(ms))
                     return y, aux
 
-                return random_ltd_block(fn, h, positions, keep, r_ltd)
+                return random_ltd_block(fn, h, positions, keep, r_ltd,
+                                        key_mask=attn_mask)
 
             block_fn = self._ckpt(body) if cfg.remat else body
             x, auxes = jax.lax.scan(block_fn, x, (mid, mid_rngs))
@@ -684,7 +739,22 @@ class TransformerLM:
 
     def _head(self, params, x):
         cfg = self.config
-        x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg.norm, cfg.norm_eps)
+        if cfg.mlm_head:
+            # BERT prediction head: dense + act + LN, then the tied decoder
+            # (reference kernel-injection covers this via the BERT container)
+            x = x @ params["mlm_dense"].astype(x.dtype) \
+                + params["mlm_dense_bias"].astype(x.dtype)
+            if cfg.activation == "relu":  # transform act follows hidden_act
+                x = jax.nn.relu(x)
+            else:
+                x = jax.nn.gelu(x, approximate=cfg.activation != "gelu_exact")
+            x = _norm(x, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                      "layernorm", cfg.norm_eps)
+            out = x @ params["wte"].T.astype(x.dtype)
+            return out + params["mlm_bias"].astype(x.dtype)
+        if cfg.norm_position != "post":  # post-LN trunks end already normalized
+            x = _norm(x, params["lnf_scale"], params.get("lnf_bias"),
+                      cfg.norm, cfg.norm_eps)
         w = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
         out = x @ w.astype(x.dtype)  # (B,S,V)
         if "lm_head_bias" in params:
@@ -693,7 +763,8 @@ class TransformerLM:
 
     # ------------------------------------------------------------------
     def _logits_aux(self, params, input_ids, positions=None, train=False, rng=None,
-                    pld_theta=None, ltd_keep=None):
+                    pld_theta=None, ltd_keep=None, attention_mask=None,
+                    token_type_ids=None):
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -701,7 +772,12 @@ class TransformerLM:
         dtype = next(
             (l.dtype for l in jax.tree.leaves(params)
              if jnp.issubdtype(l.dtype, jnp.floating)), jnp.float32)
-        x = self._embed(params, input_ids, positions, dtype)
+        mask_bias = None
+        if attention_mask is not None:  # encoder padding: mask keys out
+            mask_bias = jnp.where(attention_mask.astype(bool), 0.0, -1e30
+                                  )[:, None, None, None, :]
+        x = self._embed(params, input_ids, positions, dtype,
+                        token_type_ids=token_type_ids)
         x = self._constraint(x, self._act_spec(True))
         if ltd_keep is not None and train:
             if pld_theta is not None:
@@ -710,13 +786,18 @@ class TransformerLM:
                     "(the LTD trunk has no stochastic-depth path)")
             if rng is None:
                 rng = jax.random.PRNGKey(0)
-            x, aux = self._trunk_ltd(params, x, positions, rng, int(ltd_keep))
+            x, aux = self._trunk_ltd(params, x, positions, rng, int(ltd_keep),
+                                     attn_mask=attention_mask)
         else:
-            x, aux = self._trunk(params, x, positions, rng, train, pld_theta=pld_theta)
+            x, aux = self._trunk(params, x, positions, rng, train,
+                                 pld_theta=pld_theta, attn_mask_bias=mask_bias)
         return self._head(params, x), aux
 
-    def logits(self, params, input_ids, positions=None, train=False, rng=None):
-        return self._logits_aux(params, input_ids, positions, train, rng)[0]
+    def logits(self, params, input_ids, positions=None, train=False, rng=None,
+               attention_mask=None, token_type_ids=None):
+        return self._logits_aux(params, input_ids, positions, train, rng,
+                                attention_mask=attention_mask,
+                                token_type_ids=token_type_ids)[0]
 
     def apply(self, params, batch, train=True, rng=None):
         """Next-token LM loss over the batch (engine protocol).
@@ -742,10 +823,20 @@ class TransformerLM:
         else:
             input_ids, labels, positions = batch, None, None
 
+        attention_mask = token_type_ids = None
+        if isinstance(batch, dict):
+            attention_mask = batch.get("attention_mask")
+            token_type_ids = batch.get("token_type_ids")
         lg, aux = self._logits_aux(params, input_ids, positions=positions,
                                    train=train, rng=rng, pld_theta=pld_theta,
-                                   ltd_keep=ltd_keep)
+                                   ltd_keep=ltd_keep,
+                                   attention_mask=attention_mask,
+                                   token_type_ids=token_type_ids)
         if labels is None:
+            if not self.config.causal:
+                raise ValueError(
+                    "encoder (causal=False) models need explicit labels — "
+                    "next-token shifting only applies to causal LMs")
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1
             )
@@ -831,13 +922,14 @@ class TransformerLM:
 
         x, (nkp, nvp) = jax.lax.scan(
             body, x, (params["blocks"], kv_pool[0], kv_pool[1]))
-        logits = self._head(params, x)  # (B, S, V)
+        # project only each sequence's last VALID position — skips the
+        # (S, V) vocab matmul over the rest of the chunk
         if n_valid is None:
             last = jnp.full((B,), S - 1, jnp.int32)
         else:
             last = jnp.clip(n_valid - 1, 0, S - 1)
-        lg = jnp.take_along_axis(
-            logits, last[:, None, None], axis=1)[:, 0]
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # (B,1,H)
+        lg = self._head(params, x_last)[:, 0]
         return lg, (nkp, nvp)
 
     def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):

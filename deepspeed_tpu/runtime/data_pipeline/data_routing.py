@@ -35,20 +35,24 @@ def random_ltd_apply(layer_fn: Callable, x, keep: int, rng):
     ].set(processed)
 
 
-def random_ltd_block(layer_fn: Callable, x, positions, keep: int, rng):
-    """Trunk form of ``random_ltd_apply``: ``layer_fn(x_sub, pos_sub) ->
-    (y_sub, aux)`` runs on a random sorted ``keep``-token subset with the
-    tokens' ORIGINAL positions (sorted order keeps the causal mask exact:
-    index order equals position order within the subset)."""
+def random_ltd_block(layer_fn: Callable, x, positions, keep: int, rng,
+                     key_mask=None):
+    """Trunk form of ``random_ltd_apply``: ``layer_fn(x_sub, pos_sub,
+    mask_sub) -> (y_sub, aux)`` runs on a random sorted ``keep``-token subset
+    with the tokens' ORIGINAL positions (sorted order keeps the causal mask
+    exact: index order equals position order within the subset). ``key_mask``
+    (B, S) — e.g. an encoder padding mask — is gathered alongside."""
     B, S, H = x.shape
     if keep >= S:
-        return layer_fn(x, positions)
+        return layer_fn(x, positions, key_mask)
     perm = jax.vmap(lambda r: jax.random.permutation(r, S))(
         jax.random.split(rng, B))
     kept_idx = jnp.sort(perm[:, :keep], axis=1)  # (B, keep)
     gathered = jnp.take_along_axis(x, kept_idx[..., None], axis=1)
     pos_sub = jnp.take_along_axis(positions, kept_idx, axis=1)
-    processed, aux = layer_fn(gathered, pos_sub)
+    mask_sub = None if key_mask is None else \
+        jnp.take_along_axis(key_mask, kept_idx, axis=1)
+    processed, aux = layer_fn(gathered, pos_sub, mask_sub)
     y = jnp.array(x).at[jnp.arange(B)[:, None], kept_idx].set(processed)
     return y, aux
 

@@ -160,6 +160,92 @@ class TestHFFamilies:
         m = _parity(hf, 100, atol=5e-3)
         assert m.config.num_experts == 4 and m.config.moe_top_k == 2
 
+    def test_bert_mlm_logits_match(self):
+        import torch
+        from transformers import BertConfig, BertForMaskedLM
+
+        hf = BertForMaskedLM(BertConfig(
+            vocab_size=100, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, type_vocab_size=2)).eval()
+        model, params = from_hf(hf)
+        ids = np.random.default_rng(0).integers(0, 100, (2, 16))
+        tt = np.concatenate([np.zeros((2, 8)), np.ones((2, 8))], 1).astype(np.int64)
+        mask = np.ones((2, 16), np.int64)
+        mask[1, 12:] = 0  # padded tail on one row
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                     token_type_ids=torch.tensor(tt)).logits.numpy()
+        ours = np.asarray(model.logits(
+            params, jnp.asarray(ids, jnp.int32),
+            attention_mask=jnp.asarray(mask, jnp.int32),
+            token_type_ids=jnp.asarray(tt, jnp.int32)))
+        # compare only unpadded positions (HF computes padded ones too but
+        # their values are garbage-by-contract on both sides)
+        np.testing.assert_allclose(ours[0], ref[0], atol=2e-3)
+        np.testing.assert_allclose(ours[1, :12], ref[1, :12], atol=2e-3)
+        assert not model.config.causal and model.config.norm_position == "post"
+
+    def test_roberta_mlm_logits_match(self):
+        import torch
+        from transformers import RobertaConfig, RobertaForMaskedLM
+
+        hf = RobertaForMaskedLM(RobertaConfig(
+            vocab_size=100, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=66, type_vocab_size=1,
+            pad_token_id=1)).eval()
+        model, params = from_hf(hf)
+        ids = np.random.default_rng(5).integers(2, 100, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        ours = np.asarray(model.logits(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, atol=2e-3)
+
+    def test_distilbert_mlm_logits_match(self):
+        import torch
+        from transformers import DistilBertConfig, DistilBertForMaskedLM
+
+        hf = DistilBertForMaskedLM(DistilBertConfig(
+            vocab_size=100, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+            max_position_embeddings=64)).eval()
+        model, params = from_hf(hf)
+        ids = np.random.default_rng(6).integers(0, 100, (2, 16))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        ours = np.asarray(model.logits(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, atol=2e-3)
+
+    def test_encoder_mlm_training_loss(self):
+        """Converted BERT trains through the engine with MLM labels."""
+        import torch
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import topology as topo_mod
+        from transformers import BertConfig, BertForMaskedLM
+
+        topo_mod.reset_topology()
+        hf = BertForMaskedLM(BertConfig(
+            vocab_size=100, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, type_vocab_size=2)).eval()
+        model, params = from_hf(hf)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}, "mesh": {"data": 8}})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 100, (8, 16)).astype(np.int32)
+        labels = np.where(rng.random((8, 16)) < 0.15, ids, -100).astype(np.int32)
+        b = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+        losses = []
+        for _ in range(5):
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
     def test_converted_family_generates(self):
         """A non-trivial family (parallel block + partial rotary) serves through
         the inference engine end to end."""
