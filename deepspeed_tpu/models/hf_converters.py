@@ -619,12 +619,25 @@ def from_hf_bert(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     ``module_inject/containers/bert.py`` + the fused BERT training kernel
     ``ops/transformer/transformer.py:296``). Post-LN encoder trunk with
     segment embeddings, embedding LayerNorm and the MLM prediction head;
-    RoBERTa's +2 position offset is baked out like OPT's."""
+    RoBERTa's +2 position offset is baked out like OPT's.
+
+    Positions are arange-based: RIGHT-padded batches match HF exactly
+    (HF's mask-cumsum position ids equal arange+offset on the unpadded
+    prefix); left padding would shift real-token positions and is not
+    supported."""
     hf_cfg = model.config
     sd = {k: _np(v) for k, v in model.state_dict().items()}
     roberta = "roberta" in type(model).__name__.lower() or \
         hf_cfg.model_type == "roberta"
     base = "roberta" if roberta else "bert"
+    if getattr(hf_cfg, "position_embedding_type", "absolute") != "absolute":
+        raise ValueError(
+            f"{base} position_embedding_type="
+            f"'{hf_cfg.position_embedding_type}' unsupported (absolute only)")
+    if f"{base}.embeddings.word_embeddings.weight" not in sd:
+        raise ValueError(
+            f"no converter for this {base}-named architecture — pass a "
+            f"{'RobertaForMaskedLM' if roberta else 'BertForMaskedLM'} module")
     H, L, nh = hf_cfg.hidden_size, hf_cfg.num_hidden_layers, hf_cfg.num_attention_heads
     V = hf_cfg.vocab_size
     pos_off = 2 if roberta else 0  # roberta: padding_idx+1 baked into wpe
@@ -760,7 +773,8 @@ _CONVERTERS = {
 # look-alike architectures with incompatible weight layouts — reject cleanly
 # instead of dispatching to a converter that would die on missing keys
 _UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm",
-                "albert", "camembert"]  # look-alike names, different layouts
+                "albert", "camembert", "deberta", "mobilebert", "squeezebert",
+                "flaubert"]  # look-alike names, different layouts
 
 # match order matters: more specific names first ("gptneox" before "gptneo",
 # "mixtral" before "llama"-substring families)

@@ -32,6 +32,12 @@ class SparseSelfAttention:
                  attn_mask_mode="mul", max_seq_length: int = 2048):
         self.config = sparsity_config
         self._bias_cache = {}
+        self._layout_cache = {}
+
+    def _layout(self, seq_len: int):
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = self.config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
 
     def _bias(self, seq_len: int):
         if seq_len not in self._bias_cache:
@@ -39,12 +45,34 @@ class SparseSelfAttention:
             self._bias_cache[seq_len] = layout_to_bias(layout, self.config.block)
         return self._bias_cache[seq_len]
 
-    def __call__(self, q, k, v, *, causal: Optional[bool] = None):
-        """q/k/v: (B, S, h, d). Causality defaults to the layout's attention mode."""
+    def __call__(self, q, k, v, *, causal: Optional[bool] = None,
+                 use_kernel: str = "auto"):
+        """q/k/v: (B, S, h, d). Causality defaults to the layout's attention mode.
+
+        ``use_kernel``: "auto" picks the block-skipping Pallas kernel
+        (``block_sparse_kernel.py``) when the layout block is >=128 and the
+        shapes fit; "never" forces the masked-XLA path (the numerics oracle);
+        "always" raises if the kernel cannot run.
+        """
         S = q.shape[1]
-        bias = self._bias(S)  # (H, S, S)
         if causal is None:
             causal = getattr(self.config, "attention", "bidirectional") == "unidirectional"
+        if use_kernel != "never":
+            try:
+                import jax
+
+                # mirror _auto_impl: interpreted Pallas on CPU/GPU would be a
+                # silent massive slowdown vs the fused XLA mask path
+                if use_kernel == "auto" and jax.default_backend() != "tpu":
+                    raise NotImplementedError("block_sparse kernel: TPU only")
+                from .block_sparse_kernel import block_sparse_attention
+
+                return block_sparse_attention(
+                    q, k, v, self._layout(S), self.config.block, causal=causal)
+            except NotImplementedError:
+                if use_kernel == "always":
+                    raise
+        bias = self._bias(S)  # (H, S, S)
         # bias broadcast: attention expects (B?, h, groups, Sq, Sk)-compatible
         return attention(q, k, v, causal=causal,
                          bias=bias[None, :, None, :, :], impl="xla")
