@@ -614,6 +614,103 @@ def from_hf_mixtral(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     return TransformerLM(cfg), params
 
 
+def from_hf_gemma(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF Gemma causal LM. LLaMA skeleton with Gemma's quirks:
+    explicit head_dim != H/heads, RMSNorm computing with (1 + weight),
+    sqrt(H)-scaled embeddings, and a tanh-gelu gated MLP (geglu)."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L = hf_cfg.hidden_size, hf_cfg.num_hidden_layers
+    nh = hf_cfg.num_attention_heads
+    kvh = getattr(hf_cfg, "num_key_value_heads", nh)
+    V = hf_cfg.vocab_size
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=kvh,
+        head_dim_override=int(hf_cfg.head_dim),
+        intermediate_size=hf_cfg.intermediate_size,
+        max_seq_len=getattr(hf_cfg, "max_position_embeddings", 8192),
+        pos_embedding="rope", norm="rmsnorm", activation="geglu",
+        tie_embeddings=True, norm_eps=hf_cfg.rms_norm_eps,
+        norm_weight_offset=1.0, embed_scale=float(H) ** 0.5,
+        rope_theta=float(getattr(hf_cfg, "rope_theta", 10000.0)), name="gemma-hf",
+    )
+    pre = "model.layers.{}"
+    params = {
+        "wte": jnp.asarray(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "wq": _stackT(sd, pre + ".self_attn.q_proj.weight", L),
+            "wk": _stackT(sd, pre + ".self_attn.k_proj.weight", L),
+            "wv": _stackT(sd, pre + ".self_attn.v_proj.weight", L),
+            "wo": _stackT(sd, pre + ".self_attn.o_proj.weight", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "w_gate": _stackT(sd, pre + ".mlp.gate_proj.weight", L),
+            "w_up": _stackT(sd, pre + ".mlp.up_proj.weight", L),
+            "w_down": _stackT(sd, pre + ".mlp.down_proj.weight", L),
+        },
+        "lnf_scale": jnp.asarray(sd["model.norm.weight"]),
+    }
+    log_dist(f"converted HF Gemma: H={H} L={L} heads={nh}/{kvh} "
+             f"hd={hf_cfg.head_dim} vocab={V}", ranks=[0])
+    return TransformerLM(cfg), params
+
+
+def from_hf_gpt_bigcode(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF GPT-BigCode / StarCoder causal LM (reference v2 supports
+    it via AutoTP). GPT-2 layout but with torch-Linear (out, in) weights and a
+    fused multi-query c_attn: rows = [q (H), k (hd), v (hd)]."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.n_embd, hf_cfg.n_layer, hf_cfg.n_head
+    hd = H // nh
+    V = hf_cfg.vocab_size
+    if not getattr(hf_cfg, "multi_query", True):
+        raise ValueError("GPT-BigCode without multi_query unsupported")
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh, num_kv_heads=1,
+        max_seq_len=hf_cfg.n_positions, pos_embedding="learned",
+        norm="layernorm", norm_eps=hf_cfg.layer_norm_epsilon,
+        activation=_act(hf_cfg.activation_function),
+        tie_embeddings=True, qkv_bias=True, name="gpt_bigcode-hf",
+    )
+    pre = "transformer.h.{}"
+
+    def split_qkv(i):
+        w = sd[pre.format(i) + ".attn.c_attn.weight"]  # (H + 2*hd, H)
+        b = sd[pre.format(i) + ".attn.c_attn.bias"]
+        return ((w[:H].T, w[H:H + hd].T, w[H + hd:].T),
+                (b[:H], b[H:H + hd], b[H + hd:]))
+
+    qkv = [split_qkv(i) for i in range(L)]
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"]),
+        "wpe": jnp.asarray(sd["transformer.wpe.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".ln_1.weight", L),
+            "ln1_bias": _stack(sd, pre + ".ln_1.bias", L),
+            "wq": jnp.asarray(np.stack([w[0] for w, _ in qkv])),
+            "wk": jnp.asarray(np.stack([w[1] for w, _ in qkv])),
+            "wv": jnp.asarray(np.stack([w[2] for w, _ in qkv])),
+            "wq_bias": jnp.asarray(np.stack([b[0] for _, b in qkv])),
+            "wk_bias": jnp.asarray(np.stack([b[1] for _, b in qkv])),
+            "wv_bias": jnp.asarray(np.stack([b[2] for _, b in qkv])),
+            "wo": _stackT(sd, pre + ".attn.c_proj.weight", L),
+            "attn_bias": _stack(sd, pre + ".attn.c_proj.bias", L),
+            "ln2_scale": _stack(sd, pre + ".ln_2.weight", L),
+            "ln2_bias": _stack(sd, pre + ".ln_2.bias", L),
+            "w_up": _stackT(sd, pre + ".mlp.c_fc.weight", L),
+            "mlp_up_bias": _stack(sd, pre + ".mlp.c_fc.bias", L),
+            "w_down": _stackT(sd, pre + ".mlp.c_proj.weight", L),
+            "mlp_bias": _stack(sd, pre + ".mlp.c_proj.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+    }
+    log_dist(f"converted HF GPT-BigCode: H={H} L={L} heads={nh}/1 vocab={V}",
+             ranks=[0])
+    return TransformerLM(cfg), params
+
+
 def from_hf_bert(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     """Convert an HF BERT/RoBERTa MaskedLM (reference
     ``module_inject/containers/bert.py`` + the fused BERT training kernel
@@ -768,19 +865,23 @@ _CONVERTERS = {
     "distilbert": from_hf_distilbert,
     "roberta": from_hf_bert,
     "bert": from_hf_bert,
+    "gemma": from_hf_gemma,
+    "gptbigcode": from_hf_gpt_bigcode,
 }
 
 # look-alike architectures with incompatible weight layouts — reject cleanly
 # instead of dispatching to a converter that would die on missing keys
 _UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm",
                 "albert", "camembert", "deberta", "mobilebert", "squeezebert",
-                "flaubert"]  # look-alike names, different layouts
+                "flaubert", "gemma2", "gemma3", "recurrentgemma",
+                "paligemma"]  # look-alike names, different layouts
 
 # match order matters: more specific names first ("gptneox" before "gptneo",
 # "mixtral" before "llama"-substring families)
-_MATCH_ORDER = ["gptneox", "gptj", "gpt2", "mixtral", "qwen2", "internlm",
-                "mistral", "llama", "opt", "bloom", "falcon", "rwforcausallm",
-                "phi", "distilbert", "roberta", "bert"]
+_MATCH_ORDER = ["gptneox", "gptj", "gptbigcode", "gpt2", "mixtral", "qwen2",
+                "internlm", "mistral", "llama", "opt", "bloom", "falcon",
+                "rwforcausallm", "phi", "distilbert", "roberta", "bert",
+                "gemma"]
 
 
 def from_hf(model, **kw):

@@ -46,6 +46,7 @@ class TransformerConfig:
     num_layers: int = 12
     num_heads: int = 12
     num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    head_dim_override: Optional[int] = None  # Gemma: head_dim != H/num_heads
     intermediate_size: Optional[int] = None  # None → 4*H (gelu) or 8/3*H (swiglu)
     max_seq_len: int = 1024
     # family knobs
@@ -55,11 +56,13 @@ class TransformerConfig:
     mlm_head: bool = False  # BERT MLM head: dense+act+LN before the tied decoder
     pos_embedding: str = "learned"  # "learned" | "rope" | "alibi" | "none"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
-    activation: str = "gelu"  # "gelu" (tanh) | "gelu_exact" | "relu" | "swiglu"
+    activation: str = "gelu"  # "gelu" (tanh) | "gelu_exact" | "relu" | "swiglu" | "geglu"
     tie_embeddings: bool = True
     qkv_bias: bool = False  # GPT-2-style biases on q/k/v projections
     attn_out_bias: bool = False  # bias on the attention out-proj even under rmsnorm (InternLM)
     norm_eps: float = 1e-5
+    norm_weight_offset: float = 0.0  # Gemma RMSNorm: scale = offset + weight
+    embed_scale: Optional[float] = None  # Gemma: embeddings scaled by sqrt(H)
     rope_theta: float = 10000.0
     rotary_dim: Optional[int] = None  # partial rotary (GPT-J/NeoX/Phi); None = head_dim
     # parallel residual: x + attn(ln(x)) + mlp(ln(x)) (GPT-J/NeoX/Falcon/Phi,
@@ -102,7 +105,7 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return self.head_dim_override or (self.hidden_size // self.num_heads)
 
     @property
     def mlp_dim(self) -> int:
@@ -117,9 +120,10 @@ class TransformerConfig:
     @property
     def num_parameters(self) -> int:
         H, L, V, I = self.hidden_size, self.num_layers, self.vocab_size, self.mlp_dim
-        kvh = self.kv_heads * self.head_dim
-        attn = H * H + 2 * H * kvh + H * H  # q, k, v, o
-        mlp = (3 if self.activation == "swiglu" else 2) * H * I
+        qd = self.num_heads * self.head_dim
+        kvd = self.kv_heads * self.head_dim
+        attn = H * qd + 2 * H * kvd + qd * H  # q, k, v, o
+        mlp = (3 if self.activation in ("swiglu", "geglu") else 2) * H * I
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + H * self.num_experts  # experts + router
         n_ln = 1 if (self.parallel_block and self.parallel_shared_ln) else 2
@@ -211,11 +215,12 @@ MODEL_PRESETS = {
 # functional pieces
 # ----------------------------------------------------------------------------
 
-def _norm(x, scale, bias, kind: str, eps: float):
+def _norm(x, scale, bias, kind: str, eps: float, weight_offset: float = 0.0):
     xf = x.astype(jnp.float32)
     if kind == "rmsnorm":
         var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
-        y = xf * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(var + eps) * (
+            weight_offset + scale.astype(jnp.float32))
     else:
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)
@@ -321,7 +326,7 @@ class TransformerLM:
                 blocks["w_gate"] = stacked(k[7], (E, H, I))
         else:
             blocks["w_down"] = stacked(k[6], (I, H), resid_init)
-            if cfg.activation == "swiglu":
+            if cfg.activation in ("swiglu", "geglu"):
                 blocks["w_gate"] = stacked(k[5], (H, I))
                 blocks["w_up"] = stacked(k[7], (H, I))
             else:
@@ -332,7 +337,7 @@ class TransformerLM:
                 blocks["ln2_bias"] = jnp.zeros((L, H), dt)
             blocks["attn_bias"] = jnp.zeros((L, H), dt)
             blocks["mlp_bias"] = jnp.zeros((L, H), dt)
-            if cfg.activation != "swiglu" and E == 0:
+            if cfg.activation not in ("swiglu", "geglu") and E == 0:
                 blocks["mlp_up_bias"] = jnp.zeros((L, I), dt)
             if cfg.norm_position != "post":
                 params["lnf_bias"] = jnp.zeros((H,), dt)
@@ -400,7 +405,7 @@ class TransformerLM:
         else:
             blocks["w_down"] = P(None, m, None)
             blocks["w_up"] = P(None, None, m)
-            if cfg.activation == "swiglu":
+            if cfg.activation in ("swiglu", "geglu"):
                 blocks["w_gate"] = P(None, None, m)
         if cfg.norm == "layernorm":
             blocks["ln1_bias"] = P(None, None)
@@ -408,7 +413,7 @@ class TransformerLM:
                 blocks["ln2_bias"] = P(None, None)
             blocks["attn_bias"] = P(None, None)
             blocks["mlp_bias"] = P(None, None)
-            if cfg.activation != "swiglu" and cfg.num_experts == 0:
+            if cfg.activation not in ("swiglu", "geglu") and cfg.num_experts == 0:
                 blocks["mlp_up_bias"] = P(None, m)
             if cfg.norm_position != "post":
                 specs["lnf_bias"] = P(None)
@@ -478,7 +483,8 @@ class TransformerLM:
         # ln1/ln2 normalize AFTER each residual add
         post_ln = cfg.norm_position == "post"
         h = x if post_ln else _norm(
-            x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps)
+            x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps,
+            cfg.norm_weight_offset)
         q = h @ blk["wq"].astype(h.dtype)
         kk = h @ blk["wk"].astype(h.dtype)
         v = h @ blk["wv"].astype(h.dtype)
@@ -560,22 +566,26 @@ class TransformerLM:
 
         if post_ln:
             x = _norm(x + attn_out, blk["ln1_scale"], blk.get("ln1_bias"),
-                      cfg.norm, cfg.norm_eps)
+                      cfg.norm, cfg.norm_eps, cfg.norm_weight_offset)
             h2 = x
         elif cfg.parallel_block:
             h2 = h if cfg.parallel_shared_ln else _norm(
-                x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+                x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps,
+                cfg.norm_weight_offset)
         else:
             x = x + attn_out
-            h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps)
+            h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps,
+                       cfg.norm_weight_offset)
         aux = jnp.zeros((), jnp.float32)
         if cfg.num_experts > 0:
             mlp_out, aux = self._moe_ffn(h2, blk, train)
         else:
-            if cfg.activation == "swiglu":
+            if cfg.activation in ("swiglu", "geglu"):
                 g = h2 @ blk["w_gate"].astype(h.dtype)
                 u = h2 @ blk["w_up"].astype(h.dtype)
-                inter = jax.nn.silu(g) * u
+                act = jax.nn.silu if cfg.activation == "swiglu" else \
+                    partial(jax.nn.gelu, approximate=True)
+                inter = act(g) * u
             else:
                 up = h2 @ blk["w_up"].astype(h.dtype)
                 if "mlp_up_bias" in blk:
@@ -593,7 +603,7 @@ class TransformerLM:
             mlp_out = _dropout(mlp_out, cfg.dropout, r2, train)
         if post_ln:
             y = _norm(x + mlp_out, blk["ln2_scale"], blk.get("ln2_bias"),
-                      cfg.norm, cfg.norm_eps)
+                      cfg.norm, cfg.norm_eps, cfg.norm_weight_offset)
             return y, new_kv, aux
         if cfg.parallel_block:
             return x + attn_out + mlp_out, new_kv, aux
@@ -620,6 +630,8 @@ class TransformerLM:
     def _embed(self, params, input_ids, positions, dtype, token_type_ids=None):
         cfg = self.config
         x = jnp.take(params["wte"], input_ids, axis=0).astype(dtype)
+        if cfg.embed_scale is not None:
+            x = x * jnp.asarray(cfg.embed_scale, dtype)
         if cfg.pos_embedding == "learned":
             x = x + jnp.take(params["wpe"], positions, axis=0).astype(dtype)
         if cfg.token_type_embedding > 0:
@@ -628,7 +640,7 @@ class TransformerLM:
             x = x + jnp.take(params["wtt"], tt, axis=0).astype(dtype)
         if cfg.embed_layernorm:
             x = _norm(x, params["ln_emb_scale"], params.get("ln_emb_bias"),
-                      cfg.norm, cfg.norm_eps)
+                      cfg.norm, cfg.norm_eps, cfg.norm_weight_offset)
         return x
 
     def _ckpt(self, fn):
@@ -754,7 +766,7 @@ class TransformerLM:
             return out + params["mlm_bias"].astype(x.dtype)
         if cfg.norm_position != "post":  # post-LN trunks end already normalized
             x = _norm(x, params["lnf_scale"], params.get("lnf_bias"),
-                      cfg.norm, cfg.norm_eps)
+                      cfg.norm, cfg.norm_eps, cfg.norm_weight_offset)
         w = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
         out = x @ w.astype(x.dtype)  # (B,S,V)
         if "lm_head_bias" in params:

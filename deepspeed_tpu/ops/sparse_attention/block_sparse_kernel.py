@@ -257,6 +257,7 @@ def _sparse_bwd(kcnt, kidx, qcnt, qidx, causal, g, scale, block, res, do):
 
 
 _FN_CACHE = {}
+_FN_CACHE_MAX = 16
 
 
 def _make_sparse_fn(kcnt, kidx, qcnt, qidx, causal, g, scale, block):
@@ -297,10 +298,17 @@ def block_sparse_attention(q, k, v, layout: np.ndarray, block: int, *,
     if layout.shape != (nh, Sq // block, Skv // block):
         raise ValueError(f"layout shape {layout.shape} != "
                          f"{(nh, Sq // block, Skv // block)}")
+    # K/V (and Q/dO in the backward) are staged whole per grid cell, like the
+    # dense flash kernel — guard the VMEM window; per-active-block DMA is the
+    # future long-context path
+    if 2 * Skv * hd * k.dtype.itemsize > 12 * 1024 * 1024:
+        raise NotImplementedError("block_sparse kernel: KV window exceeds VMEM budget")
     scale = scale if scale is not None else hd ** -0.5
     key = (layout.tobytes(), bool(causal), num_kv_groups, float(scale), block)
     fn = _FN_CACHE.get(key)
     if fn is None:
+        if len(_FN_CACHE) >= _FN_CACHE_MAX:  # bound device-array pinning
+            _FN_CACHE.pop(next(iter(_FN_CACHE)))
         lists = layout_to_lists(np.asarray(layout, bool), causal)
         fn = _FN_CACHE[key] = _make_sparse_fn(
             *lists, causal, num_kv_groups, scale, block)

@@ -41,8 +41,8 @@ class SparseSelfAttention:
 
     def _bias(self, seq_len: int):
         if seq_len not in self._bias_cache:
-            layout = self.config.make_layout(seq_len)
-            self._bias_cache[seq_len] = layout_to_bias(layout, self.config.block)
+            self._bias_cache[seq_len] = layout_to_bias(
+                self._layout(seq_len), self.config.block)
         return self._bias_cache[seq_len]
 
     def __call__(self, q, k, v, *, causal: Optional[bool] = None,

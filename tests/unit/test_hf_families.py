@@ -160,6 +160,26 @@ class TestHFFamilies:
         m = _parity(hf, 100, atol=5e-3)
         assert m.config.num_experts == 4 and m.config.moe_top_k == 2
 
+    def test_gemma_geglu_headdim(self):
+        from transformers import GemmaConfig, GemmaForCausalLM
+
+        hf = GemmaForCausalLM(GemmaConfig(
+            vocab_size=100, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=24, max_position_embeddings=64))  # head_dim != H/nh
+        m = _parity(hf, 100, atol=5e-3)
+        assert m.config.head_dim == 24 and m.config.norm_weight_offset == 1.0
+        assert m.config.activation == "geglu"
+
+    def test_gpt_bigcode_multiquery(self):
+        from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+
+        hf = GPTBigCodeForCausalLM(GPTBigCodeConfig(
+            vocab_size=100, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            multi_query=True))
+        m = _parity(hf, 100)
+        assert m.config.kv_heads == 1
+
     def test_bert_mlm_logits_match(self):
         import torch
         from transformers import BertConfig, BertForMaskedLM
