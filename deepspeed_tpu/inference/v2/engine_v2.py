@@ -9,7 +9,8 @@ ragged batch becomes **bucketed static shapes**:
 
 - KV cache, two layouts: dense per-sequence slots
   (L, max_seqs, max_seq_len, kvh, hd), or ``paged=True`` blocked pool
-  (L, num_blocks, block_size, kvh, hd) with per-sequence block tables
+  (L, kvh, num_blocks, block_size, hd — kv-head-major for the Pallas
+  paged-decode kernel) with per-sequence block tables
   (reference ``BlockedKVCache``) — total KV memory is shared across
   sequences, so many short sequences fit where dedicated slots would not;
   attention runs on the table-gathered logical cache with position masks.

@@ -25,6 +25,7 @@ Architecture choices driven by XLA/TPU:
   iota comparison (no materialised (S,S) bool tensor at peak memory).
 """
 
+import os
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -465,8 +466,8 @@ class TransformerLM:
         """One transformer block on (B, S, H). Returns (y, new_kv) where new_kv is
         the updated (k, v) when decoding with a cache.
 
-        ``paged``: (kp, vp, tables) for a blocked KV pool — kp/vp
-        (NB, BS, kvh, hd), tables (B, MAXB) of pool block ids (0 = reserved
+        ``paged``: (kp, vp, tables) for a blocked KV pool — kp/vp kv-head-major
+        (kvh, NB, BS, hd), tables (B, MAXB) of pool block ids (0 = reserved
         trash block). Tokens write at their ``positions`` via block-table
         scatter; attention runs against the table-gathered logical cache with
         a per-sequence position mask (covers chunked prefill AND decode —
@@ -512,26 +513,42 @@ class TransformerLM:
 
         new_kv = None
         if paged is not None:
-            kp, vp, tables = paged
-            BS = kp.shape[1]
+            kp, vp, tables = paged  # pool: (kvh, NB, BS, hd) kv-head-major
+            BS = kp.shape[2]
             # scatter this segment's k/v into the pool at its block/offset
             blk_idx = jnp.take_along_axis(tables, positions // BS, axis=1)  # (B,S)
             off = positions % BS
-            kp = kp.at[blk_idx, off].set(kk.astype(kp.dtype))
-            vp = vp.at[blk_idx, off].set(v.astype(vp.dtype))
+            kp = kp.at[:, blk_idx, off].set(
+                kk.astype(kp.dtype).transpose(2, 0, 1, 3))
+            vp = vp.at[:, blk_idx, off].set(
+                v.astype(vp.dtype).transpose(2, 0, 1, 3))
             new_kv = (kp, vp)
-            gk = kp[tables].reshape(B, -1, kvh, hd)  # (B, T=MAXB*BS, kvh, hd)
-            gv = vp[tables].reshape(B, -1, kvh, hd)
-            T = gk.shape[1]
-            kpos = jnp.arange(T)
-            mask = kpos[None, None, :] <= positions[:, :, None]  # (B,S,T)
-            bias = jnp.where(mask, 0.0, -1e30)[:, None, None]  # (B,1,1,S,T)
-            if cfg.pos_embedding == "alibi":
-                bias = bias + _alibi_bias(kpos)
-            attn_out = _attention_op(
-                q, gk, gv, causal=False, num_kv_groups=nh // kvh,
-                softcap=cfg.logit_softcap, bias=bias,
+            use_kernel = (
+                S == 1 and cfg.pos_embedding != "alibi"
+                and not cfg.logit_softcap
+                and (jax.default_backend() == "tpu"
+                     or os.environ.get("DSTPU_FORCE_PAGED_KERNEL") == "1")
             )
+            if use_kernel:
+                # Pallas paged decode: pool blocks stream via the block table's
+                # index map — no materialized gather copy (paged_attention.py)
+                from ..ops.transformer.paged_attention import paged_decode_attention
+
+                attn_out = paged_decode_attention(
+                    q[:, 0], kp, vp, tables, positions[:, 0] + 1)[:, None]
+            else:
+                gk = jnp.moveaxis(kp[:, tables], 0, 3).reshape(B, -1, kvh, hd)
+                gv = jnp.moveaxis(vp[:, tables], 0, 3).reshape(B, -1, kvh, hd)
+                T = gk.shape[1]
+                kpos = jnp.arange(T)
+                mask = kpos[None, None, :] <= positions[:, :, None]  # (B,S,T)
+                bias = jnp.where(mask, 0.0, -1e30)[:, None, None]  # (B,1,1,S,T)
+                if cfg.pos_embedding == "alibi":
+                    bias = bias + _alibi_bias(kpos)
+                attn_out = _attention_op(
+                    q, gk, gv, causal=False, num_kv_groups=nh // kvh,
+                    softcap=cfg.logit_softcap, bias=bias,
+                )
         elif kv_cache is not None:
             ck, cv = kv_cache  # (B, T, kvh, hd)
             ck = jax.lax.dynamic_update_slice(ck, kk.astype(ck.dtype), (0, cache_index, 0, 0))
@@ -904,10 +921,11 @@ class TransformerLM:
     # paged (blocked) KV cache — reference inference/v2 BlockedKVCache path
     # ------------------------------------------------------------------
     def init_kv_pool(self, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
-        """Blocked KV pool (L, NB, BS, kvh, hd); block 0 is the reserved trash
-        block that masked/padded writes land in."""
+        """Blocked KV pool (L, kvh, NB, BS, hd) — kv-head-major so the Pallas
+        paged-decode kernel can stream (BS, hd) tiles; block 0 is the reserved
+        trash block that masked/padded writes land in."""
         cfg = self.config
-        shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, cfg.kv_heads, num_blocks, block_size, cfg.head_dim)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     def forward_paged(self, params, input_ids, kv_pool, tables, starts,

@@ -1,0 +1,113 @@
+"""Pallas paged-attention decode kernel (blocked KV pool + block tables).
+
+Reference: ``deepspeed/inference/v2/kernels/ragged_ops/blocked_flash`` — flash
+attention over paged KV blocks addressed through per-sequence block tables.
+
+TPU design: the XLA fallback in ``TransformerLM.forward_paged`` materializes
+the table-gathered logical cache (read pool + write copy) every decode step;
+this kernel instead streams ONE pool block per grid step straight from HBM,
+with the block id resolved in the BlockSpec index map from the
+scalar-prefetched table — the canonical TPU paged-attention pattern. Online
+softmax state lives in VMEM scratch across the (sequential) block-step axis
+of the grid.
+
+Decode only (one query token per sequence); prefill keeps the XLA path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_size, scale, max_blocks):
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = lens_ref[b]
+    # tokens this block holds: positions [j*BS, j*BS + BS) ∩ [0, seq_len)
+    @pl.when(j * block_size < seq_len)
+    def _step():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # (g, hd)
+        k = k_ref[0, 0, :, :].astype(jnp.float32)          # (BS, hd)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (g, BS)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < seq_len, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = alpha * l_ref[:, 0] + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:, 0] = m_new
+
+    @pl.when(j == max_blocks - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lens, *, scale=None):
+    """One-token decode attention against a blocked KV pool.
+
+    q: (B, nh, hd) — this step's query per sequence.
+    k_pool/v_pool: (kvh, NB, BS, hd) — kv-head-major so a pool block is a
+    Mosaic-tileable (BS, hd) tile; tables: (B, MAXB) int32 pool block ids
+    (0-padded); lens: (B,) int32 valid token counts (position + 1).
+    Returns (B, nh, hd) in q's dtype.
+    """
+    B, nh, hd = q.shape
+    kvh, NB, BS, _ = k_pool.shape
+    MAXB = tables.shape[1]
+    g = nh // kvh
+    qg = q.reshape(B, kvh, g, hd)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lens
+        grid=(B, kvh, MAXB),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd), lambda b, h, j, tables, lens: (b, h, 0, 0)),
+            # THE paged trick: each grid step fetches pool block tables[b, j]
+            pl.BlockSpec((1, 1, BS, hd),
+                         lambda b, h, j, tables, lens: (h, tables[b, j], 0, 0)),
+            pl.BlockSpec((1, 1, BS, hd),
+                         lambda b, h, j, tables, lens: (h, tables[b, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda b, h, j, tables, lens: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),   # m
+            pltpu.VMEM((g, 1), jnp.float32),   # l
+            pltpu.VMEM((g, hd), jnp.float32),  # acc
+        ],
+    )
+    scale = scale if scale is not None else hd ** -0.5
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=BS, scale=scale,
+                          max_blocks=MAXB),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, kvh, g, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(tables, lens, qg, k_pool, v_pool)
+    return out.reshape(B, nh, hd)
