@@ -523,9 +523,17 @@ class TransformerLM:
             vp = vp.at[:, blk_idx, off].set(
                 v.astype(vp.dtype).transpose(2, 0, 1, 3))
             new_kv = (kp, vp)
+            from ..ops.transformer.attention import get_default_impl
+
+            # NOTE: evaluated at TRACE time — the env override (used by tests
+            # to exercise this branch in interpret mode) and set_default_impl
+            # must be set before the engine compiles its decode program
             use_kernel = (
                 S == 1 and cfg.pos_embedding != "alibi"
                 and not cfg.logit_softcap
+                and get_default_impl() != "xla"  # operator escape hatch
+                and hd in (64, 128, 256)  # Mosaic-validated head dims
+                and kp.shape[2] % 8 == 0  # block_size sublane alignment
                 and (jax.default_backend() == "tpu"
                      or os.environ.get("DSTPU_FORCE_PAGED_KERNEL") == "1")
             )

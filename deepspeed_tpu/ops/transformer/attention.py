@@ -35,6 +35,10 @@ def set_default_impl(name: Optional[str]):
     _DEFAULT_IMPL = name
 
 
+def get_default_impl() -> Optional[str]:
+    return _DEFAULT_IMPL
+
+
 def _auto_impl(q) -> str:
     if _DEFAULT_IMPL is not None:
         return _DEFAULT_IMPL
