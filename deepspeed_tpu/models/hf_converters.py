@@ -711,6 +711,71 @@ def from_hf_gpt_bigcode(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     return TransformerLM(cfg), params
 
 
+def from_hf_mpt(model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Convert an HF MPT causal LM (reference AutoTP-supported family).
+    ALiBi positions (MPT's slope formula equals the standard closest-power
+    form for power-of-two head counts — others are rejected), bias-free
+    LayerNorm blocks, straight-split fused Wqkv."""
+    hf_cfg = model.config
+    sd = {k: _np(v) for k, v in model.state_dict().items()}
+    H, L, nh = hf_cfg.d_model, hf_cfg.n_layers, hf_cfg.n_heads
+    V = hf_cfg.vocab_size
+    if nh & (nh - 1):
+        raise ValueError("MPT with non-power-of-two heads uses a different "
+                         "ALiBi slope selection — unsupported")
+    attn_cfg = getattr(hf_cfg, "attn_config", None)
+    # HF MptModel applies ALiBi unconditionally and MptMLP hardcodes 4*H;
+    # clip_qkv / softmax_scale change attention math — reject rather than
+    # silently diverge from the logits-exact contract
+    if attn_cfg is not None:
+        if getattr(attn_cfg, "clip_qkv", None):
+            raise ValueError("MPT attn_config.clip_qkv unsupported")
+        if getattr(attn_cfg, "softmax_scale", None):
+            raise ValueError("MPT attn_config.softmax_scale unsupported")
+    if int(getattr(hf_cfg, "expansion_ratio", 4)) != 4:
+        raise ValueError("MPT expansion_ratio != 4 unsupported "
+                         "(HF MptMLP hardcodes 4*hidden_size)")
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=nh,
+        intermediate_size=4 * H,
+        max_seq_len=hf_cfg.max_seq_len,
+        pos_embedding="alibi",
+        norm="layernorm", norm_eps=getattr(hf_cfg, "layer_norm_epsilon", 1e-5),
+        activation="gelu_exact", tie_embeddings=True, qkv_bias=False,
+        name="mpt-hf",
+    )
+    pre = "transformer.blocks.{}"
+
+    def split_qkv(i):
+        w = sd[pre.format(i) + ".attn.Wqkv.weight"]  # (3H, H), straight [q;k;v]
+        return w[:H].T, w[H:2 * H].T, w[2 * H:].T
+
+    qkv = [split_qkv(i) for i in range(L)]
+    zeros_h = jnp.zeros((L, H), jnp.float32)
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".norm_1.weight", L),
+            "ln1_bias": zeros_h,
+            "wq": jnp.asarray(np.stack([w[0] for w in qkv])),
+            "wk": jnp.asarray(np.stack([w[1] for w in qkv])),
+            "wv": jnp.asarray(np.stack([w[2] for w in qkv])),
+            "wo": _stackT(sd, pre + ".attn.out_proj.weight", L),
+            "attn_bias": zeros_h,
+            "ln2_scale": _stack(sd, pre + ".norm_2.weight", L),
+            "ln2_bias": zeros_h,
+            "w_up": _stackT(sd, pre + ".ffn.up_proj.weight", L),
+            "mlp_up_bias": jnp.zeros((L, cfg.mlp_dim), jnp.float32),
+            "w_down": _stackT(sd, pre + ".ffn.down_proj.weight", L),
+            "mlp_bias": zeros_h,
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.norm_f.weight"]),
+        "lnf_bias": jnp.zeros((H,), jnp.float32),
+    }
+    log_dist(f"converted HF MPT: H={H} L={L} heads={nh} (alibi)", ranks=[0])
+    return TransformerLM(cfg), params
+
+
 def from_hf_bert(model) -> Tuple[TransformerLM, Dict[str, Any]]:
     """Convert an HF BERT/RoBERTa MaskedLM (reference
     ``module_inject/containers/bert.py`` + the fused BERT training kernel
@@ -867,6 +932,7 @@ _CONVERTERS = {
     "bert": from_hf_bert,
     "gemma": from_hf_gemma,
     "gptbigcode": from_hf_gpt_bigcode,
+    "mpt": from_hf_mpt,
 }
 
 # look-alike architectures with incompatible weight layouts — reject cleanly
@@ -881,7 +947,7 @@ _UNSUPPORTED = ["phi3", "phimoe", "internlm2", "qwen2moe", "gptneoforcausallm",
 _MATCH_ORDER = ["gptneox", "gptj", "gptbigcode", "gpt2", "mixtral", "qwen2",
                 "internlm", "mistral", "llama", "opt", "bloom", "falcon",
                 "rwforcausallm", "phi", "distilbert", "roberta", "bert",
-                "gemma"]
+                "gemma", "mpt"]
 
 
 def from_hf(model, **kw):

@@ -283,3 +283,27 @@ class TestHFFamilies:
         ids = jnp.asarray(np.random.default_rng(0).integers(0, 100, (1, 8)), jnp.int32)
         out = eng.generate(ids, max_new_tokens=4, temperature=0.0)
         assert out.shape == (1, 4)
+
+
+class TestMPT:
+    def test_mpt_alibi_logits_match(self):
+        import torch
+        from transformers import MptConfig, MptForCausalLM
+
+        from deepspeed_tpu.comm import topology as topo_mod
+
+        topo_mod.reset_topology()
+        torch.manual_seed(0)
+        hf = MptForCausalLM(MptConfig(
+            vocab_size=100, d_model=64, n_layers=2, n_heads=4,
+            expansion_ratio=4, max_seq_len=64)).eval()
+        m = _parity(hf, 100)
+        assert m.config.pos_embedding == "alibi" and not m.config.qkv_bias
+
+    def test_mpt_npow2_heads_rejected(self):
+        from transformers import MptConfig, MptForCausalLM
+
+        hf = MptForCausalLM(MptConfig(vocab_size=100, d_model=60, n_layers=1,
+                                      n_heads=6, max_seq_len=32))
+        with pytest.raises(ValueError, match="non-power-of-two"):
+            from_hf(hf)
