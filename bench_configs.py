@@ -1,0 +1,318 @@
+"""Tracked-config benchmarks (BASELINE.json ``configs``) beyond the headline.
+
+``python bench.py`` prints ONE JSON line (the headline GPT-2-350M number — the
+driver contract). ``python bench.py --all`` additionally runs the other four
+tracked configs as scaled stand-ins sized for the available hardware (one real
+chip + the host), emitting one JSON line each and writing ``BENCH_ALL.json``.
+
+Stand-in honesty: every line's ``detail.standin`` says exactly how the config
+was scaled; ``vs_baseline`` is null where no comparable reference claim exists.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _train_throughput(model_cfg, ds_config, *, seq, micro_bs, steps=10,
+                      warmup=3, labels=False):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import topology as topo_mod
+    from deepspeed_tpu.models import TransformerLM
+
+    topo_mod.reset_topology()
+    model = TransformerLM(model_cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    dp = 1
+    topo = topo_mod.get_topology(required=False)
+    if topo is not None:
+        dp = topo.get_dim("data") * topo.get_dim("hpz")
+    B = micro_bs * dp
+    rng = np.random.default_rng(0)
+
+    def mk():
+        b = {"input_ids": jnp.asarray(
+            rng.integers(0, model_cfg.vocab_size, (B, seq), dtype=np.int32))}
+        if labels:
+            b["labels"] = jnp.asarray(
+                rng.integers(0, model_cfg.vocab_size, (B, seq), dtype=np.int32))
+        return b
+
+    # one distinct batch per step: repeated batches get one-shot-memorized by
+    # large models under AdamW (verified: loss 0.05 on a revisited batch,
+    # 11.2 on fresh data), which makes final_loss misleading
+    batches = [mk() for _ in range(steps + warmup)]
+
+    def it():
+        i = 0
+        while True:
+            yield batches[i % len(batches)]
+            i += 1
+
+    g = it()
+    gas = ds_config.get("gradient_accumulation_steps", 1)
+    for _ in range(warmup):
+        float(engine.train_batch(g))
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = engine.train_batch(g)
+    loss = float(loss)
+    jax.block_until_ready(engine.params)
+    dt = time.perf_counter() - t0
+    tokens = B * seq * gas * steps
+    return tokens / dt, loss, dt / steps
+
+
+def bench_cpu_zero1_125m():
+    """Config 1: GPT-2 125M ZeRO-1 fp32, single process, C++ CPUAdam (host)."""
+    from deepspeed_tpu.models import gpt2_config
+
+    seq, mb = 128, 1
+    cfg = gpt2_config("125m", max_seq_len=seq)
+    tok_s, loss, step_s = _train_throughput(cfg, {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1,
+                              "offload_optimizer": {"device": "cpu"}},
+        "gradient_clipping": 0.0,
+        "steps_per_print": 0,
+    }, seq=seq, micro_bs=mb, steps=2, warmup=1)
+    return {
+        "metric": "gpt2_125m_zero1_fp32_cpu_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": None,
+        "detail": {"standin": "full 125M dims; seq 128, mb 1, 2 steps, CPU "
+                              "backend; bitwise parity vs plain CPUAdam loop "
+                              "is asserted in tests/unit/test_bitwise_cpu_zero1.py",
+                   "final_loss": loss, "step_s": round(step_s, 2)},
+    }
+
+
+def bench_zero2_350m():
+    """Config 2: GPT-2 350M ZeRO-2 bf16 + FusedAdam (dp over available chips)."""
+    import jax
+
+    from deepspeed_tpu.models import gpt2_config
+
+    seq, mb = 1024, 8
+    n = len(jax.devices())
+    cfg = gpt2_config("350m", max_seq_len=seq, remat=True, remat_policy="dots",
+                      scan_layers=False)
+    tok_s, loss, step_s = _train_throughput(cfg, {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }, seq=seq, micro_bs=mb, steps=20, warmup=4)
+    return {
+        "metric": "gpt2_350m_zero2_bf16_tokens_per_sec_per_chip",
+        "value": round(tok_s / n, 1), "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "detail": {"standin": f"dp={n} (8-chip config run on available chips)",
+                   "final_loss": loss, "step_ms": round(step_s * 1000, 1)},
+    }
+
+
+def bench_llama7b_zero3():
+    """Config 3: LLaMA-2 7B ZeRO-3 + gradient checkpointing (depth-scaled)."""
+    import jax
+
+    from deepspeed_tpu.models import llama_config
+
+    # full 7B hidden/FFN/head geometry, 2 of 32 layers: the per-layer compute
+    # and memory behavior (the thing the config tracks) is preserved; depth is
+    # cut so master+moments fit one 16 GB chip
+    L = 2
+    seq, mb = 2048, 1
+    cfg = llama_config("7b", num_layers=L, max_seq_len=seq, remat=True,
+                       remat_policy="dots")
+    tok_s, loss, step_s = _train_throughput(cfg, {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }, seq=seq, micro_bs=mb, steps=8, warmup=3)
+    import jax as _jax
+
+    peak = 197e12
+    n = len(_jax.devices())
+    mfu = tok_s / n * cfg.flops_per_token(seq) / peak
+    return {
+        "metric": "llama7b_zero3_remat_tokens_per_sec_per_chip",
+        "value": round(tok_s / n, 1), "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "detail": {"standin": f"full 7B layer geometry, {L}/32 layers, seq "
+                              f"{seq}, mb {mb}", "mfu": round(mfu, 4),
+                   "final_loss": loss, "step_ms": round(step_s * 1000, 1)},
+    }
+
+
+def bench_bert_offloadpp():
+    """Config 4: BERT-large ZeRO + Offload++ twin-flow (ratio split host/device)."""
+    from deepspeed_tpu.models.transformer import TransformerConfig
+
+    seq, mb = 256, 2
+    cfg = TransformerConfig(
+        vocab_size=30592, hidden_size=1024, num_layers=24, num_heads=16,
+        max_seq_len=seq, causal=False, norm_position="post",
+        activation="gelu", name="bert-large",
+    )
+    tok_s, loss, step_s = _train_throughput(cfg, {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 2, "offload_optimizer": {
+            "device": "cpu", "ratio": 0.4}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }, seq=seq, micro_bs=mb, steps=2, warmup=1, labels=True)
+    return {
+        "metric": "bert_large_offloadpp_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tokens/s", "vs_baseline": None,
+        "detail": {"standin": "BERT-large dims, MLM-style random labels, seq "
+                              "256 mb 2, 2 steps; twin-flow ratio 0.4 "
+                              "(largest leaves host, rest device); every step "
+                              "round-trips the offloaded states through the "
+                              "dev-env tunnel, so the absolute number is "
+                              "tunnel-latency-bound",
+                   "final_loss": loss, "step_ms": round(step_s * 1000, 1)},
+    }
+
+
+def bench_pipe_zero1():
+    """Config 5: GPT-2 1.3B PipelineEngine x ZeRO-1 hybrid — pp4 x dp2 on the
+    8-device virtual CPU mesh (functional stand-in; no multi-chip hardware)."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import topology as topo_mod
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.runtime.pipe import PipelinedLM
+
+    topo_mod.reset_topology()
+    topo = topo_mod.initialize_topology(data=2, model=1, seq=1, pipe=4,
+                                        expert=1)
+    seq, mb, gas = 256, 2, 4
+    cfg = gpt2_config("1.3b", hidden_size=512, num_layers=8, num_heads=8,
+                      vocab_size=8192, max_seq_len=seq)
+    model = PipelinedLM(TransformerLM(cfg), topology=topo)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "steps_per_print": 0,
+        "mesh": {"data": 2, "model": 1, "seq": 1, "pipe": 4, "expert": 1},
+    })
+    rng = np.random.default_rng(0)
+
+    def it():
+        while True:
+            yield {"input_ids": rng.integers(0, cfg.vocab_size, (mb * 2, seq),
+                                             dtype=np.int32)}
+
+    g = it()
+    float(engine.train_batch(g))
+    t0 = time.perf_counter()
+    steps = 3
+    loss = None
+    for _ in range(steps):
+        loss = engine.train_batch(g)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    tokens = mb * 2 * seq * gas * steps
+    return {
+        "metric": "gpt2_1.3b_pipe_zero1_tokens_per_sec",
+        "value": round(tokens / dt, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": {"standin": "FUNCTIONAL-ONLY: scaled dims (h512 L8 v8k) on "
+                              "the 8-device virtual CPU mesh, pp4 x dp2, "
+                              "GAS 4 — records that the hybrid runs end-to-"
+                              "end; not a hardware throughput number",
+                   "final_loss": loss},
+    }
+
+
+CPU_CONFIGS = {"cpu_zero1_125m": bench_cpu_zero1_125m,
+               "pipe_zero1": bench_pipe_zero1}
+TPU_CONFIGS = {"zero2_350m": bench_zero2_350m,
+               "llama7b_zero3": bench_llama7b_zero3,
+               "bert_offloadpp": bench_bert_offloadpp}
+
+
+def run_one(name):
+    """Entry for the CPU-backend subprocess (see run_all)."""
+    fn = {**CPU_CONFIGS, **TPU_CONFIGS}[name]
+    print(json.dumps(fn()))
+
+
+def run_all():
+    results = []
+    # CPU-backend configs run in subprocesses so the forced platform and the
+    # virtual device mesh exist before JAX initializes
+    from deepspeed_tpu.utils.xla_env import force_device_count_flags
+
+    for name in CPU_CONFIGS:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = force_device_count_flags(env.get("XLA_FLAGS", ""), 8)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), name],
+            env=env, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            results.append(json.loads(line))
+        except json.JSONDecodeError:
+            results.append({"metric": name, "error": proc.stderr[-400:]})
+    for name, fn in TPU_CONFIGS.items():
+        try:
+            results.append(fn())
+        except Exception as e:  # record the failure, keep benching
+            results.append({"metric": name,
+                            "error": f"{type(e).__name__}: {e}"[:400]})
+    for r in results:
+        print(json.dumps(r))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_ALL.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return results
+
+
+if __name__ == "__main__":
+    import logging
+
+    logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
+    if len(sys.argv) > 1:
+        name = sys.argv[1]
+        if name in CPU_CONFIGS:
+            # the environment force-loads a hardware platform plugin via
+            # sitecustomize; env vars alone cannot override it — the platform
+            # must be pinned in-Python before the first backend use
+            from deepspeed_tpu.utils.xla_env import force_device_count_flags
+
+            os.environ["XLA_FLAGS"] = force_device_count_flags(
+                os.environ.get("XLA_FLAGS", ""), 8)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        run_one(name)
+    else:
+        run_all()

@@ -93,8 +93,21 @@ class TransformerConfig:
     random_ltd: bool = False
     random_ltd_skip_ends: int = 1
     # training knobs
+    scan_layers: bool = True  # False: unroll the layer loop (no stacked
+    # residual buffers / dynamic-update-slice traffic; longer compile)
     remat: bool = False  # per-block activation rematerialisation
-    remat_policy: str = "full"  # "full" (min memory) | "dots" (save matmul outputs, faster)
+    # "full"       min memory, recompute everything
+    # "dots"       save weight-side matmul outputs AND the flash-attention
+    #              out/lse residuals (no matmul or attention-kernel recompute;
+    #              +one B*S*H per layer vs the pre-round-2 "dots" — use
+    #              "dots_plain" for the old, smaller behavior)
+    # "dots_plain" save weight-side matmul outputs only (attention fwd reruns
+    #              in the backward)
+    # "dots_batch" save every matmul output incl. batch dims
+    # "dots_elem"  "dots" plus LN/MLP-activation outputs (no recompute at all)
+    # "dots_lean"  "dots" minus MLP up/gate outputs (recompute one matmul,
+    #              biggest activation-memory saver)
+    remat_policy: str = "full"
     param_dtype: Any = jnp.float32
     # fraction of attention logits softcapped (gemma-style); 0 = off
     logit_softcap: float = 0.0
@@ -482,10 +495,12 @@ class TransformerLM:
 
         # post-LN (BERT family): attention reads the raw residual stream and
         # ln1/ln2 normalize AFTER each residual add
+        from jax.ad_checkpoint import checkpoint_name
+
         post_ln = cfg.norm_position == "post"
-        h = x if post_ln else _norm(
+        h = x if post_ln else checkpoint_name(_norm(
             x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps,
-            cfg.norm_weight_offset)
+            cfg.norm_weight_offset), "ln_out")
         q = h @ blk["wq"].astype(h.dtype)
         kk = h @ blk["wk"].astype(h.dtype)
         v = h @ blk["wv"].astype(h.dtype)
@@ -599,15 +614,16 @@ class TransformerLM:
                 cfg.norm_weight_offset)
         else:
             x = x + attn_out
-            h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm, cfg.norm_eps,
-                       cfg.norm_weight_offset)
+            h2 = checkpoint_name(
+                _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm,
+                      cfg.norm_eps, cfg.norm_weight_offset), "ln_out")
         aux = jnp.zeros((), jnp.float32)
         if cfg.num_experts > 0:
             mlp_out, aux = self._moe_ffn(h2, blk, train)
         else:
             if cfg.activation in ("swiglu", "geglu"):
-                g = h2 @ blk["w_gate"].astype(h.dtype)
-                u = h2 @ blk["w_up"].astype(h.dtype)
+                g = checkpoint_name(h2 @ blk["w_gate"].astype(h.dtype), "mlp_up")
+                u = checkpoint_name(h2 @ blk["w_up"].astype(h.dtype), "mlp_up")
                 act = jax.nn.silu if cfg.activation == "swiglu" else \
                     partial(jax.nn.gelu, approximate=True)
                 inter = act(g) * u
@@ -615,10 +631,12 @@ class TransformerLM:
                 up = h2 @ blk["w_up"].astype(h.dtype)
                 if "mlp_up_bias" in blk:
                     up = up + blk["mlp_up_bias"].astype(h.dtype)
+                up = checkpoint_name(up, "mlp_up")
                 if cfg.activation == "relu":
                     inter = jax.nn.relu(up)
                 else:
                     inter = jax.nn.gelu(up, approximate=cfg.activation != "gelu_exact")
+            inter = checkpoint_name(inter, "mlp_act")
             mlp_out = inter @ blk["w_down"].astype(h.dtype)
         if "mlp_bias" in blk:
             mlp_out = mlp_out + blk["mlp_bias"].astype(h.dtype)
@@ -668,11 +686,59 @@ class TransformerLM:
                       cfg.norm, cfg.norm_eps, cfg.norm_weight_offset)
         return x
 
+    def _lean_policy(self):
+        """Save no-batch-dim dot outputs EXCEPT tensors wider than 2×hidden
+        (the MLP up/gate projections — the bulk of activation memory, one
+        cheap matmul to recompute), plus the flash-attention residuals."""
+        from jax._src.ad_checkpoint import name_p
+        from jax._src.lax import lax as lax_internal
+
+        H = self.config.hidden_size
+
+        def policy(prim, *args, **params):
+            if prim is name_p:
+                return params["name"] in ("attn_out", "attn_lse")
+            if prim is lax_internal.dot_general_p:
+                (_, _), (lhs_b, rhs_b) = params["dimension_numbers"]
+                if lhs_b or rhs_b:
+                    return False
+                rhs = args[1] if len(args) > 1 else None
+                if rhs is not None and rhs.shape and rhs.shape[-1] >= 2 * H:
+                    return False
+                return True
+            return False
+
+        return policy
+
     def _ckpt(self, fn):
-        if self.config.remat_policy == "dots":
-            return jax.checkpoint(
-                fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
+        policies = jax.checkpoint_policies
+        # "dots" saves weight-side matmul outputs AND the flash-attention
+        # kernel's named residuals (out/lse) — the backward pass then only
+        # recomputes cheap elementwise/norm ops, never a matmul or the
+        # attention forward kernel
+        policy = {
+            "dots": policies.save_from_both_policies(
+                policies.dots_with_no_batch_dims_saveable,
+                policies.save_only_these_names("attn_out", "attn_lse"),
+            ),
+            # additionally keep LN and MLP-activation outputs: the backward
+            # pass then recomputes nothing at all (more HBM, fewer VPU passes)
+            "dots_elem": policies.save_from_both_policies(
+                policies.dots_with_no_batch_dims_saveable,
+                policies.save_only_these_names(
+                    "attn_out", "attn_lse", "ln_out", "mlp_act"),
+            ),
+            "dots_plain": policies.dots_with_no_batch_dims_saveable,
+            "dots_batch": policies.dots_saveable,
+            "dots_lean": self._lean_policy(),
+            "full": None,
+        }
+        name = self.config.remat_policy
+        if name not in policy:
+            raise ValueError(
+                f"unknown remat_policy {name!r} (known: {sorted(policy)})")
+        if policy[name] is not None:
+            return jax.checkpoint(fn, policy=policy[name])
         return jax.checkpoint(fn)
 
     def _trunk(self, params, x, positions, rng, train, pld_theta=None,
@@ -703,6 +769,13 @@ class TransformerLM:
                 return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
+            if not cfg.scan_layers:
+                aux_sum = jnp.zeros((), jnp.float32)
+                for i in range(L):
+                    blk = jax.tree.map(lambda a: a[i], params["blocks"])
+                    x, aux = block_fn(x, (blk, rngs[i], jnp.asarray(i)))
+                    aux_sum = aux_sum + aux
+                return x, aux_sum
             x, auxes = jax.lax.scan(
                 block_fn, x, (params["blocks"], rngs, jnp.arange(L)))
         else:
@@ -713,6 +786,13 @@ class TransformerLM:
                 return y, aux
 
             block_fn = self._ckpt(body) if cfg.remat else body
+            if not cfg.scan_layers:
+                aux_sum = jnp.zeros((), jnp.float32)
+                for i in range(L):
+                    blk = jax.tree.map(lambda a: a[i], params["blocks"])
+                    x, aux = block_fn(x, blk)
+                    aux_sum = aux_sum + aux
+                return x, aux_sum
             x, auxes = jax.lax.scan(block_fn, x, params["blocks"])
         return x, jnp.sum(auxes)
 

@@ -40,7 +40,10 @@ def _interpret() -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # (BQ, hd)
+    # keep matmul inputs in their storage dtype (bf16): the MXU multiplies
+    # bf16 at full rate with fp32 accumulation; casting to fp32 first would
+    # run the MXU at a fraction of peak
+    q = q_ref[0, 0, :, :]  # (BQ, hd)
     skv = k_ref.shape[2]
     hd = q.shape[-1]
 
@@ -58,11 +61,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
+        ) * scale  # (BQ, BK) fp32
         if causal:
             qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -72,7 +75,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -118,8 +122,8 @@ def _fwd(q, k, v, *, causal, num_kv_groups, scale, block_q, block_k):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, block_q, block_k, causal, scale):
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    q = q_ref[0, 0, :, :]  # bf16: MXU inputs stay in storage dtype
+    do = do_ref[0, 0, :, :]
     lse = lse_ref[0, 0, :, 0]
     delta = delta_ref[0, 0, :, 0]
     skv = k_ref.shape[2]
@@ -133,18 +137,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         num_kv = skv // block_k
 
     def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
+        p = jnp.exp(s - lse[:, None])  # (BQ, BK) fp32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -155,8 +159,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, block_q, block_k, causal, scale):
     ki = pl.program_id(2)
-    k = k_ref[0, 0, :, :].astype(jnp.float32)  # (BK, hd)
-    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    k = k_ref[0, 0, :, :]  # (BK, hd) bf16: MXU inputs stay in storage dtype
+    v = v_ref[0, 0, :, :]
     sq = q_ref.shape[2]
     hd = k.shape[-1]
     k_start = ki * block_k
@@ -167,23 +171,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (BQ, BK)
+                                preferred_element_type=jnp.float32) * scale  # (BQ, BK)
         if causal:
             qpos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv_new = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        # q is pre-scaled, so ds·q already carries the one factor of scale dk needs
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
         return dk_new, dv_new
@@ -263,8 +266,15 @@ def _flash(q, k, v, causal, num_kv_groups, scale, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, num_kv_groups, scale, block_q, block_k):
+    from jax.ad_checkpoint import checkpoint_name
+
     out, lse = _fwd(q, k, v, causal=causal, num_kv_groups=num_kv_groups,
                     scale=scale, block_q=block_q, block_k=block_k)
+    # name the residuals so a remat policy can elect to SAVE them — under
+    # ``save_only_these_names("attn_out", "attn_lse")`` the backward pass reads
+    # the stored out/lse instead of re-running the forward kernel
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -1,0 +1,12 @@
+"""Host-platform / XLA environment helpers shared by the driver entry points,
+benches, and tests (everything that self-provisions a virtual CPU device mesh).
+"""
+
+
+def force_device_count_flags(flags: str, n: int) -> str:
+    """Return ``flags`` with any existing host-platform device-count flag
+    replaced by ``--xla_force_host_platform_device_count=n``."""
+    kept = " ".join(
+        f for f in flags.split() if "xla_force_host_platform_device_count" not in f
+    )
+    return (kept + f" --xla_force_host_platform_device_count={n}").strip()
