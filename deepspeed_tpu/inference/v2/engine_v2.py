@@ -49,7 +49,7 @@ class InferenceEngineV2:
     def __init__(self, model, params=None, *, max_seqs: int = 8,
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None, token_budget: int = 0):
         self.model = model
         self.cfg = model.config
         self.max_seqs = max_seqs
@@ -57,6 +57,10 @@ class InferenceEngineV2:
         self.prefill_chunk = prefill_chunk
         self.dtype = dtype
         self.paged = paged
+        # paged mode: every engine step is ONE compiled ragged forward over
+        # exactly token_budget token-rows (prefill chunks and decodes mixed —
+        # reference engine_v2.py:107 put); the budget is the latency knob
+        self.token_budget = token_budget or max(max_seqs, min(prefill_chunk, 64))
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
 
@@ -88,7 +92,8 @@ class InferenceEngineV2:
             self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
             log_dist(
                 f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
-                f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk}",
+                f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk} "
+                f"token_budget={self.token_budget}",
                 ranks=[0],
             )
         else:
@@ -158,31 +163,93 @@ class InferenceEngineV2:
         self._decode_fn = jax.jit(decode, donate_argnums=(1,))
         return self._decode_fn
 
-    def _get_prefill_paged(self):
-        # one compiled wrapper; jit retraces per (n_seq, S) shape on its own
-        if "paged" in self._prefill_fns:
-            return self._prefill_fns["paged"]
+    def _get_ragged(self):
+        """THE paged-mode program: one fixed-shape ragged forward.
+
+        Each of the ``token_budget`` rows is one token of some sequence —
+        prefill-chunk tokens and decode tokens mixed freely (the reference's
+        ragged batch, ``engine_v2.py:107 put`` + ``ragged/ragged_wrapper.py``).
+        A row carries its sequence's block table and its own position; padding
+        rows carry the all-zero table (trash block 0) and are ignored. One
+        shape → one compile, ever.
+        """
+        if "ragged" in self._prefill_fns:
+            return self._prefill_fns["ragged"]
         model = self.model
 
-        def prefill(params, pool, ids, tables, starts, n_valid):
-            return model.forward_paged(params, ids, pool, tables, starts, n_valid)
+        def ragged(params, pool, ids, tables, starts, logit_rows):
+            # ids (T, 1): every row is its own length-1 "sequence" against the
+            # shared pool; only the (max_seqs,) logit_rows are projected
+            # through the vocab head (reference ragged_ops/logits_gather)
+            return model.forward_paged(params, ids, pool, tables, starts,
+                                       logit_rows=logit_rows)
 
-        fn = jax.jit(prefill, donate_argnums=(1,))
-        self._prefill_fns["paged"] = fn
+        fn = jax.jit(ragged, donate_argnums=(1,))
+        self._prefill_fns["ragged"] = fn
         return fn
 
-    def _get_decode_paged(self):
-        if self._decode_fn is not None:
-            return self._decode_fn
-        model = self.model
+    @property
+    def ragged_cache_size(self) -> int:
+        """Number of compiled traces of the ragged-step program (tests assert
+        this stays 1 — the whole point of the fixed-shape design)."""
+        fn = self._prefill_fns.get("ragged")
+        return 0 if fn is None else fn._cache_size()
 
-        def decode(params, pool, toks, tables, poss):
-            # inactive rows carry an all-zero table (trash block 0) + pos 0:
-            # their writes land in the trash block, their logits are ignored
-            return model.forward_paged(params, toks[:, None], pool, tables, poss)
+    def _put_paged(self, out: Dict[int, np.ndarray]) -> None:
+        """Drain all pending tokens through fixed-budget ragged steps.
 
-        self._decode_fn = jax.jit(decode, donate_argnums=(1,))
-        return self._decode_fn
+        Scheduling policy (the token-budget scheduler the reference hides
+        behind ``query``/``can_schedule``): sequences with the fewest pending
+        tokens go first — live decodes (1 token) always beat prefill chunks,
+        bounding decode latency under heavy prefill (split-fuse)."""
+        T = self.token_budget
+        while True:
+            work = [d for d in self.state.seqs.values() if d.in_flight > 0]
+            if not work:
+                return
+            work.sort(key=lambda d: (d.in_flight, d.slot))
+            plan: List[Tuple] = []
+            used = 0
+            for d in work:
+                if used >= T:
+                    break
+                take = min(d.in_flight, self.prefill_chunk, T - used)
+                if d.seen_tokens + take > self.max_seq_len:
+                    raise RuntimeError(
+                        f"uid {d.uid}: prompt exceeds context "
+                        f"({d.seen_tokens}+{take} > {self.max_seq_len})")
+                plan.append((d, take))
+                used += take
+            # allocate blocks for the WHOLE step before mutating any sequence
+            # state — an exhaustion raise must leave every descriptor intact
+            for d, take in plan:
+                self.block_mgr.ensure(d, d.seen_tokens + take)
+            ids = np.zeros((T, 1), np.int32)
+            tables = np.zeros((T, self.block_mgr.max_blocks_per_seq), np.int32)
+            starts = np.zeros((T,), np.int32)
+            logit_rows = np.zeros((self.max_seqs,), np.int32)
+            finals = []
+            r = 0
+            for d, take in plan:
+                completes = take == d.in_flight
+                row = self.block_mgr.table_row(d)
+                for j in range(take):
+                    ids[r, 0] = d.pending[j]
+                    tables[r] = row
+                    starts[r] = d.seen_tokens + j
+                    r += 1
+                if completes:
+                    logit_rows[len(finals)] = r - 1
+                    finals.append(d)
+                del d.pending[:take]
+                d.seen_tokens += take
+            fn = self._get_ragged()
+            lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
+                             jnp.asarray(tables), jnp.asarray(starts),
+                             jnp.asarray(logit_rows))
+            lg = np.asarray(lg)
+            for i, d in enumerate(finals):
+                out[d.uid] = lg[i]
 
     # ------------------------------------------------------------------
     # reference surface
@@ -205,10 +272,14 @@ class InferenceEngineV2:
                 desc.pending.extend(int(t) for t in toks)
 
         out: Dict[int, np.ndarray] = {}
-        # 2. chunked prefill for pending prompt tokens (split-fuse: bounded
-        # chunks, grouped by padded segment length). A sequence near the end of
-        # its slot gets an exact-fit segment (dynamic_update_slice clamps
-        # out-of-range starts, which would silently corrupt the cache).
+        if self.paged:
+            # single compiled ragged program over a fixed token budget
+            self._put_paged(out)
+            return out
+        # 2. slot mode: chunked prefill for pending prompt tokens (split-fuse:
+        # bounded chunks, grouped by padded segment length). A sequence near
+        # the end of its slot gets an exact-fit segment (dynamic_update_slice
+        # clamps out-of-range starts, which would silently corrupt the cache).
         while True:
             work = [d for d in self.state.seqs.values() if d.in_flight > 0]
             if not work:
@@ -229,16 +300,6 @@ class InferenceEngineV2:
                 starts = np.zeros((len(grp),), np.int32)
                 slots = np.zeros((len(grp),), np.int32)
                 nval = np.zeros((len(grp),), np.int32)
-                tables = None
-                if self.paged:
-                    # allocate blocks for the WHOLE group before mutating any
-                    # sequence state — an exhaustion raise must leave every
-                    # descriptor exactly as it was (padded tail positions also
-                    # land in allocated blocks)
-                    tables = np.zeros(
-                        (len(grp), self.block_mgr.max_blocks_per_seq), np.int32)
-                    for d in grp:
-                        self.block_mgr.ensure(d, d.seen_tokens + S)
                 for i, d in enumerate(grp):
                     take = min(S, d.in_flight, self.prefill_chunk)
                     ids[i, :take] = d.pending[:take]
@@ -246,19 +307,11 @@ class InferenceEngineV2:
                     starts[i] = d.seen_tokens
                     slots[i] = d.slot
                     nval[i] = take
-                    if self.paged:
-                        tables[i] = self.block_mgr.table_row(d)
                     d.seen_tokens += take
-                if self.paged:
-                    fn = self._get_prefill_paged()
-                    lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
-                                     jnp.asarray(tables), jnp.asarray(starts),
-                                     jnp.asarray(nval))
-                else:
-                    fn = self._get_prefill(S)
-                    lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
-                                     jnp.asarray(slots), jnp.asarray(starts),
-                                     jnp.asarray(nval))
+                fn = self._get_prefill(S)
+                lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
+                                 jnp.asarray(slots), jnp.asarray(starts),
+                                 jnp.asarray(nval))
                 lg = np.asarray(lg)
                 for i, d in enumerate(grp):
                     if d.in_flight == 0:  # prompt fully consumed → logits are live
@@ -268,16 +321,27 @@ class InferenceEngineV2:
     def decode_step(self, tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
         """One continuous-batching decode step: feed each live uid its sampled
         token, get next-token logits for all of them."""
+        if self.paged:
+            # all-or-nothing validation BEFORE any state is touched (matches
+            # slot mode): unknown uids KeyError rather than silently becoming
+            # new sequences; context-full raises with nothing enqueued
+            for uid in tokens:
+                d = self.state.seqs[uid]
+                if d.seen_tokens + d.in_flight >= self.max_seq_len:
+                    raise RuntimeError(
+                        f"uid {uid}: context full ({d.seen_tokens} >= "
+                        f"{self.max_seq_len}); flush the sequence or raise "
+                        "max_seq_len")
+            # decode tokens ride the same compiled ragged program as prefill —
+            # mixed arrivals and decodes in one step is the normal case
+            uids = list(tokens)
+            return self.put(uids, [[tokens[u]] for u in uids])
         toks = np.zeros((self.max_seqs,), np.int32)
         poss = np.zeros((self.max_seqs,), np.int32)
         active = np.zeros((self.max_seqs,), bool)
-        tables = None
-        if self.paged:
-            tables = np.zeros((self.max_seqs, self.block_mgr.max_blocks_per_seq),
-                              np.int32)
         by_slot: Dict[int, int] = {}
-        # validation + block allocation for EVERY uid first: a raise here must
-        # leave all sequence state untouched (no half-advanced positions)
+        # validation for EVERY uid first: a raise here must leave all
+        # sequence state untouched (no half-advanced positions)
         for uid in tokens:
             d = self.state.seqs[uid]
             if d.seen_tokens >= self.max_seq_len:
@@ -285,27 +349,17 @@ class InferenceEngineV2:
                     f"uid {uid}: context full ({d.seen_tokens} >= {self.max_seq_len}); "
                     "flush the sequence or raise max_seq_len"
                 )
-            if self.paged:
-                self.block_mgr.ensure(d, d.seen_tokens + 1)
         for uid, tok in tokens.items():
             d = self.state.seqs[uid]
             toks[d.slot] = tok
             poss[d.slot] = d.seen_tokens
             active[d.slot] = True
             by_slot[d.slot] = uid
-            if self.paged:
-                tables[d.slot] = self.block_mgr.table_row(d)
             d.seen_tokens += 1
-        if self.paged:
-            lg, self.kv = self._get_decode_paged()(
-                self.params, self.kv, jnp.asarray(toks), jnp.asarray(tables),
-                jnp.asarray(poss),
-            )
-        else:
-            lg, self.kv = self._get_decode()(
-                self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
-                jnp.asarray(active),
-            )
+        lg, self.kv = self._get_decode()(
+            self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
+            jnp.asarray(active),
+        )
         lg = np.asarray(lg)
         return {uid: lg[slot] for slot, uid in by_slot.items()}
 

@@ -1017,12 +1017,15 @@ class TransformerLM:
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     def forward_paged(self, params, input_ids, kv_pool, tables, starts,
-                      n_valid=None):
+                      n_valid=None, logit_rows=None):
         """Run a (B, S) segment against the blocked pool.
 
         tables: (B, MAXB) pool block ids per sequence (0-padded); starts: (B,)
         first logical position of the segment. Returns ((B, V) logits at each
-        sequence's LAST VALID position, new pool).
+        sequence's LAST VALID position, new pool). With ``logit_rows`` ((R,)
+        int32), only those rows are projected through the vocab head —
+        returns ((R, V), new pool) — so a ragged batch pays for R logits, not
+        B (reference ``ragged_ops/logits_gather``).
         """
         B, S = input_ids.shape
         positions = starts[:, None] + jnp.broadcast_to(
@@ -1046,8 +1049,10 @@ class TransformerLM:
             last = jnp.full((B,), S - 1, jnp.int32)
         else:
             last = jnp.clip(n_valid - 1, 0, S - 1)
-        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # (B,1,H)
-        lg = self._head(params, x_last)[:, 0]
+        x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # (B,H)
+        if logit_rows is not None:
+            x_last = x_last[logit_rows]  # (R,H)
+        lg = self._head(params, x_last[:, None])[:, 0]
         return lg, (nkp, nvp)
 
     def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
