@@ -92,9 +92,31 @@ def initialize(
     comm.configure(config=ds_config)
 
     # engine dispatch (reference __init__.py:166-206: pipeline models get the
-    # PipelineEngine)
+    # PipelineEngine; stage-3 offload_param gets the layer-streamed
+    # ZeRO-Infinity engine)
     from .runtime.pipe.engine import PipelineEngine
     from .runtime.pipe.module import PipelinedLM, PipelineModule
+
+    off_p = zc.offload_param
+    if off_p is not None and off_p.device in ("cpu", "nvme"):
+        if zc.stage < 3:
+            raise ValueError(
+                "zero_optimization.offload_param requires stage 3 "
+                "(parity with reference offload_param)")
+        unsupported = {"optimizer": optimizer, "training_data": training_data,
+                       "collate_fn": collate_fn,
+                       "model_parameters": model_parameters}
+        given = [k for k, v in unsupported.items() if v is not None]
+        if given:
+            raise ValueError(
+                f"offload_param (layer-streamed) engine does not support the "
+                f"{given} argument(s): the optimizer is the host CPUAdam from "
+                "the config's optimizer block, and data is passed to "
+                "train_batch(data_iter) directly")
+        from .runtime.swap_tensor import StreamedZeroEngine
+
+        engine = StreamedZeroEngine(model, ds_config, lr_scheduler=lr_scheduler)
+        return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
     engine_cls = (
         PipelineEngine if isinstance(model, (PipelinedLM, PipelineModule)) else DeepSpeedEngine

@@ -324,7 +324,9 @@ class InferenceEngineV2:
         if self.paged:
             # all-or-nothing validation BEFORE any state is touched (matches
             # slot mode): unknown uids KeyError rather than silently becoming
-            # new sequences; context-full raises with nothing enqueued
+            # new sequences; context-full or block-pool-exhausted raises with
+            # nothing enqueued, so the step can be retried verbatim after
+            # freeing capacity (blocks allocated here are used by the step)
             for uid in tokens:
                 d = self.state.seqs[uid]
                 if d.seen_tokens + d.in_flight >= self.max_seq_len:
@@ -332,6 +334,9 @@ class InferenceEngineV2:
                         f"uid {uid}: context full ({d.seen_tokens} >= "
                         f"{self.max_seq_len}); flush the sequence or raise "
                         "max_seq_len")
+            for uid in tokens:
+                d = self.state.seqs[uid]
+                self.block_mgr.ensure(d, d.seen_tokens + d.in_flight + 1)
             # decode tokens ride the same compiled ragged program as prefill —
             # mixed arrivals and decodes in one step is the normal case
             uids = list(tokens)

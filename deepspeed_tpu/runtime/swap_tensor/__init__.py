@@ -1,0 +1,13 @@
+"""ZeRO-Infinity tensor-swapping tier (reference ``runtime/swap_tensor/``).
+
+``StreamedParamStore`` — host/NVMe parameter store with read-ahead
+(reference ``partitioned_param_swapper.py:36``).
+``StreamedZeroEngine`` — layer-streamed training engine whose parameters
+never fully reside in HBM.
+
+The optimizer-state swap tier lives in ``runtime/zero/offload.py``
+(``OffloadedAdamState``, reference ``partitioned_optimizer_swapper.py``).
+"""
+
+from .param_swapper import StreamedParamStore  # noqa: F401
+from .streamed import StreamedZeroEngine  # noqa: F401

@@ -97,15 +97,20 @@ class OffloadedAdamState:
         return self.master
 
     def state_dict(self) -> Dict:
+        # copies, not references: the live buffers keep mutating in place as
+        # training continues — a checkpoint must be a snapshot
+        master = [np.array(m, copy=True) for m in self.master]
         if self._aio is None:
-            return {"master": self.master, "m": self.m, "v": self.v,
+            return {"master": master,
+                    "m": [np.array(x, copy=True) for x in self.m],
+                    "v": [np.array(x, copy=True) for x in self.v],
                     "step": self.step_count}
         mv = []
         for i in range(len(self.master)):
             buf, rid = self._fetch_mv(i)
             self._aio.wait(rid)
             mv.append(buf)
-        return {"master": self.master, "mv": mv, "step": self.step_count}
+        return {"master": master, "mv": mv, "step": self.step_count}
 
     def load_state_dict(self, sd: Dict):
         self.step_count = int(sd["step"])
