@@ -322,15 +322,17 @@ class DeepSpeedEngine:
         # ---- ZeRO++ qgZ validation (zero/zeropp.py) ----
         self._qgz_enabled = bool(config.zero_config.zero_quantized_gradients)
         if self._qgz_enabled:
-            tp_like = [a for a in ("model", "seq", "pipe", "expert")
-                       if topo.get_dim(a) > 1]
-            if stage >= 3 or tp_like:
+            if topo.get_dim("pipe") > 1:
                 raise ValueError(
-                    "zero_quantized_gradients rides the explicit-collective "
-                    "shard_map path (replicated lp params over pure DP axes): "
-                    "requires stage<=2 and no model/seq/pipe/expert axes "
-                    f"(got stage={stage}, axes={tp_like}). For stage-3 gather "
-                    "compression use zero_quantized_weights (qwZ)."
+                    "zero_quantized_gradients is not supported with pipeline "
+                    "parallelism (the pipeline engine owns its own gradient "
+                    "reduction schedule)"
+                )
+            if topo.get_dim("expert") > 1:
+                raise ValueError(
+                    "zero_quantized_gradients is not supported with expert "
+                    "parallelism: expert-sharded weights are never gathered "
+                    "and expert grads reduce in their own groups"
                 )
             if config.optimizer_name in ("onebitadam", "zerooneadam", "onebitlamb"):
                 raise ValueError(
@@ -598,11 +600,14 @@ class DeepSpeedEngine:
             param_specs = jax.tree.map(lambda _: P(), self.params)
             batch_spec_ = self._dp_shardmap_batch_specs(batch, axes)
             err_spec = jax.tree.map(lambda _: P(axes), self.params)
+            # check_vma off: the packed-wire reduce ends in an all_gather +
+            # local decompress whose replication the static checker cannot
+            # infer (same situation as the qgZ path)
             self._onebit_fn = jax.jit(jax.shard_map(
                 body, mesh=topo.mesh,
                 in_specs=(param_specs, batch_spec_, err_spec, P(), P()),
                 out_specs=(P(), jax.tree.map(lambda _: P(), self.params), err_spec),
-                axis_names=set(axes),
+                axis_names=set(axes), check_vma=False,
             ))
         if getattr(self, "_ef_errors", None) is None:
             self._ef_errors = jax.tree.map(
@@ -642,23 +647,34 @@ class DeepSpeedEngine:
         dpn = int(np.prod([topo.get_dim(a) for a in axes]))
 
         if getattr(self, "_qgz_fn", None) is None:
+            from .zero.zeropp import gather_params_tree, manual_axis_specs
+
             apply_fn = self._apply_fn
             base_rng = self._rng
             gas = getattr(self, "_gas_divisor", self.config.gradient_accumulation_steps)
+            full_specs = self._param_specs
+            qwz_wire = bool(self.config.zero_config.zero_quantized_weights)
 
             def body(lp, batch_local, scale, step_idx):
                 rng = jax.random.fold_in(base_rng, step_idx)
+                # stage-3: inside the manual ZeRO axes GSPMD no longer inserts
+                # the param gather — do it explicitly (int8 wire when qwZ is
+                # also on), OUTSIDE the grad so qgZ owns the reduction
+                p_full = gather_params_tree(lp, full_specs, axes,
+                                            quantized=qwz_wire)
 
                 def loss_fn(p):
                     out = apply_fn(p, batch_local, train=True, rng=rng)
                     loss = self._loss_of(out)
                     return loss.astype(jnp.float32) * scale / gas, loss
 
-                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp)
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_full)
                 red = quantized_grad_reduce_tree(grads, axes, dpn)
                 return jax.lax.pmean(loss, axes), red
 
-            param_specs = jax.tree.map(lambda _: P(), self.params)
+            # manual in_specs: the params' real sharding over the ZeRO axes
+            # (replicated at stage<=2, sharded at stage 3); TP axes stay auto
+            param_specs = manual_axis_specs(full_specs, axes)
             batch_spec_ = self._dp_shardmap_batch_specs(batch, axes)
             # check_vma off: the quantized reduce ends in an all_gather whose
             # replication the static checker cannot infer

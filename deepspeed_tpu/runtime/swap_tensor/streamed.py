@@ -48,6 +48,16 @@ class StreamedZeroEngine:
                 "(no MoE / PLD / random-LTD)")
         if config.fp16_enabled:
             raise ValueError("offload_param streaming: use bf16 or fp32, not fp16")
+        if mcfg.dropout > 0:
+            raise ValueError(
+                "offload_param streaming does not support dropout (the "
+                "per-layer programs run rng-free; it would silently differ "
+                "from the resident engine)")
+        if config.gradient_accumulation_steps > 1:
+            raise ValueError(
+                "offload_param streaming runs one optimizer step per "
+                "micro-batch; gradient_accumulation_steps > 1 is not "
+                "supported (it would silently change the effective batch)")
         self.model = model
         self.config = config
         self.lr_scheduler = lr_scheduler
@@ -86,6 +96,11 @@ class StreamedZeroEngine:
         opt = build_optimizer(config.optimizer_name or "adamw",
                               config.optimizer_params or {})
         self._lr = float(getattr(opt, "lr", 1e-3))
+        if self.lr_scheduler is None and config.scheduler_name is not None:
+            from ..lr_schedules import build_lr_scheduler
+
+            self.lr_scheduler = build_lr_scheduler(
+                config.scheduler_name, opt, config.scheduler_params)
         self.cpu_opt = DeepSpeedCPUAdam(
             lr=self._lr, betas=getattr(opt, "betas", (0.9, 0.999)),
             eps=getattr(opt, "eps", 1e-8),

@@ -141,6 +141,70 @@ def make_qwz_transform(param_specs, topo: MeshTopology, num_bits: int = 8):
 
 
 # ----------------------------------------------------------------------------
+# Explicit stage-3 parameter gather for the shard_map (qgZ) path: inside
+# manual ZeRO axes GSPMD no longer inserts the gather, so it is written out —
+# optionally as the qwZ int8 wire (quantize shard-locally, gather codes +
+# block scales, dequantize).
+# ----------------------------------------------------------------------------
+
+def manual_axis_specs(specs, axes):
+    """Restrict a PartitionSpec pytree to the ``axes`` (shard_map in_specs:
+    auto axes must not appear in manual specs)."""
+    axset = set(axes)
+
+    def filt(spec):
+        if spec is None:
+            return P()
+        entries = []
+        for e in spec:
+            kept = tuple(a for a in _entry_axes(e) if a in axset)
+            entries.append(kept[0] if len(kept) == 1 else (kept or None))
+        return P(*entries)
+
+    return jax.tree.map(filt, specs, is_leaf=lambda s: isinstance(s, P))
+
+
+def _gather_param_leaf(x, gather_axes, axis: int, quantized: bool,
+                       num_bits: int = 8, block: int = 512):
+    if not quantized:
+        return lax.all_gather(x, gather_axes, axis=axis, tiled=True)
+    n = int(np.prod(x.shape))
+    nb = -(-n // block)
+    flat = x.reshape(-1).astype(jnp.float32)
+    if nb * block != n:
+        flat = jnp.concatenate([flat, jnp.zeros((nb * block - n,), jnp.float32)])
+    codes, scale = _block_quantize_rows(flat.reshape(nb, block), num_bits)
+    g_codes = lax.all_gather(codes, gather_axes)  # (W, nb, block) int8 wire
+    g_scale = lax.all_gather(scale, gather_axes)
+    deq = (g_codes.astype(jnp.float32) * g_scale).reshape(g_codes.shape[0], -1)
+    deq = deq[:, :n].astype(x.dtype)
+    parts = [deq[i].reshape(x.shape) for i in range(deq.shape[0])]
+    return jnp.concatenate(parts, axis=axis)
+
+
+def gather_params_tree(params, specs, axes, quantized: bool = False):
+    """Rebuild full (ZeRO-gathered) parameters inside a shard_map whose manual
+    axes are ``axes``; TP/auto-axis sharding passes through untouched.
+    ``quantized`` selects the qwZ int8 gather wire. Only true ZeRO axes
+    (data/hpz) are ever gathered — expert-sharded weights stay sharded."""
+    axset = set(axes) & _ZERO_AXIS_SET  # excludes "expert" by construction
+
+    def one(p, spec):
+        if spec is None:
+            return p
+        for i, e in enumerate(spec):
+            gather_axes = tuple(a for a in _entry_axes(e) if a in axset)
+            if gather_axes:
+                return _gather_param_leaf(p, gather_axes, i, quantized)
+        return p
+
+    flat_p, treedef = jax.tree.flatten(params)
+    flat_s = jax.tree.flatten(specs, is_leaf=lambda s: isinstance(s, P))[0]
+    return jax.tree.unflatten(
+        treedef, [one(p, s) for p, s in zip(flat_p, flat_s)])
+
+
+# ----------------------------------------------------------------------------
 # qgZ: int8 block-quantized gradient reduction (call inside shard_map over the
 # DP axes). Two hops like the reference: quantized all-to-all (= reduce-
 # scatter) then quantized all-gather of the reduced shard.

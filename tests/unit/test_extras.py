@@ -57,6 +57,60 @@ class TestOnebit:
         assert rels[200] < rel1
         topo_mod.reset_topology()
 
+    def test_packed_wire_is_8x_smaller_than_int8(self):
+        """The compiled HLO's all-gather operands prove the wire format:
+        uint8 bitmaps move n/8 bytes vs n for int8 signs (32x vs fp32)."""
+        import re
+
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=8)
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
+
+        n = 4096
+
+        def make(wire):
+            def body(g, e):
+                r, ne = compressed_allreduce(g[0], e[0], ("data",), wire=wire)
+                return r[None], ne[None]
+
+            return jax.jit(jax.shard_map(
+                body, mesh=topo.mesh, in_specs=(P("data"), P("data")),
+                out_specs=(P("data"), P("data")), axis_names={"data"}))
+
+        g = jax.random.normal(jax.random.PRNGKey(0), (8, n))
+        e = jnp.zeros_like(g)
+
+        def gather_bytes(fn):
+            hlo = fn.lower(g, e).compile().as_text()
+            sizes = {"u8": 1, "s8": 1, "f32": 4, "bf16": 2, "pred": 1}
+            total = 0
+            for m in re.finditer(
+                    r"=\s*(\w+)\[([\d,]*)\][^\n]*\ball-gather", hlo):
+                dt, dims = m.group(1), m.group(2)
+                count = 1
+                for d in dims.split(","):
+                    if d:
+                        count *= int(d)
+                total += count * sizes.get(dt, 4)
+            return total
+
+        b1, b8 = gather_bytes(make("1bit")), gather_bytes(make("int8"))
+        assert 0 < b1 <= b8 / 7  # ~8x smaller (scales add a few bytes)
+        # numerics: both wires EF-converge to the same mean
+        f1, f8 = make("1bit"), make("int8")
+        e1 = e8 = e
+        a1 = a8 = jnp.zeros((n,))
+        for _ in range(50):
+            r1, e1 = f1(g, e1)
+            r8, e8 = f8(g, e8)
+            a1, a8 = a1 + r1[0], a8 + r8[0]
+        true = jnp.mean(g, axis=0)
+        rel = lambda a: float(jnp.max(jnp.abs(a / 50 - true)))  # noqa: E731
+        assert abs(rel(a1) - rel(a8)) < 0.05
+        topo_mod.reset_topology()
+
     def test_onebit_adam_trains_through_freeze(self):
         topo_mod.reset_topology()
         engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config={
