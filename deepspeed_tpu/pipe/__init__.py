@@ -1,3 +1,3 @@
 """Alias package (reference deepspeed/pipe/__init__.py re-exports PipelineModule)."""
 
-from ..runtime.pipe import LayerSpec, PipelinedLM, PipelineModule  # noqa: F401
+from ..runtime.pipe import LayerSpec, PipelinedLM, PipelineModule, TiedLayerSpec  # noqa: F401

@@ -1,5 +1,5 @@
 """Pipeline parallelism (reference runtime/pipe/ + deepspeed/pipe/)."""
 
 from .engine import PipelineEngine  # noqa: F401
-from .module import LayerSpec, PipelinedLM, PipelineModule  # noqa: F401
+from .module import LayerSpec, PipelinedLM, PipelineModule, TiedLayerSpec  # noqa: F401
 from .spmd import spmd_pipeline  # noqa: F401

@@ -44,7 +44,9 @@ class PipelineEngine(DeepSpeedEngine):
                     )
                 model.num_stages = topo.pipe_parallel_size
             else:
-                if len(model.specs) % topo.pipe_parallel_size:
+                # heterogeneous modules partition unequal stacks themselves
+                if not getattr(model, "_heterogeneous", False) and \
+                        len(model.specs) % topo.pipe_parallel_size:
                     raise ValueError(
                         f"{len(model.specs)} layers not divisible by "
                         f"pipe={topo.pipe_parallel_size}"

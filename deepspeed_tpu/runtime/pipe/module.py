@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...comm.topology import get_topology
@@ -38,6 +39,21 @@ class LayerSpec:
         return self.typename(*self.module_args, **self.module_kwargs)
 
 
+class TiedLayerSpec(LayerSpec):
+    """A layer sharing its parameters with every other ``TiedLayerSpec`` of the
+    same ``name`` (reference ``pipe/module.py:77 TiedLayerSpec`` — e.g. the
+    embedding reused as the LM head). Parameters are initialized by the first
+    occurrence and live replicated across the pipe axis; the shard_map
+    transpose psums their cotangents from every using stage — the analogue of
+    the reference's tied-weight all-reduce (``pipe/engine.py:259
+    ReduceTiedGrads``)."""
+
+    def __init__(self, name: str, typename: Callable, *module_args,
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.name = name
+
+
 class PipelineModule:
     """Uniform pipeline over a list of identical-structure layers.
 
@@ -55,18 +71,54 @@ class PipelineModule:
         topo = topology or get_topology()
         self.topology = topo
         self.num_stages = num_stages or topo.pipe_parallel_size
-        if len(self.specs) % self.num_stages:
-            raise ValueError(
-                f"{len(self.specs)} layers not divisible by {self.num_stages} stages"
-            )
+        self.partition_method = partition_method
         self.loss_fn = loss_fn or (lambda out, labels: jnp.mean((out - labels) ** 2))
         self._built = [s.build() if isinstance(s, LayerSpec) else s for s in self.specs]
         self.num_micro = 1  # set by the engine (= gradient_accumulation_steps)
+        # heterogeneous mode: tied layers, weight-balanced partitioning, or
+        # per-layer parameter structures that differ (reference
+        # ``_partition_layers:370`` handles arbitrary LayerSpec lists)
+        self._tied_idx = {i: s.name for i, s in enumerate(self.specs)
+                          if isinstance(s, TiedLayerSpec)}
+        self._heterogeneous = bool(self._tied_idx) or partition_method != "uniform"
+        if not self._heterogeneous:
+            try:
+                shapes = [jax.eval_shape(lyr.init_params, jax.random.PRNGKey(0))
+                          for lyr in self._built]
+                sigs = {
+                    (str(jax.tree.structure(s)),
+                     tuple((l.shape, str(l.dtype)) for l in jax.tree.leaves(s)))
+                    for s in shapes
+                }
+                self._heterogeneous = len(sigs) > 1
+            except Exception as e:
+                from ...utils.logging import logger
+
+                logger.warning(
+                    "PipelineModule: could not shape-trace layer init_params "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "heterogeneous (fully-replicated) pipeline path")
+                self._heterogeneous = True
+        if not self._heterogeneous and len(self.specs) % self.num_stages:
+            raise ValueError(
+                f"{len(self.specs)} layers not divisible by {self.num_stages} "
+                "stages (use partition_method='parameters' for unequal stages)"
+            )
 
     # ------------------------------------------------------------------
     def init_params(self, rng):
         L = len(self._built)
         keys = jax.random.split(rng, L)
+        if self._heterogeneous:
+            params = {"layers": {}, "tied": {}}
+            for i, (lyr, k) in enumerate(zip(self._built, keys)):
+                name = self._tied_idx.get(i)
+                if name is not None:
+                    if name not in params["tied"]:
+                        params["tied"][name] = lyr.init_params(k)
+                else:
+                    params["layers"][f"l{i}"] = lyr.init_params(k)
+            return params
         per_layer = [lyr.init_params(k) for lyr, k in zip(self._built, keys)]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
         Pn = self.num_stages
@@ -77,11 +129,88 @@ class PipelineModule:
 
     @property
     def tp_specs(self):
+        dummy = jax.eval_shape(lambda: self.init_params(jax.random.PRNGKey(0)))
+        if self._heterogeneous:
+            # per-stage structures differ, so every leaf is replicated (the
+            # lax.switch branches read the full tree); tied leaves must be
+            # replicated for the transpose-psum to realize ReduceTiedGrads
+            return jax.tree.map(lambda a: P(*([None] * a.ndim)), dummy)
+
         def spec_of(a):
             return P("pipe", *([None] * (a.ndim - 1)))
 
-        dummy = jax.eval_shape(lambda: self.init_params(jax.random.PRNGKey(0)))
         return jax.tree.map(spec_of, dummy)
+
+    # ------------------------------------------------------------------
+    def _layer_params(self, params, i):
+        name = self._tied_idx.get(i)
+        return params["tied"][name] if name is not None else params["layers"][f"l{i}"]
+
+    def _analyze(self, params, inputs_mb):
+        """Shape-chain the layer list: the state handed between stages must
+        have ONE shape (the ppermute ring), so a leading shape-changing prefix
+        (embedding) runs in first_fn and a trailing one (LM head) in last_fn.
+        Returns ``(prefix_end, suffix_start, stage_ranges)`` — layers
+        [0, prefix_end) are the ingest prefix, [suffix_start, n) the head
+        suffix, and stage_ranges partitions [prefix_end, suffix_start)."""
+        n = len(self._built)
+        cur = jax.eval_shape(lambda x: x, inputs_mb)
+        chain = [cur]
+        for i, lyr in enumerate(self._built):
+            cur = jax.eval_shape(lyr.apply, self._layer_params(params, i), cur)
+            chain.append(cur)
+
+        def sig(s):
+            return (s.shape, str(s.dtype))
+
+        sigs = [sig(s) for s in chain]  # len n+1; sigs[i] = input of layer i
+        # boundary signature: the most common inter-layer state
+        from collections import Counter
+
+        boundary = Counter(sigs).most_common(1)[0][0]
+        p = next(i for i in range(n + 1) if sigs[i] == boundary)
+        q = max(i for i in range(n + 1) if sigs[i] == boundary)
+        middle = list(range(p, q))  # layers whose input AND output are boundary
+        for i in middle:
+            if sigs[i] != boundary or sigs[i + 1] != boundary:
+                raise ValueError(
+                    f"pipeline stage boundary shape changes at layer {i} "
+                    f"({sigs[i]} -> {sigs[i + 1]}): mid-pipeline shape changes "
+                    "cannot cross stage boundaries")
+        if not middle:
+            raise ValueError("no uniform-shape middle segment to partition")
+        Pn = self.num_stages
+        m = len(middle)  # middle is the contiguous layer range [p, q)
+        if m < Pn:
+            raise ValueError(
+                f"{m} partitionable middle layers < {Pn} pipeline stages")
+        if self.partition_method == "parameters":
+            # balance by parameter count (reference 'parameters' method):
+            # place cut k at the prefix-sum closest to k/Pn of the total,
+            # clamped so every stage gets >= 1 layer (no empty/inverted ranges)
+            counts = []
+            for i in middle:
+                leaves = jax.tree.leaves(jax.eval_shape(
+                    lambda i=i: self._layer_params(params, i)))
+                counts.append(sum(int(np.prod(l.shape)) for l in leaves))
+            total = float(sum(counts)) or 1.0
+            prefix = np.cumsum([0] + counts)  # len m+1
+            cuts = [0]
+            for k in range(1, Pn):
+                target = total * k / Pn
+                j = int(np.argmin(np.abs(prefix - target)))
+                j = max(cuts[-1] + 1, min(j, m - (Pn - k)))
+                cuts.append(j)
+            cuts.append(m)
+            ranges = [(p + cuts[k], p + cuts[k + 1]) for k in range(Pn)]
+        else:
+            base, rem = divmod(m, Pn)
+            ranges, s = [], 0
+            for k in range(Pn):
+                cnt = base + (1 if k < rem else 0)
+                ranges.append((p + s, p + s + cnt))
+                s += cnt
+        return p, q, ranges
 
     # ------------------------------------------------------------------
     def apply(self, params, batch, train: bool = True, rng=None):
@@ -95,6 +224,8 @@ class PipelineModule:
             raise ValueError(f"batch {inputs.shape[0]} not divisible by {M} microbatches")
         inputs = inputs.reshape((M, inputs.shape[0] // M) + inputs.shape[1:])
         labels = labels.reshape((M, labels.shape[0] // M) + labels.shape[1:])
+        if self._heterogeneous:
+            return self._apply_heterogeneous(params, inputs, labels)
         layer = self._built[0]
 
         def first_fn(p, feed_t):
@@ -114,6 +245,58 @@ class PipelineModule:
         loss, _ = spmd_pipeline(
             first_fn, stage_fn, last_fn, params, (inputs, labels),
             mesh=self.topology.mesh, num_micro=self.num_micro,
+        )
+        return loss
+
+    def _apply_heterogeneous(self, params, inputs, labels):
+        """Arbitrary LayerSpec lists (+ TiedLayerSpec): every stage holds the
+        full replicated param tree and runs its own layer segment via
+        per-layer ownership gating — the functional memory/compute tradeoff
+        for non-uniform stacks (the homogeneous path keeps stage-sharded
+        params and is the performance mode)."""
+        mb0 = jax.eval_shape(lambda a: a[0], inputs)
+        p_end, q_start, ranges = self._analyze(params, mb0)
+
+        def run_range(pp, h, lo, hi):
+            for i in range(lo, hi):
+                h = self._built[i].apply(self._layer_params(pp, i), h)
+            return h
+
+        def first_fn(pp, feed_t):
+            return run_range(pp, feed_t[0], 0, p_end)
+
+        stage_of = {}
+        for k, (lo, hi) in enumerate(ranges):
+            for i in range(lo, hi):
+                stage_of[i] = k
+
+        def stage_fn(pp, state, feed_t, rng_t):
+            # per-layer gating instead of lax.switch (switch inside the
+            # pipeline scan transpose crashes XLA's CPU backend): every stage
+            # applies only its own layers, passing the state through
+            # elsewhere. Non-owned layers still trace, so the het path trades
+            # compute for arbitrary per-stage structures — the homogeneous
+            # stacked path remains the performance mode.
+            sid = jax.lax.axis_index("pipe")
+            h = state
+            for i in range(p_end, q_start):
+                y = self._built[i].apply(self._layer_params(pp, i), h)
+                own = (sid == stage_of[i])
+                h = jax.tree.map(
+                    lambda a, b: jnp.where(own, a, b), y, h)
+            return h, jnp.zeros((), jnp.float32)
+
+        def last_fn(pp, state, feed_t):
+            out = run_range(pp, state, q_start, len(self._built))
+            loss = self.loss_fn(out, feed_t[1])
+            return loss.astype(jnp.float32), jnp.asarray(1.0, jnp.float32)
+
+        # remat=False: jax.checkpoint of a lax.switch body segfaults XLA's CPU
+        # backend in the transpose (the het path targets functionality; the
+        # homogeneous stacked path keeps tick-level remat)
+        loss, _ = spmd_pipeline(
+            first_fn, stage_fn, last_fn, params, (inputs, labels),
+            mesh=self.topology.mesh, num_micro=self.num_micro, remat=False,
         )
         return loss
 

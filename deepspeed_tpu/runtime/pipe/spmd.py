@@ -64,22 +64,38 @@ def spmd_pipeline(
 
     from jax.sharding import PartitionSpec
 
-    stage_spec = jax.tree.map(lambda _: PartitionSpec(axis), params["stages"])
-    param_specs = {k: (stage_spec if k == "stages" else jax.tree.map(lambda _: PartitionSpec(), v))
-                   for k, v in params.items()}
+    has_stacked = "stages" in params
+    param_specs = {
+        k: (jax.tree.map(lambda _: PartitionSpec(axis), v)
+            if (k == "stages" and has_stacked)
+            else jax.tree.map(lambda _: PartitionSpec(), v))
+        for k, v in params.items()
+    }
     feed_spec = jax.tree.map(lambda _: PartitionSpec(), feed)
 
     def body(params, feed):
         sid = lax.axis_index(axis)
-        stages_local = jax.tree.map(lambda a: a[0], params["stages"])  # squeeze P-shard
+        # homogeneous path: stacked (P, ...) leaves arrive as this stage's
+        # chunk and become stage_fn's first argument; heterogeneous pipelines
+        # carry everything replicated, and stage_fn receives the FULL params
+        # tree instead (it selects its own segment by axis index)
+        stages_local = (
+            jax.tree.map(lambda a: a[0], params["stages"]) if has_stacked
+            else None
+        )
 
         def feed_at(i):
             return jax.tree.map(lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), feed)
 
         # state template from the first microbatch (cheap: traced shapes only)
         state_shape = jax.eval_shape(lambda: first_fn(params, feed_at(0)))
-        zvar = sum(jnp.sum(x) * 0.0 for x in jax.tree.leaves(stages_local)
+        zsrc = stages_local if stages_local is not None else params
+        zvar = sum(jnp.sum(x) * 0.0 for x in jax.tree.leaves(zsrc)
                    if jnp.issubdtype(x.dtype, jnp.floating))
+        # the scan carry must be pipe-VARYING from the start (heterogeneous
+        # params are fully replicated, so zvar alone would be non-varying
+        # while the tick output varies per stage)
+        zvar = zvar + sid.astype(jnp.float32) * 0.0
         state0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype) + zvar.astype(s.dtype),
                               state_shape)
 
@@ -97,7 +113,8 @@ def spmd_pipeline(
             rng_t = None
             if rng is not None:
                 rng_t = jax.random.fold_in(jax.random.fold_in(rng, t), sid)
-            y, aux = stage_fn(stages_local, x_in, feed_at(here_idx), rng_t)
+            seg_params = stages_local if stages_local is not None else params
+            y, aux = stage_fn(seg_params, x_in, feed_at(here_idx), rng_t)
             # validity of the microbatch currently at this stage: mb = t - sid
             valid_here = (t - sid >= 0) & (t - sid < M)
             aux_sum = aux_sum + jnp.where(valid_here, aux, 0.0)

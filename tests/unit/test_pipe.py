@@ -91,6 +91,128 @@ class TestPipelineModule:
         assert abs(float(loss) - float(ref)) < 1e-5
 
 
+class Embed:
+    """Token embedding (shape-changing ingest layer)."""
+
+    def __init__(self, vocab, dim):
+        self.vocab, self.dim = vocab, dim
+
+    def init_params(self, rng):
+        return {"w": jax.random.normal(rng, (self.vocab, self.dim)) * 0.05}
+
+    def apply(self, p, ids):
+        return jnp.take(p["w"], ids, axis=0)
+
+
+class TiedHead:
+    """LM head reusing the embedding weights (TiedLayerSpec partner)."""
+
+    def __init__(self, vocab, dim):
+        self.vocab, self.dim = vocab, dim
+
+    def init_params(self, rng):
+        return {"w": jax.random.normal(rng, (self.vocab, self.dim)) * 0.05}
+
+    def apply(self, p, x):
+        return x @ p["w"].T
+
+
+class TestHeterogeneousPipeline:
+    def test_tied_embedding_unequal_stages_match_dense(self):
+        """Reference TiedLayerSpec (pipe/module.py:77) + arbitrary layer lists
+        (_partition_layers:370): an embedding-tied LM head with an UNEQUAL
+        middle (3 layers over 2 stages) must match the dense composition's
+        loss and grads — including the tied weight's summed cotangent (the
+        ReduceTiedGrads analogue)."""
+        from deepspeed_tpu.runtime.pipe import TiedLayerSpec
+
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=4, pipe=2)
+        V, D = 64, 32
+        specs = [
+            TiedLayerSpec("embed", Embed, V, D),
+            LayerSpec(Linear, D),
+            LayerSpec(Linear, D),
+            LayerSpec(Linear, D),
+            TiedLayerSpec("embed", TiedHead, V, D),
+        ]
+
+        def ce(logits, labels):
+            lg = logits.astype(jnp.float32)
+            logz = jax.scipy.special.logsumexp(lg, axis=-1)
+            gold = jnp.take_along_axis(lg, labels[..., None], axis=-1)[..., 0]
+            return jnp.mean(logz - gold)
+
+        mod = PipelineModule(specs, loss_fn=ce, topology=topo)
+        assert mod._heterogeneous
+        mod.num_micro = 2
+        params = mod.init_params(jax.random.PRNGKey(0))
+        assert set(params["tied"]) == {"embed"}
+        assert len(params["layers"]) == 3
+
+        rng = np.random.default_rng(3)
+        ids = jnp.asarray(rng.integers(0, V, (4, 16), dtype=np.int32))
+        labels = jnp.asarray(rng.integers(0, V, (4, 16), dtype=np.int32))
+
+        # dense oracle: same layers applied sequentially, shared tied weights
+        built = mod._built
+
+        def dense(params):
+            h = built[0].apply(params["tied"]["embed"], ids)
+            for i in (1, 2, 3):
+                h = built[i].apply(params["layers"][f"l{i}"], h)
+            return ce(built[4].apply(params["tied"]["embed"], h), labels)
+
+        ld = float(dense(params))
+        lp = float(mod.apply(params, (ids, labels)))
+        assert abs(ld - lp) < 1e-5
+        gd = jax.grad(dense)(params)
+        gp = jax.grad(lambda p: mod.apply(p, (ids, labels)))(params)
+        for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gp)):
+            scale = np.abs(np.asarray(a)).max() + 1e-9
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5 * scale, rtol=1e-4)
+        topo_mod.reset_topology()
+
+    def test_parameters_partition_balances(self):
+        """partition_method='parameters' splits a lopsided stack by weight
+        count, not layer count — and never yields empty or inverted stages."""
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=4, pipe=2)
+
+        class Wide(Linear):
+            """Bottleneck layer with 32x the weight of a Linear(64)."""
+
+            def init_params(self, rng):
+                k1, k2 = jax.random.split(rng)
+                return {"w1": jax.random.normal(k1, (self.dim, self.dim * 16)) * 0.05,
+                        "w2": jax.random.normal(k2, (self.dim * 16, self.dim)) * 0.05}
+
+            def apply(self, p, x):
+                return jax.nn.relu(x @ p["w1"] @ p["w2"]) + x
+
+        specs = [LayerSpec(Wide, 64)] + [LayerSpec(Linear, 64)] * 5
+        mod = PipelineModule(specs, topology=topo,
+                             partition_method="parameters")
+        assert mod._heterogeneous
+        params = mod.init_params(jax.random.PRNGKey(0))
+        mb = jax.eval_shape(lambda: jnp.zeros((2, 64)))
+        _, _, ranges = mod._analyze(params, mb)
+        assert len(ranges) == 2
+        assert sum(hi - lo for lo, hi in ranges) == 6
+        for lo, hi in ranges:
+            assert hi > lo  # no empty/inverted stages
+        # the Wide layer dominates the weight count: stage 0 takes ONLY it
+        assert ranges[0] == (0, 1)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 64)),
+                        jnp.float32)
+        y = x * 0.5
+        mod.num_micro = 2
+        loss = mod.apply(params, (x, y))
+        assert np.isfinite(float(loss))
+        topo_mod.reset_topology()
+
+
 class TestPipelineEngine:
     def test_train_batch_loss_decreases(self, pipe_mesh):
         cfg = tiny_cfg(num_layers=4)
