@@ -28,6 +28,26 @@ PEAK_BF16_FLOPS = {
 
 def main():
     import logging
+    import sys
+
+    # the --tune subprocess dispatch must happen BEFORE any jax device query:
+    # once this process attaches the device runtime, the child's sweep cannot
+    # reliably share it (and its HBM wouldn't be isolated anyway)
+    micro_bs = 8  # per chip — the --tune sweep's pick on v5e
+    if "--tune" in sys.argv and "--tune-select" not in sys.argv:
+        import os
+        import subprocess
+
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tune-select"],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--tune sweep subprocess failed rc={proc.returncode}:\n"
+                + proc.stderr[-800:])
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        micro_bs = json.loads(line)["micro_bs"]
+        print(f"# autotuner selected micro_batch={micro_bs}", file=sys.stderr)
 
     import jax
     import jax.numpy as jnp
@@ -43,12 +63,32 @@ def main():
     peak = PEAK_BF16_FLOPS.get(kind, 197e12)
 
     seq = 1024
-    micro_bs = 8  # per chip (sweep: 8 beats 12/16 — OOM or up-recompute cost)
     # unrolled layers (no stacked-residual update-slice traffic) + "dots"
     # remat (saves matmul outputs AND the flash kernel's out/lse residuals)
     # measured 203 ms/step vs 226 for scan+plain-dots on v5e
-    cfg = gpt2_config("350m", max_seq_len=seq, remat=True, remat_policy="dots",
-                      scan_layers=False)
+    mk_cfg = lambda: gpt2_config(  # noqa: E731
+        "350m", max_seq_len=seq, remat=True, remat_policy="dots",
+        scan_layers=False)
+    if "--tune-select" in sys.argv:
+        # (subprocess of --tune) run the autotuner sweep and print the pick
+        from deepspeed_tpu.autotuning import Autotuner
+
+        tuner = Autotuner(lambda: TransformerLM(mk_cfg()), {
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0,
+        })
+        rng0 = np.random.default_rng(1)
+        best = tuner.tune(
+            lambda B: {"input_ids": jnp.asarray(rng0.integers(
+                0, 50304, (B, seq), dtype=np.int32))},
+            zero_stages=(1 if n_chips > 1 else 0,),
+            micro_batches=(4, 8, 12), steps=6)
+        print(json.dumps(
+            {"micro_bs": best.config["train_micro_batch_size_per_gpu"]}))
+        return
+    cfg = mk_cfg()
     model = TransformerLM(cfg)
 
     ds_config = {

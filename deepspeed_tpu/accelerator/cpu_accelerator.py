@@ -34,5 +34,15 @@ class CPU_Accelerator(DeepSpeedAccelerator):
     def is_fp16_supported(self) -> bool:
         return True
 
+    def total_memory(self, device_index=0) -> int:
+        # virtual CPU devices expose no XLA memory stats; the devices share
+        # host RAM, so report the per-device slice of it
+        try:
+            import psutil
+
+            return psutil.virtual_memory().total // max(1, self.device_count())
+        except Exception:
+            return 0
+
     def communication_backend_name(self) -> str:
         return self._communication_backend_name

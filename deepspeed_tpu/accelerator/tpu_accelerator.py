@@ -8,6 +8,17 @@ import functools
 
 from .abstract_accelerator import DeepSpeedAccelerator
 
+# per-chip HBM fallback for runtimes that don't expose memory_stats()
+# (virtual CPU meshes, some plugin backends); live stats win when present
+_HBM_TABLE = {
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,
+    "TPU v5e": 16e9,
+    "TPU v5p": 95e9,
+    "TPU v6 lite": 32e9,
+    "TPU v6e": 32e9,
+}
+
 
 class TPU_Accelerator(DeepSpeedAccelerator):
     def __init__(self):
@@ -42,3 +53,22 @@ class TPU_Accelerator(DeepSpeedAccelerator):
 
     def communication_backend_name(self) -> str:
         return self._communication_backend_name
+
+    # ------------------------- device properties -------------------------
+    def device_kind(self, device_index=0) -> str:
+        try:
+            return self.devices()[device_index].device_kind
+        except Exception:
+            return "unknown"
+
+    def total_memory(self, device_index=0) -> int:
+        """Per-chip HBM: live runtime stats when available, else the known
+        per-generation table (the seam the autotuner asks instead of keeping
+        its own hardware knowledge)."""
+        live = super().total_memory(device_index)
+        if live:
+            return live
+        return int(_HBM_TABLE.get(self.device_kind(device_index), 16e9))
+
+    def memory_stats(self, device_index=0) -> dict:
+        return self._stats(device_index)

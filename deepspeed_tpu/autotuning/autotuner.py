@@ -20,13 +20,14 @@ import numpy as np
 
 from ..utils.logging import log_dist, logger
 
-HBM_PER_CHIP = {
-    "TPU v4": 32e9,
-    "TPU v5 lite": 16e9,
-    "TPU v5e": 16e9,
-    "TPU v5p": 95e9,
-    "TPU v6e": 32e9,
-}
+
+def hbm_per_chip() -> float:
+    """Per-chip HBM from the accelerator seam (live runtime stats with a
+    generation-table fallback) — the autotuner keeps no hardware knowledge
+    of its own (reference consults the accelerator likewise)."""
+    from ..accelerator import get_accelerator
+
+    return float(get_accelerator().total_memory(0))
 
 
 @dataclass
@@ -96,8 +97,9 @@ class Autotuner:
         mcfg = getattr(model, "config", None)
         if mcfg is None:
             return cfgs
-        kind = jax.devices()[0].device_kind
-        hbm = HBM_PER_CHIP.get(kind, 16e9) * 0.9
+        hbm = hbm_per_chip() * 0.9
+        if hbm <= 0:  # unknown-memory backend: nothing to prune against
+            return cfgs
         kept = []
         for cfg in cfgs:
             mesh = cfg.get("mesh", {})
@@ -119,12 +121,15 @@ class Autotuner:
 
     # ------------------------------------------------------------------
     def _profile_one(self, cfg: Dict[str, Any], batch_fn, steps: int = 4) -> TuneResult:
+        import gc
+
         import jax
 
         import deepspeed_tpu
         from deepspeed_tpu.comm import topology as topo_mod
 
         topo_mod.reset_topology()
+        engine = None
         try:
             engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_fn(), config=cfg)
             b = batch_fn(engine.train_micro_batch_size_per_gpu *
@@ -146,19 +151,30 @@ class Autotuner:
         except Exception as e:
             return TuneResult(cfg, 0.0, error=str(e)[:200])
         finally:
+            # release the candidate's HBM before the next compile (a sweep
+            # otherwise accumulates param/optimizer buffers until the real
+            # run OOMs)
+            del engine
+            gc.collect()
+            jax.clear_caches()
             topo_mod.reset_topology()
 
     def tune(self, batch_fn, zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),
-             mesh_shapes=None, max_trials: int = 16, steps: int = 4) -> TuneResult:
+             mesh_shapes=None, max_trials: int = 16, steps: int = 4,
+             tuner_type: str = "gridsearch") -> TuneResult:
         """Run the search; returns the best result (reference ``tune:404``).
-        ``batch_fn(global_batch_size) -> batch``."""
+        ``batch_fn(global_batch_size) -> batch``; ``tuner_type``: gridsearch |
+        random | model_based (reference ``tuner/``)."""
         cfgs = self.candidates(zero_stages, micro_batches, mesh_shapes)
-        cfgs = self.prune_by_memory(cfgs, self.model_fn())[:max_trials]
+        cfgs = self.prune_by_memory(cfgs, self.model_fn())
         if not cfgs:
             raise RuntimeError("no candidate configs survive the memory model")
-        for cfg in cfgs:
-            r = self._profile_one(cfg, batch_fn, steps=steps)
-            self.results.append(r)
+        from .tuner import TUNERS
+
+        strategy = TUNERS[tuner_type](self)
+        best = strategy.tune(cfgs, batch_fn, steps=steps, max_trials=max_trials)
+        for r in self.results:
+            cfg = r.config
             log_dist(
                 f"autotune: stage={cfg['zero_optimization']['stage']} "
                 f"mb={cfg['train_micro_batch_size_per_gpu']} mesh={cfg.get('mesh')} "
@@ -166,7 +182,6 @@ class Autotuner:
                 + (f" (FAILED: {r.error})" if r.error else ""),
                 ranks=[0],
             )
-        best = max(self.results, key=lambda r: r.throughput)
         log_dist(f"autotune best: {best.config.get('zero_optimization')} "
                  f"mb={best.config.get('train_micro_batch_size_per_gpu')} "
                  f"@ {best.throughput:.1f} samples/s", ranks=[0])

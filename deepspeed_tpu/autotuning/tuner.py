@@ -1,0 +1,126 @@
+"""Search strategies over the autotuner's config space.
+
+Reference: ``deepspeed/autotuning/tuner/`` — ``GridSearchTuner``,
+``RandomTuner`` (``random_tuner.py``), ``ModelBasedTuner``
+(``model_based_tuner.py``) with an XGBoost ``cost_model.py``.
+
+TPU design: the same three strategies over the in-process profiler
+(``Autotuner._profile_one``). The cost model is a ridge regression on simple
+config features (log micro-batch, ZeRO stage one-hots, mesh dims) — XGBoost
+is not in the image and the spaces are small; ridge over these features
+captures the monotone throughput-vs-batch and stage-overhead trends the
+reference's model learns.
+"""
+
+import random
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+def _features(cfg: Dict[str, Any]) -> np.ndarray:
+    mb = cfg.get("train_micro_batch_size_per_gpu", 1)
+    stage = cfg.get("zero_optimization", {}).get("stage", 0)
+    mesh = cfg.get("mesh", {}) or {}
+    return np.array([
+        1.0,
+        np.log2(max(1, mb)),
+        float(stage == 1), float(stage == 2), float(stage == 3),
+        np.log2(max(1, mesh.get("data", 1))),
+        np.log2(max(1, mesh.get("model", 1))),
+        np.log2(max(1, mesh.get("pipe", 1))),
+    ])
+
+
+class CostModel:
+    """Ridge regression throughput predictor (reference ``cost_model.py``)."""
+
+    def __init__(self, l2: float = 1e-3):
+        self.l2 = l2
+        self._w: Optional[np.ndarray] = None
+
+    def fit(self, cfgs: List[Dict], throughputs: List[float]):
+        X = np.stack([_features(c) for c in cfgs])
+        y = np.asarray(throughputs, np.float64)
+        A = X.T @ X + self.l2 * np.eye(X.shape[1])
+        self._w = np.linalg.solve(A, X.T @ y)
+
+    def predict(self, cfg: Dict) -> float:
+        if self._w is None:
+            return 0.0
+        return float(_features(cfg) @ self._w)
+
+
+class GridSearchTuner:
+    """Exhaustive sweep (reference ``GridSearchTuner``): profile everything."""
+
+    def __init__(self, autotuner):
+        self.autotuner = autotuner
+
+    def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
+             max_trials: Optional[int] = None):
+        for cfg in cfgs[: max_trials or len(cfgs)]:
+            self.autotuner.results.append(
+                self.autotuner._profile_one(cfg, batch_fn, steps=steps))
+        return max(self.autotuner.results, key=lambda r: r.throughput)
+
+
+class RandomTuner:
+    """Uniform random subset (reference ``RandomTuner``)."""
+
+    def __init__(self, autotuner, seed: int = 0):
+        self.autotuner = autotuner
+        self.rng = random.Random(seed)
+
+    def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
+             max_trials: int = 8):
+        picks = self.rng.sample(cfgs, min(max_trials, len(cfgs)))
+        for cfg in picks:
+            self.autotuner.results.append(
+                self.autotuner._profile_one(cfg, batch_fn, steps=steps))
+        return max(self.autotuner.results, key=lambda r: r.throughput)
+
+
+class ModelBasedTuner:
+    """Cost-model-guided search (reference ``model_based_tuner.py``): seed
+    with a few random profiles, then iteratively profile the model's
+    top-predicted untried config and refit."""
+
+    def __init__(self, autotuner, seed: int = 0, init_trials: int = 3):
+        self.autotuner = autotuner
+        self.rng = random.Random(seed)
+        self.init_trials = init_trials
+        self.model = CostModel()
+
+    def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
+             max_trials: int = 8):
+        remaining = list(cfgs)
+        tried, tputs = [], []
+
+        def profile(cfg):
+            r = self.autotuner._profile_one(cfg, batch_fn, steps=steps)
+            self.autotuner.results.append(r)
+            tried.append(cfg)
+            tputs.append(r.throughput)
+            remaining.remove(cfg)
+            return r
+
+        for cfg in self.rng.sample(remaining,
+                                   min(self.init_trials, len(remaining))):
+            profile(cfg)
+        while remaining and len(tried) < max_trials:
+            self.model.fit(tried, tputs)
+            best_pred = max(remaining, key=self.model.predict)
+            r = profile(best_pred)
+            log_dist(
+                f"model-based tuner: tried predicted-best "
+                f"mb={best_pred.get('train_micro_batch_size_per_gpu')} "
+                f"stage={best_pred.get('zero_optimization', {}).get('stage')} "
+                f"-> {r.throughput:.1f}", ranks=[0])
+        return max(self.autotuner.results, key=lambda r: r.throughput)
+
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+          "model_based": ModelBasedTuner}

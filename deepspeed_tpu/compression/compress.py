@@ -107,18 +107,30 @@ class CompressionScheduler:
             self.pruning.enabled and self.step_count >= self.pruning.schedule_offset)
 
 
-def compress_params(params, scheduler: CompressionScheduler, num_bits: Optional[int] = None):
-    """Apply fake-quant / pruning to matching 2D+ leaves (returns new tree)."""
+def compress_params(params, scheduler: CompressionScheduler, num_bits: Optional[int] = None,
+                    tp_specs=None, topo=None):
+    """Apply fake-quant / pruning to matching 2D+ leaves (returns new tree).
+
+    With ``tp_specs``/``topo``, quantization groups are aligned to each leaf's
+    tensor-parallel shards (see ``tp_aware_quantize_groups``)."""
     wq = scheduler.weight_quantize
     pr = scheduler.pruning
     paths, leaves, treedef = _leaf_paths(params)
+    spec_flat = None
+    if tp_specs is not None and topo is not None:
+        from jax.sharding import PartitionSpec as _P
+
+        spec_flat = jax.tree_util.tree_flatten(
+            tp_specs, is_leaf=lambda s: isinstance(s, _P))[0]
     out = []
     bits = num_bits if num_bits is not None else scheduler.weight_bits()
-    for path, leaf in zip(paths, leaves):
+    for i, (path, leaf) in enumerate(zip(paths, leaves)):
         x = leaf
         if (wq.enabled and leaf.ndim >= 2 and _match(path, wq.modules)
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
             groups = wq.quantize_groups if leaf.size % wq.quantize_groups == 0 else 1
+            if spec_flat is not None and i < len(spec_flat):
+                groups = tp_aware_quantize_groups(leaf, spec_flat[i], topo, groups)
             x = fake_quantize(x, bits, groups, wq.symmetric)
         if (pr.enabled and pr.ratio > 0 and leaf.ndim >= 2 and _match(path, pr.modules)
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
@@ -166,6 +178,92 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         ranks=[0],
     )
     return model, scheduler
+
+
+def student_initialization(student_model, teacher_model, teacher_params,
+                           deepspeed_config=None, teacher_layers=None):
+    """Layer-reduction distillation init (reference ``compress.py:192
+    student_initialization`` + ``layer_reduction`` config): build the
+    shallower student's parameters from selected teacher layers.
+
+    ``teacher_layers``: which teacher block indices seed the student's blocks
+    (defaults to the config's ``layer_reduction.teacher_layer`` list, else an
+    even stride over the teacher's depth). Embeddings, final norm, and head
+    copy over directly. Works on the stacked (L, ...) block layout of
+    ``TransformerLM``.
+    """
+    s_cfg = student_model.config
+    t_cfg = teacher_model.config
+    if (s_cfg.hidden_size, s_cfg.num_heads) != (t_cfg.hidden_size, t_cfg.num_heads):
+        raise ValueError(
+            "student_initialization: student and teacher must share "
+            "hidden_size/num_heads (layer reduction changes depth only)")
+    Ls, Lt = s_cfg.num_layers, t_cfg.num_layers
+    if teacher_layers is None and deepspeed_config is not None:
+        cc = (deepspeed_config.compression_config
+              if hasattr(deepspeed_config, "compression_config")
+              else deepspeed_config) or {}
+        lr_cfg = cc.get("layer_reduction", {})
+        teacher_layers = lr_cfg.get("teacher_layer")
+    if teacher_layers is None:
+        teacher_layers = [round(i * (Lt - 1) / max(1, Ls - 1)) for i in range(Ls)]
+    if len(teacher_layers) != Ls:
+        raise ValueError(
+            f"teacher_layer list has {len(teacher_layers)} entries for a "
+            f"{Ls}-layer student")
+    bad = [i for i in teacher_layers if not 0 <= int(i) < Lt]
+    if bad:
+        # jnp.take would silently CLAMP these to the last layer
+        raise ValueError(
+            f"teacher_layer indices {bad} out of range for a {Lt}-layer "
+            "teacher (valid: 0..{})".format(Lt - 1))
+    idx = jnp.asarray(teacher_layers, jnp.int32)
+    student = dict(teacher_params)
+    student["blocks"] = jax.tree_util.tree_map(
+        lambda a: jnp.take(a, idx, axis=0), teacher_params["blocks"])
+    log_dist(
+        f"student_initialization: {Lt}-layer teacher -> {Ls}-layer student "
+        f"(teacher layers {list(teacher_layers)})", ranks=[0])
+    return student
+
+
+def tp_aware_quantize_groups(leaf, spec, topo, requested_groups: int) -> int:
+    """TP-aware compression (reference ``basic_layer.py:767
+    ColumnParallelLinear_Compress``): quantization groups must tile each TP
+    shard so no block crosses a shard boundary — otherwise every device needs
+    remote statistics and the compressed layer stops being shard-local.
+
+    Groups are contiguous chunks of the row-major flattened leaf, so the
+    shard-local contiguous run along a model-sharded axis ``k`` has
+    ``(shape[k]/shards) * prod(shape[k+1:])`` elements; a chunk is shard-local
+    iff its size divides that run. Returns the adjusted group count.
+    """
+    if spec is None:
+        return requested_groups
+    import numpy as _np
+
+    k, shards = None, 1
+    for i, e in enumerate(spec):
+        axes = e if isinstance(e, (tuple, list)) else (e,)
+        s = 1
+        for a in axes:
+            if a == "model":
+                s *= topo.get_dim(a)
+        if s > 1:
+            k, shards = i, s
+            break
+    if k is None or shards <= 1:
+        return requested_groups
+    shape = leaf.shape
+    if shape[k] % shards:
+        return requested_groups  # uneven shard: leave as requested
+    trailing = int(_np.prod(shape[k + 1:])) if k + 1 < len(shape) else 1
+    seg = (shape[k] // shards) * trailing  # shard-local contiguous run
+    nbase = leaf.size // seg  # minimum groups for shard-locality
+    m = max(1, requested_groups // nbase)
+    while m > 1 and seg % m:
+        m -= 1
+    return nbase * m
 
 
 def redundancy_clean(model, deepspeed_config, mpu=None):

@@ -1,5 +1,6 @@
 """Elasticity (reference deepspeed/elasticity/)."""
 
+from .elastic_agent import DSElasticAgent, RunResult, WorkerSpec  # noqa: F401
 from .elasticity import (  # noqa: F401
     ElasticityConfigError,
     ElasticityError,

@@ -1,0 +1,139 @@
+"""Elastic agent — worker supervision and restart.
+
+Reference: ``deepspeed/elasticity/elastic_agent.py:32 DSElasticAgent``
+(a torch-elastic agent subclass whose ``_invoke_run:125`` monitors worker
+state and restarts the group on failure or membership change).
+
+TPU design: torch-elastic's rendezvous is replaced by the launcher's
+coordinator env (``comm.init_distributed``); the agent is a host-side
+supervisor that (1) spawns the training command, (2) watches it, (3) on
+failure recomputes the elastic world from the currently-reachable hosts via
+``compute_elastic_config`` and relaunches with the adjusted
+``DSTPU_NUM_PROCESSES``, relying on checkpoint/resume (universal checkpoints
+reshard across the new topology) for state continuity.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+from .elasticity import compute_elastic_config
+
+
+@dataclass
+class WorkerSpec:
+    """What to run and how to restart it (reference ``WorkerSpec``)."""
+
+    cmd: List[str]
+    ds_config: Dict
+    max_restarts: int = 3
+    monitor_interval: float = 1.0
+    # returns the currently available world size (device/host probe); the
+    # default asks the launcher's env (static world)
+    world_fn: Optional[Callable[[], int]] = None
+    env: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class RunResult:
+    """Terminal state of the supervised run (reference ``RunResult``)."""
+
+    succeeded: bool
+    restarts: int
+    returncode: int
+    world_sizes: List[int] = field(default_factory=list)
+
+
+class DSElasticAgent:
+    """Supervise a training process group with elastic restart."""
+
+    def __init__(self, spec: WorkerSpec):
+        self.spec = spec
+
+    def _current_world(self) -> int:
+        if self.spec.world_fn is not None:
+            return int(self.spec.world_fn())
+        return int(os.environ.get("DSTPU_NUM_PROCESSES",
+                                  os.environ.get("WORLD_SIZE", "1")))
+
+    def _validate_world(self, world: int) -> int:
+        """Clamp the observed world to an elastic-compatible size (the batch
+        invariant from the config's elasticity block); raises if none fits."""
+        ecfg = (self.spec.ds_config or {}).get("elasticity")
+        if not ecfg or not ecfg.get("enabled", False):
+            return world
+        final_batch, valid_gpus = compute_elastic_config(
+            self.spec.ds_config, world_size=0)
+        ok = [g for g in valid_gpus if g <= world]
+        if not ok:
+            raise RuntimeError(
+                f"no elastic-compatible world <= {world} (valid: {valid_gpus})")
+        chosen = max(ok)
+        if chosen != world:
+            log_dist(
+                f"elastic agent: clamping world {world} -> {chosen} "
+                f"(batch invariant {final_batch})", ranks=[0])
+        return chosen
+
+    def run(self) -> RunResult:
+        """Spawn, monitor, restart (reference ``_invoke_run:125``)."""
+        spec = self.spec
+        restarts = 0
+        worlds: List[int] = []
+        while True:
+            world = self._validate_world(self._current_world())
+            worlds.append(world)
+            env = dict(os.environ)
+            env.update(spec.env or {})
+            env["DSTPU_NUM_PROCESSES"] = str(world)
+            env["DSTPU_ELASTIC_RESTART"] = str(restarts)
+            log_dist(
+                f"elastic agent: launching world={world} "
+                f"(restart {restarts}/{spec.max_restarts})", ranks=[0])
+            proc = subprocess.Popen(spec.cmd, env=env)
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                time.sleep(spec.monitor_interval)
+            if rc == 0:
+                return RunResult(True, restarts, 0, worlds)
+            if restarts >= spec.max_restarts:
+                logger.error(
+                    f"elastic agent: worker failed rc={rc}, restart budget "
+                    f"exhausted ({spec.max_restarts})")
+                return RunResult(False, restarts, rc, worlds)
+            restarts += 1
+            logger.warning(
+                f"elastic agent: worker failed rc={rc}; restarting "
+                f"({restarts}/{spec.max_restarts})")
+
+
+def main(argv=None):
+    """``dstpu_elastic`` CLI: supervise ``-- <cmd...>`` with restarts."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="DeepSpeed-TPU elastic agent")
+    p.add_argument("--deepspeed_config", default=None)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        p.error("no command given (usage: dstpu_elastic [opts] -- cmd ...)")
+    ds_config = {}
+    if args.deepspeed_config:
+        with open(args.deepspeed_config) as f:
+            ds_config = json.load(f)
+    result = DSElasticAgent(WorkerSpec(
+        cmd=cmd, ds_config=ds_config, max_restarts=args.max_restarts)).run()
+    sys.exit(0 if result.succeeded else 1)
+
+
+if __name__ == "__main__":
+    main()

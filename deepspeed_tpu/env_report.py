@@ -37,12 +37,21 @@ def debug_report():
     print("-" * 60)
     print("DeepSpeed-TPU general environment info:")
     print("-" * 60)
+    from .accelerator import get_accelerator
+
+    acc = get_accelerator()
+    hbm = acc.total_memory(0)
+    used = acc.memory_allocated(0) if hasattr(acc, "memory_allocated") else 0
     rows = [
         ("python version", sys.version.split()[0]),
         ("jax version", jax.__version__),
         ("platform", jax.default_backend()),
+        ("accelerator", acc.name),
+        ("device kind", getattr(jax.local_devices()[0], "device_kind", "?")),
         ("local devices", len(jax.local_devices())),
         ("global devices", jax.device_count()),
+        ("memory per device", f"{hbm / 1e9:.1f} GB"
+         + (f" ({used / 1e9:.2f} GB in use)" if used else "")),
         ("process index", f"{jax.process_index()}/{jax.process_count()}"),
         ("g++ available", shutil.which("g++") is not None),
     ]

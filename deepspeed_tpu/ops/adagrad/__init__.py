@@ -1,0 +1,8 @@
+"""Adagrad optimizer (reference ``deepspeed/ops/adagrad/``).
+
+Fused implementation in ``ops.optimizers``; the host (offload) variant is
+``ops.adam.cpu_adam.DeepSpeedCPUAdagrad``.
+"""
+
+from ..adam.cpu_adam import DeepSpeedCPUAdagrad  # noqa: F401
+from ..optimizers import FusedAdagrad  # noqa: F401

@@ -1,0 +1,7 @@
+"""LAMB optimizer (reference ``deepspeed/ops/lamb/``).
+
+The fused implementation lives in ``ops.optimizers`` (XLA fuses the update;
+per-layer trust ratios via tree-level norms).
+"""
+
+from ..optimizers import FusedLamb  # noqa: F401

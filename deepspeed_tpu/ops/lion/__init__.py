@@ -1,0 +1,8 @@
+"""Lion optimizer (reference ``deepspeed/ops/lion/``).
+
+Fused implementation in ``ops.optimizers``; the host (offload) variant is
+``ops.adam.cpu_adam.DeepSpeedCPULion``.
+"""
+
+from ..adam.cpu_adam import DeepSpeedCPULion  # noqa: F401
+from ..optimizers import FusedLion  # noqa: F401

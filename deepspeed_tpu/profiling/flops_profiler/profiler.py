@@ -59,6 +59,76 @@ def _fmt(x: float) -> str:
     return f"{x:.2f}E"
 
 
+def get_module_profile(model, batch, train: bool = False,
+                       print_profile: bool = True):
+    """Per-module FLOPs breakdown for a ``TransformerLM`` (reference
+    ``profiler.py:28`` prints a module-tree profile; the torch version hooks
+    every nn.Module — here each component is its own compiled program put
+    through XLA cost analysis, so the numbers are the compiler's own).
+
+    Returns a list of rows ``(depth, name, flops, params)``; also printed as
+    an indented tree with %% of total when ``print_profile``.
+    """
+    import jax.numpy as jnp
+
+    from ...models.transformer import TransformerLM
+
+    if not isinstance(model, TransformerLM):
+        flops, macs, n_params = get_model_profile(
+            model, batch, train=train, print_profile=print_profile)
+        return [(0, "model", flops, n_params)]
+    cfg = model.config
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = batch["input_ids"] if isinstance(batch, dict) else batch
+    ids = jnp.asarray(ids, jnp.int32)
+    B, S = ids.shape
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    blk0 = jax.tree.map(lambda a: a[0], params["blocks"])
+    x = jnp.zeros((B, S, cfg.hidden_size), jnp.float32)
+
+    def psize(tree):
+        return sum(int(p.size) for p in jax.tree.leaves(tree))
+
+    total = analyze_fn(
+        lambda p, b: model.apply(p, b, train=train), params, batch)["flops"]
+    embed = analyze_fn(
+        lambda p, i: model._embed(p, i, pos, jnp.float32), params, ids)["flops"]
+    block = analyze_fn(
+        lambda bl, h: model._block(h, bl, positions=pos, rng=None,
+                                   train=train)[0], blk0, x)["flops"]
+    from ...ops.transformer.attention import attention as attn_op
+
+    q = jnp.zeros((B, S, cfg.num_heads, cfg.head_dim), jnp.float32)
+    kv = jnp.zeros((B, S, cfg.kv_heads, cfg.head_dim), jnp.float32)
+    attn = analyze_fn(
+        lambda a, b, c: attn_op(a, b, c, causal=cfg.causal,
+                                num_kv_groups=cfg.num_heads // cfg.kv_heads),
+        q, kv, kv)["flops"]
+    head = analyze_fn(lambda p, h: model._head(p, h), params, x)["flops"]
+    L = cfg.num_layers
+    stem_params = psize({k: v for k, v in params.items() if k != "blocks"})
+    rows = [
+        (0, f"{cfg.name} (fwd{'+loss' if train else ''})", total, psize(params)),
+        (1, "embedding", embed, stem_params - (
+            0 if cfg.tie_embeddings else int(params["lm_head"].size))),
+        (1, f"blocks x{L}", block * L, psize(params["blocks"])),
+        (2, "attention core (per layer)", attn, 0),
+        (2, "proj+mlp+norms (per layer)", block - attn, psize(blk0)),
+        (1, "lm head", head, 0 if cfg.tie_embeddings
+         else int(params["lm_head"].size)),
+        (1, "loss/other", total - embed - block * L - head, 0),
+    ]
+    if print_profile:
+        log_dist("-" * 64, ranks=[0])
+        log_dist(f"{'module':<40}{'fwd flops':>12}{'%':>6}", ranks=[0])
+        for depth, name, fl, np_ in rows:
+            pct = 100.0 * fl / total if total > 0 else 0.0
+            log_dist(f"{'  ' * depth + name:<40}{_fmt(fl):>12}{pct:>5.1f}%"
+                     + (f"  params={_fmt(np_)}" if np_ else ""), ranks=[0])
+        log_dist("-" * 64, ranks=[0])
+    return rows
+
+
 class FlopsProfiler:
     """Engine-integrated profiler (reference ``FlopsProfiler:28`` surface).
 

@@ -418,7 +418,10 @@ class DeepSpeedEngine:
                     if comp_key is not None and comp_key[0]:
                         from ..compression.compress import compress_params
 
-                        p = compress_params(p, self._compression, num_bits=comp_key[1])
+                        p = compress_params(p, self._compression,
+                                            num_bits=comp_key[1],
+                                            tp_specs=self._param_specs,
+                                            topo=self.topology)
                     b = batch
                     if ltd_keep is not None and isinstance(batch, dict):
                         b = dict(batch, ltd_keep=ltd_keep)
@@ -553,8 +556,12 @@ class DeepSpeedEngine:
         axes = tuple(a for a in ZERO_AXES if self.topology.get_dim(a) > 1)
         if not axes or self.zero_stage > 1:
             return False
-        # warmup phase communicates full-precision (reference freeze_step)
-        return self.global_steps >= self.optimizer.freeze_step
+        # warmup phase communicates full-precision (reference freeze_step).
+        # Only APPLIED steps warm the Adam variance — overflow-skipped steps
+        # must not advance the freeze counter, or compression starts against
+        # v ~= 0 and the first real update explodes (the reference's state
+        # step likewise only counts real updates)
+        return (self.global_steps - self.skipped_steps) >= self.optimizer.freeze_step
 
     def _onebit_fwd_bwd(self, batch):
         """Local grads under shard_map over the DP axes + EF 1-bit allreduce."""
@@ -1168,6 +1175,11 @@ class DeepSpeedEngine:
                     {"master": mgr["dev"]["master"], "m": mgr["dev"]["m"],
                      "v": mgr["dev"]["v"]}
                 ),
+                # the ratio split at save time — lets a load with a DIFFERENT
+                # offload ratio reshard (reference elastic ckpt reload,
+                # stage_1_and_2.py:2173)
+                "host_idx": list(mgr["host_idx"]),
+                "dev_idx": list(mgr["dev_idx"]),
                 "scaler": _gather_to_host(self.scaler_state._asdict()),
             }
             if jax.process_index() == 0:
@@ -1233,23 +1245,32 @@ class DeepSpeedEngine:
                 and load_optimizer_states and os.path.exists(optim_path):
             optim_sd = self.checkpoint_engine.load(optim_path)
             mgr = self._offload_mgr
-            mgr["host"].load_state_dict(optim_sd["offload_host"])
-            if mgr["dev"] is not None and optim_sd.get("offload_dev"):
-                od = optim_sd["offload_dev"]
-                shard_flat = jax.tree.leaves(self._opt_shardings)
-                for j, i in enumerate(mgr["dev_idx"]):
-                    mgr["dev"]["master"][j] = jax.device_put(
-                        jnp.asarray(od["master"][j], jnp.float32), shard_flat[i])
-                    mgr["dev"]["m"][j] = jax.device_put(
-                        jnp.asarray(od["m"][j], jnp.float32), shard_flat[i])
-                    mgr["dev"]["v"][j] = jax.device_put(
-                        jnp.asarray(od["v"][j], jnp.float32), shard_flat[i])
+            saved_h = optim_sd.get("host_idx")
+            saved_d = optim_sd.get("dev_idx") or []
+            same_split = saved_h is None or (
+                list(saved_h) == list(mgr["host_idx"])
+                and list(saved_d) == list(mgr["dev_idx"]))
+            if same_split:
+                mgr["host"].load_state_dict(optim_sd["offload_host"])
+                if mgr["dev"] is not None and optim_sd.get("offload_dev"):
+                    od = optim_sd["offload_dev"]
+                    shard_flat = jax.tree.leaves(self._opt_shardings)
+                    for j, i in enumerate(mgr["dev_idx"]):
+                        mgr["dev"]["master"][j] = jax.device_put(
+                            jnp.asarray(od["master"][j], jnp.float32), shard_flat[i])
+                        mgr["dev"]["m"][j] = jax.device_put(
+                            jnp.asarray(od["m"][j], jnp.float32), shard_flat[i])
+                        mgr["dev"]["v"][j] = jax.device_put(
+                            jnp.asarray(od["v"][j], jnp.float32), shard_flat[i])
+            else:
+                self._reshard_offload_load(optim_sd, saved_h, saved_d)
             # module weights ARE the master copies under offload
             master = model_sd["module"]
             flat = jax.tree.leaves(master)
             for j, i in enumerate(mgr["host_idx"]):
                 mgr["host"].master[j][...] = np.asarray(flat[i], np.float32)
-            if mgr["dev"] is not None and not optim_sd.get("offload_dev"):
+            if mgr["dev"] is not None and not (optim_sd.get("offload_dev")
+                                               or not same_split):
                 shard_flat = jax.tree.leaves(self._opt_shardings)
                 for j, i in enumerate(mgr["dev_idx"]):
                     mgr["dev"]["master"][j] = jax.device_put(
@@ -1294,6 +1315,53 @@ class DeepSpeedEngine:
 
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
+
+    def _reshard_offload_load(self, optim_sd, saved_h, saved_d):
+        """Restore offloaded optimizer state saved under a DIFFERENT ratio
+        split: rebuild the global per-leaf (master, m, v) map from the saved
+        host+device shards, then redistribute into this engine's split
+        (reference elastic checkpoint re-partitioning,
+        ``stage_1_and_2.py:2173``)."""
+        mgr = self._offload_mgr
+        oh = optim_sd["offload_host"]
+        n = len(mgr["host_idx"]) + len(mgr["dev_idx"])
+        gmaster, gm, gv = [None] * n, [None] * n, [None] * n
+        for j, i in enumerate(saved_h):
+            gmaster[i] = np.asarray(oh["master"][j], np.float32)
+            if "mv" in oh:  # nvme-format state: [m; v] stacked
+                gm[i] = np.asarray(oh["mv"][j][0], np.float32)
+                gv[i] = np.asarray(oh["mv"][j][1], np.float32)
+            else:
+                gm[i] = np.asarray(oh["m"][j], np.float32)
+                gv[i] = np.asarray(oh["v"][j], np.float32)
+        od = optim_sd.get("offload_dev")
+        for j, i in enumerate(saved_d):
+            gmaster[i] = np.asarray(od["master"][j], np.float32)
+            gm[i] = np.asarray(od["m"][j], np.float32).reshape(-1)
+            gv[i] = np.asarray(od["v"][j], np.float32).reshape(-1)
+        step = int(oh["step"])
+        host_sd = {"step": step,
+                   "master": [gmaster[i] for i in mgr["host_idx"]]}
+        if mgr["host"]._aio is None:
+            host_sd["m"] = [gm[i].reshape(-1) for i in mgr["host_idx"]]
+            host_sd["v"] = [gv[i].reshape(-1) for i in mgr["host_idx"]]
+        else:
+            host_sd["mv"] = [np.stack([gm[i].reshape(-1), gv[i].reshape(-1)])
+                             for i in mgr["host_idx"]]
+        mgr["host"].load_state_dict(host_sd)
+        if mgr["dev"] is not None:
+            shard_flat = jax.tree.leaves(self._opt_shardings)
+            shapes = [m.shape for m in mgr["dev"]["master"]]
+            for j, i in enumerate(mgr["dev_idx"]):
+                mgr["dev"]["master"][j] = jax.device_put(
+                    jnp.asarray(gmaster[i], jnp.float32).reshape(shapes[j]),
+                    shard_flat[i])
+                mgr["dev"]["m"][j] = jax.device_put(
+                    jnp.asarray(gm[i], jnp.float32).reshape(shapes[j]),
+                    shard_flat[i])
+                mgr["dev"]["v"][j] = jax.device_put(
+                    jnp.asarray(gv[i], jnp.float32).reshape(shapes[j]),
+                    shard_flat[i])
 
     def _offload_master_tree(self):
         """Full fp32 master pytree assembled from host + device offload shards."""

@@ -1,0 +1,8 @@
+"""fp16 mixed precision (reference ``deepspeed/runtime/fp16/``).
+
+Dynamic loss scaling lives in ``loss_scaler``; the 1-bit optimizer family
+(reference ``fp16/onebit/``) is in ``ops.adam.onebit_adam``.
+"""
+
+from .loss_scaler import (CreateLossScaler, DynamicLossScaler,  # noqa: F401
+                          LossScaler, LossScalerState)

@@ -22,6 +22,27 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# fast, compile-light tests — `pytest -m smoke` finishes in well under 90 s
+# (the reference splits similarly with its `sequential` marker + forked xdist,
+# tests/unit/common.py)
+_SMOKE = (
+    "test_config.py",
+    "test_comm.py::test_launcher",
+    "test_comm.py::test_rank_env",
+    "test_comm.py::TestMultinodeRunners",
+    "test_comm.py::TestTopology",
+    "test_inference_v2.py::TestStateManager",
+    "test_inference_v2.py::TestPagedKV::test_block_allocator_lifecycle",
+    "test_offload.py::TestSplit",
+    "test_zero_init_utils.py",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(pat in item.nodeid for pat in _SMOKE):
+            item.add_marker(pytest.mark.smoke)
+
 
 @pytest.fixture(autouse=True)
 def _reset_global_state():

@@ -172,6 +172,50 @@ def test_launcher_hostfile_parsing(tmp_path):
     assert list(active) == ["worker-0"]
 
 
+def test_rank_env_discovery(monkeypatch):
+    """init_distributed's multi-process rendezvous passes the coordinator and
+    the per-backend rank variable (DSTPU_PROCESS_ID > PMI_RANK >
+    OMPI_COMM_WORLD_RANK) to jax.distributed.initialize."""
+    import jax
+
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    captured = {}
+
+    def fake_init(**kw):
+        captured.update(kw)
+        raise RuntimeError("already initialized")  # short-circuit the probe
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    for var, rank in (("DSTPU_PROCESS_ID", 3), ("PMI_RANK", 2),
+                      ("OMPI_COMM_WORLD_RANK", 1)):
+        captured.clear()
+        monkeypatch.setattr(comm_mod, "_initialized", False)
+        monkeypatch.setenv("DSTPU_NUM_PROCESSES", "4")
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "worker-0:29500")
+        for v in ("DSTPU_PROCESS_ID", "PMI_RANK", "OMPI_COMM_WORLD_RANK"):
+            monkeypatch.delenv(v, raising=False)
+        monkeypatch.setenv(var, str(rank))
+        try:
+            comm_mod.init_distributed()
+        except Exception:
+            pass
+        assert captured.get("coordinator_address") == "worker-0:29500"
+        assert captured.get("num_processes") == 4
+        assert captured.get("process_id") == rank, var
+    # precedence: DSTPU_PROCESS_ID wins over PMI_RANK
+    captured.clear()
+    monkeypatch.setattr(comm_mod, "_initialized", False)
+    monkeypatch.setenv("DSTPU_PROCESS_ID", "3")
+    monkeypatch.setenv("PMI_RANK", "2")
+    try:
+        comm_mod.init_distributed()
+    except Exception:
+        pass
+    assert captured.get("process_id") == 3
+    monkeypatch.setattr(comm_mod, "_initialized", True)
+
+
 def test_launcher_bad_hostfile(tmp_path):
     from deepspeed_tpu.launcher.runner import fetch_hostfile
 

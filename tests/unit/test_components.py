@@ -1,0 +1,220 @@
+"""Round-2 component fills: TiledLinear, elastic agent, tuner strategies,
+compression distillation / TP-aware groups, per-module FLOPs breakdown
+(reference: ``runtime/zero/tiling.py``, ``elasticity/elastic_agent.py``,
+``autotuning/tuner/``, ``compression/compress.py:192``,
+``profiling/flops_profiler/profiler.py:28``)."""
+
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+
+class TestTiledLinear:
+    def test_matches_dense(self):
+        from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(64, 96)).astype(np.float32)
+        b = rng.normal(size=(96,)).astype(np.float32)
+        x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+        dense = x @ jnp.asarray(w) + jnp.asarray(b)
+        for ins, outs in ((1, 1), (2, 3), (4, 4)):
+            mod, params = TiledLinear.from_dense(w, b, in_splits=ins,
+                                                 out_splits=outs)
+            got = mod.apply(params, x)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(mod.dense_weight(params), w)
+
+    def test_grad_and_remat(self):
+        from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+        mod = TiledLinear(32, 32, in_splits=2, out_splits=2, remat_tile=True)
+        params = mod.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+        g = jax.grad(lambda p: jnp.sum(mod.apply(p, x) ** 2))(params)
+        assert all(bool(jnp.isfinite(v).all()) for v in jax.tree.leaves(g))
+
+    def test_bad_split_raises(self):
+        from deepspeed_tpu.runtime.zero.tiling import TiledLinear
+
+        with pytest.raises(ValueError):
+            TiledLinear(10, 10, in_splits=3)
+
+
+class TestElasticAgent:
+    def _script(self, tmp_path, fail_times):
+        marker = tmp_path / "attempts"
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent(f"""
+            import pathlib, sys
+            m = pathlib.Path({str(marker)!r})
+            n = int(m.read_text()) if m.exists() else 0
+            m.write_text(str(n + 1))
+            sys.exit(1 if n < {fail_times} else 0)
+        """))
+        return script
+
+    def test_restarts_until_success(self, tmp_path):
+        from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+        script = self._script(tmp_path, fail_times=2)
+        res = DSElasticAgent(WorkerSpec(
+            cmd=[sys.executable, str(script)], ds_config={},
+            max_restarts=3, monitor_interval=0.05)).run()
+        assert res.succeeded and res.restarts == 2
+
+    def test_restart_budget_exhausts(self, tmp_path):
+        from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+        script = self._script(tmp_path, fail_times=99)
+        res = DSElasticAgent(WorkerSpec(
+            cmd=[sys.executable, str(script)], ds_config={},
+            max_restarts=1, monitor_interval=0.05)).run()
+        assert not res.succeeded and res.restarts == 1 and res.returncode == 1
+
+    def test_elastic_world_clamped(self, tmp_path):
+        from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+        script = self._script(tmp_path, fail_times=0)
+        worlds = iter([7])  # 7 is not compatible with micro-batches x gpus
+        spec = WorkerSpec(
+            cmd=[sys.executable, str(script)],
+            ds_config={"elasticity": {
+                "enabled": True, "micro_batch_sizes": [2, 4],
+                "max_acceptable_batch_size": 16, "version": 0.1}},
+            max_restarts=0, monitor_interval=0.05,
+            world_fn=lambda: next(worlds))
+        res = DSElasticAgent(spec).run()
+        assert res.succeeded
+        assert res.world_sizes[0] in (1, 2, 4, 8) and res.world_sizes[0] <= 7
+
+
+class TestTuners:
+    def _autotuner(self):
+        from deepspeed_tpu.autotuning import Autotuner
+
+        at = Autotuner(lambda: None, {})
+        # stub the profiler: throughput = mb * (1.1 if stage 1 else 1.0)
+        from deepspeed_tpu.autotuning.autotuner import TuneResult
+
+        def fake_profile(cfg, batch_fn, steps=4):
+            mb = cfg["train_micro_batch_size_per_gpu"]
+            st = cfg["zero_optimization"]["stage"]
+            return TuneResult(cfg, mb * (1.1 if st == 1 else 1.0))
+
+        at._profile_one = fake_profile
+        return at
+
+    def _cfgs(self, at):
+        return at.candidates(zero_stages=(0, 1), micro_batches=(1, 2, 4, 8))
+
+    def test_random_tuner_subset(self):
+        from deepspeed_tpu.autotuning.tuner import RandomTuner
+
+        at = self._autotuner()
+        best = RandomTuner(at, seed=0).tune(self._cfgs(at), None, max_trials=4)
+        assert len(at.results) == 4
+        assert best.throughput == max(r.throughput for r in at.results)
+
+    def test_model_based_tuner_converges(self):
+        from deepspeed_tpu.autotuning.tuner import ModelBasedTuner
+
+        at = self._autotuner()
+        best = ModelBasedTuner(at, seed=0, init_trials=2).tune(
+            self._cfgs(at), None, max_trials=5)
+        # with 5 of 8 trials the cost model must find the optimum (mb=8, s1)
+        assert best.config["train_micro_batch_size_per_gpu"] == 8
+        assert best.config["zero_optimization"]["stage"] == 1
+        assert len(at.results) == 5
+
+    def test_cost_model_learns_trend(self):
+        from deepspeed_tpu.autotuning.tuner import CostModel
+
+        cfgs = [{"train_micro_batch_size_per_gpu": m,
+                 "zero_optimization": {"stage": 0}} for m in (1, 2, 4)]
+        cm = CostModel()
+        cm.fit(cfgs, [10.0, 20.0, 40.0])
+        hi = {"train_micro_batch_size_per_gpu": 8,
+              "zero_optimization": {"stage": 0}}
+        assert cm.predict(hi) > cm.predict(cfgs[-1])
+
+
+class TestCompressionFills:
+    def test_student_initialization(self):
+        from deepspeed_tpu.compression.compress import student_initialization
+
+        topo_mod.reset_topology()
+        t_cfg = gpt2_config("125m", hidden_size=64, num_layers=6, num_heads=4,
+                            vocab_size=128, max_seq_len=32)
+        s_cfg = gpt2_config("125m", hidden_size=64, num_layers=3, num_heads=4,
+                            vocab_size=128, max_seq_len=32)
+        teacher, student = TransformerLM(t_cfg), TransformerLM(s_cfg)
+        tp = teacher.init_params(jax.random.PRNGKey(0))
+        sp = student_initialization(student, teacher, tp,
+                                    teacher_layers=[0, 2, 5])
+        for k in sp["blocks"]:
+            got = np.asarray(sp["blocks"][k])
+            want = np.asarray(tp["blocks"][k])[[0, 2, 5]]
+            np.testing.assert_array_equal(got, want)
+        # the student params actually run
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 128, (2, 16), dtype=np.int32))
+        assert np.isfinite(float(student.apply(sp, {"input_ids": ids})))
+        with pytest.raises(ValueError, match="entries"):
+            student_initialization(student, teacher, tp, teacher_layers=[0, 1])
+        with pytest.raises(ValueError, match="out of range"):
+            student_initialization(student, teacher, tp,
+                                   teacher_layers=[0, 2, 6])
+
+    def test_tp_aware_groups(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.compression.compress import tp_aware_quantize_groups
+
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=4, model=2)
+        leaf = jnp.zeros((64, 64))
+        # column-sharded leaf (axis 1, 2 shards): each flat quantize chunk
+        # must fit inside one shard-local contiguous run (32 elements)
+        g = tp_aware_quantize_groups(leaf, P(None, "model"), topo, 3)
+        chunk = leaf.size // g
+        assert leaf.size % g == 0 and (64 // 2) % chunk == 0
+        # row-sharded leaf (axis 0): run = 32*64 elements
+        g0 = tp_aware_quantize_groups(leaf, P("model", None), topo, 3)
+        assert leaf.size % g0 == 0 and (32 * 64) % (leaf.size // g0) == 0
+        # unsharded leaf: untouched
+        assert tp_aware_quantize_groups(leaf, P(None, None), topo, 3) == 3
+        topo_mod.reset_topology()
+
+
+class TestModuleProfile:
+    def test_tree_breakdown(self):
+        from deepspeed_tpu.profiling.flops_profiler.profiler import (
+            get_module_profile)
+
+        topo_mod.reset_topology()
+        cfg = gpt2_config("125m", hidden_size=64, num_layers=2, num_heads=4,
+                          vocab_size=256, max_seq_len=32)
+        model = TransformerLM(cfg)
+        ids = np.random.default_rng(0).integers(0, 256, (2, 32), dtype=np.int32)
+        rows = get_module_profile(model, {"input_ids": jnp.asarray(ids)},
+                                  print_profile=False)
+        names = [r[1] for r in rows]
+        assert any("blocks" in n for n in names)
+        assert any("attention" in n for n in names)
+        # component programs are analyzed standalone; the fused full program
+        # can legitimately count fewer flops, so assert structure, not sums
+        assert rows[0][2] > 0
+        block_row = next(r for r in rows if "blocks" in r[1])
+        attn_row = next(r for r in rows if "attention" in r[1])
+        assert 0 < attn_row[2] * 2 < block_row[2]  # attn is a strict subset
+        head_row = next(r for r in rows if "head" in r[1])
+        assert head_row[2] > 0

@@ -111,6 +111,38 @@ class TestOnebit:
         assert abs(rel(a1) - rel(a8)) < 0.05
         topo_mod.reset_topology()
 
+    def test_fp16_overflow_interaction(self):
+        """fp16 + 1-bit: an overflow step must be skipped (scale drops), the
+        EF residual must stay finite (the sanitizer), and training must
+        recover afterwards."""
+        topo_mod.reset_topology()
+        engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "onebitadam", "params": {
+                "lr": 1e-3, "freeze_step": 4}},
+            "zero_optimization": {"stage": 1},
+            # absurd initial scale: the first scaled fp16 grads overflow
+            "fp16": {"enabled": True, "initial_scale_power": 18,
+                     "loss_scale_window": 2},
+            "mesh": {"data": 8},
+            "steps_per_print": 0,
+        })
+        b = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(
+            0, 128, (8, 32), dtype=np.int32))}
+        losses = []
+        for _ in range(16):
+            loss = engine(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert engine.skipped_steps >= 1  # the overflow was detected+skipped
+        assert float(engine.scaler_state.cur_scale) < 2.0 ** 18  # backed off
+        if engine._ef_errors is not None:  # compressed phase engaged
+            for e in jax.tree.leaves(engine._ef_errors):
+                assert bool(jnp.isfinite(e).all())  # sanitizer held
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]  # recovered and trains
+
     def test_onebit_adam_trains_through_freeze(self):
         topo_mod.reset_topology()
         engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config={

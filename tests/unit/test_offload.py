@@ -110,6 +110,23 @@ class TestNVMeOffload:
             files = [f for f in os.listdir(d) if f.startswith("optstate")]
             assert files
 
+    def test_load_checkpoint_resharded_ratio(self, tmp_path):
+        """A checkpoint saved under one Offload++ ratio restores into an
+        engine with a DIFFERENT ratio (host/device split changes) and
+        continues identically (reference elastic checkpoint re-partitioning,
+        stage_1_and_2.py:2173)."""
+        eng = make_engine(offload={"device": "cpu", "ratio": 1.0})
+        for loss in train_losses(eng, n=3):
+            pass
+        eng.save_checkpoint(str(tmp_path), tag="t0")
+        ref_cont = train_losses(eng, n=3)
+
+        eng2 = make_engine(offload={"device": "cpu", "ratio": 0.4})
+        assert eng2._offload_mgr["dev_idx"]  # genuinely a different split
+        eng2.load_checkpoint(str(tmp_path), tag="t0")
+        got_cont = train_losses(eng2, n=3)
+        np.testing.assert_allclose(got_cont, ref_cont, rtol=1e-5, atol=1e-5)
+
     def test_nvme_matches_cpu(self):
         with tempfile.TemporaryDirectory() as d:
             nv = train_losses(make_engine(offload={"device": "nvme", "nvme_path": d}))
