@@ -45,8 +45,12 @@ def main():
             raise RuntimeError(
                 f"--tune sweep subprocess failed rc={proc.returncode}:\n"
                 + proc.stderr[-800:])
-        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
-        micro_bs = json.loads(line)["micro_bs"]
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            raise RuntimeError(
+                "--tune sweep subprocess produced no output:\n"
+                + proc.stderr[-800:])
+        micro_bs = json.loads(lines[-1])["micro_bs"]
         print(f"# autotuner selected micro_batch={micro_bs}", file=sys.stderr)
 
     import jax

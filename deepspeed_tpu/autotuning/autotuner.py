@@ -171,9 +171,10 @@ class Autotuner:
             raise RuntimeError("no candidate configs survive the memory model")
         from .tuner import TUNERS
 
+        start = len(self.results)
         strategy = TUNERS[tuner_type](self)
         best = strategy.tune(cfgs, batch_fn, steps=steps, max_trials=max_trials)
-        for r in self.results:
+        for r in self.results[start:]:
             cfg = r.config
             log_dist(
                 f"autotune: stage={cfg['zero_optimization']['stage']} "

@@ -61,10 +61,11 @@ class GridSearchTuner:
 
     def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
              max_trials: Optional[int] = None):
+        start = len(self.autotuner.results)  # scope "best" to THIS sweep
         for cfg in cfgs[: max_trials or len(cfgs)]:
             self.autotuner.results.append(
                 self.autotuner._profile_one(cfg, batch_fn, steps=steps))
-        return max(self.autotuner.results, key=lambda r: r.throughput)
+        return max(self.autotuner.results[start:], key=lambda r: r.throughput)
 
 
 class RandomTuner:
@@ -76,11 +77,12 @@ class RandomTuner:
 
     def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
              max_trials: int = 8):
+        start = len(self.autotuner.results)
         picks = self.rng.sample(cfgs, min(max_trials, len(cfgs)))
         for cfg in picks:
             self.autotuner.results.append(
                 self.autotuner._profile_one(cfg, batch_fn, steps=steps))
-        return max(self.autotuner.results, key=lambda r: r.throughput)
+        return max(self.autotuner.results[start:], key=lambda r: r.throughput)
 
 
 class ModelBasedTuner:
@@ -96,6 +98,7 @@ class ModelBasedTuner:
 
     def tune(self, cfgs: List[Dict], batch_fn, steps: int = 4,
              max_trials: int = 8):
+        start = len(self.autotuner.results)
         remaining = list(cfgs)
         tried, tputs = [], []
 
@@ -119,7 +122,7 @@ class ModelBasedTuner:
                 f"mb={best_pred.get('train_micro_batch_size_per_gpu')} "
                 f"stage={best_pred.get('zero_optimization', {}).get('stage')} "
                 f"-> {r.throughput:.1f}", ranks=[0])
-        return max(self.autotuner.results, key=lambda r: r.throughput)
+        return max(self.autotuner.results[start:], key=lambda r: r.throughput)
 
 
 TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,

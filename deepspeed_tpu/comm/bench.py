@@ -1,0 +1,95 @@
+"""Communication benchmark CLI (reference ``bin/ds_bench`` →
+``benchmarks/communication/``): sweeps collective ops over message sizes and
+reports latency / algorithm bandwidth / bus bandwidth.
+
+TPU design: one process drives the whole mesh (SPMD), so the sweep jits each
+collective under ``shard_map`` over the ZeRO data axes and times real ICI (or
+virtual-mesh) executions. Bus-bandwidth factors follow the reference's
+``utils.py`` conventions: allreduce 2(n-1)/n, allgather/reducescatter (n-1)/n,
+alltoall (n-1)/n.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _bench_one(op: str, nbytes: int, trials: int, warmups: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from .topology import get_topology
+
+    topo = get_topology()
+    n = topo.data_parallel_size
+    axis = "data"
+    count = max(1, nbytes // 4)  # fp32 elements per device
+    x = jnp.arange(n * count, dtype=jnp.float32).reshape(n, count)
+
+    def body(x):
+        v = x[0]
+        if op == "all_reduce":
+            return lax.psum(v, axis)[None]
+        if op == "all_gather":
+            return lax.all_gather(v, axis)[None]
+        if op == "reduce_scatter":
+            return lax.psum_scatter(v, axis, tiled=True)[None]
+        if op == "all_to_all":
+            vv = v.reshape(n, count // n) if count % n == 0 else \
+                jnp.resize(v, (n, max(1, count // n)))
+            return lax.all_to_all(vv, axis, 0, 0, tiled=False).reshape(1, -1)
+        raise ValueError(op)
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=topo.mesh, in_specs=(P(axis),), out_specs=P(axis),
+        check_vma=False))
+    for i in range(warmups):
+        jax.block_until_ready(fn(x + i))
+    t0 = time.perf_counter()
+    outs = [fn(x + warmups + i) for i in range(trials)]
+    jax.block_until_ready(outs)
+    np.asarray(jax.device_get(jax.tree.leaves(outs[-1])[0]).ravel()[0])
+    dt = (time.perf_counter() - t0) / trials
+    # reference busbw conventions (benchmarks/communication/utils.py)
+    factor = {"all_reduce": 2 * (n - 1) / n, "all_gather": (n - 1) / n,
+              "reduce_scatter": (n - 1) / n, "all_to_all": (n - 1) / n}[op]
+    algbw = nbytes / dt
+    return {"op": op, "bytes": nbytes, "latency_us": round(dt * 1e6, 1),
+            "algbw_GBps": round(algbw / 1e9, 3),
+            "busbw_GBps": round(algbw * factor / 1e9, 3), "world": n}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="DeepSpeed-TPU collective benchmark (ds_bench parity)")
+    p.add_argument("--op", default="all",
+                   choices=["all", "all_reduce", "all_gather",
+                            "reduce_scatter", "all_to_all"])
+    p.add_argument("--minsize", type=int, default=1 << 12)
+    p.add_argument("--maxsize", type=int, default=1 << 24)
+    p.add_argument("--trials", type=int, default=10)
+    p.add_argument("--warmups", type=int, default=3)
+    args = p.parse_args(argv)
+
+    from . import init_distributed
+
+    init_distributed()
+    ops = (["all_reduce", "all_gather", "reduce_scatter", "all_to_all"]
+           if args.op == "all" else [args.op])
+    size = args.minsize
+    results = []
+    while size <= args.maxsize:
+        for op in ops:
+            r = _bench_one(op, size, args.trials, args.warmups)
+            results.append(r)
+            print(json.dumps(r))
+        size *= 4
+    return results
+
+
+if __name__ == "__main__":
+    main()

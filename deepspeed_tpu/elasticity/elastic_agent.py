@@ -95,11 +95,31 @@ class DSElasticAgent:
                 f"elastic agent: launching world={world} "
                 f"(restart {restarts}/{spec.max_restarts})", ranks=[0])
             proc = subprocess.Popen(spec.cmd, env=env)
+            membership_change = False
             while True:
                 rc = proc.poll()
                 if rc is not None:
                     break
+                # per-tick supervision (reference _invoke_run:125 checks the
+                # rendezvous each interval): a membership change relaunches
+                # the group under the new world without consuming the
+                # failure-restart budget
+                if spec.world_fn is not None:
+                    try:
+                        new_world = self._validate_world(self._current_world())
+                    except Exception:  # probe failures never kill the group
+                        new_world = world
+                    if new_world != world:
+                        logger.warning(
+                            f"elastic agent: world changed {world} -> "
+                            f"{new_world}; relaunching")
+                        proc.terminate()
+                        proc.wait(timeout=30)
+                        membership_change = True
+                        break
                 time.sleep(spec.monitor_interval)
+            if membership_change:
+                continue
             if rc == 0:
                 return RunResult(True, restarts, 0, worlds)
             if restarts >= spec.max_restarts:

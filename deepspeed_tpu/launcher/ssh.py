@@ -1,0 +1,34 @@
+"""``dstpu_ssh`` — run a command on every host in the hostfile (reference
+``bin/ds_ssh``: a pdsh fan-out convenience for cluster admin)."""
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+from .runner import fetch_hostfile, parse_inclusion_exclusion
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Run a command on all hosts in the hostfile")
+    p.add_argument("-H", "--hostfile", default=DEFAULT_HOSTFILE)
+    p.add_argument("--include", default="")
+    p.add_argument("--exclude", default="")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    cmd = [c for c in args.command if c != "--"]
+    if not cmd:
+        p.error("no command given (usage: dstpu_ssh [-H hostfile] -- cmd ...)")
+    pool = fetch_hostfile(args.hostfile)
+    active = parse_inclusion_exclusion(pool, args.include, args.exclude)
+    hosts = ",".join(active.keys())
+    full = ["pdsh", "-w", hosts, " ".join(map(shlex.quote, cmd))]
+    print(f"dstpu_ssh: {' '.join(full)}", file=sys.stderr)
+    return subprocess.call(full)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,7 +116,9 @@ def get_module_profile(model, batch, train: bool = False,
         (2, "proj+mlp+norms (per layer)", block - attn, psize(blk0)),
         (1, "lm head", head, 0 if cfg.tie_embeddings
          else int(params["lm_head"].size)),
-        (1, "loss/other", total - embed - block * L - head, 0),
+        # components are analyzed standalone; the fused full program can count
+        # fewer flops, so the residual is clamped rather than shown negative
+        (1, "loss/other (residual)", max(0.0, total - embed - block * L - head), 0),
     ]
     if print_profile:
         log_dist("-" * 64, ranks=[0])

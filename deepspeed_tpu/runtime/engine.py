@@ -1269,8 +1269,8 @@ class DeepSpeedEngine:
             flat = jax.tree.leaves(master)
             for j, i in enumerate(mgr["host_idx"]):
                 mgr["host"].master[j][...] = np.asarray(flat[i], np.float32)
-            if mgr["dev"] is not None and not (optim_sd.get("offload_dev")
-                                               or not same_split):
+            if mgr["dev"] is not None and same_split \
+                    and not optim_sd.get("offload_dev"):
                 shard_flat = jax.tree.leaves(self._opt_shardings)
                 for j, i in enumerate(mgr["dev_idx"]):
                     mgr["dev"]["master"][j] = jax.device_put(

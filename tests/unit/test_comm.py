@@ -298,3 +298,30 @@ class TestMultinodeRunners:
 
         with pytest.raises(ValueError, match="unknown launcher"):
             build_runner("pbs", _runner_args())
+
+
+def test_comm_bench_sweep():
+    """dstpu_bench parity (reference bin/ds_bench): every collective sweeps
+    and reports sane latency/bandwidth numbers on the virtual mesh."""
+    from deepspeed_tpu.comm.bench import _bench_one
+
+    initialize_topology(data=8)
+    for op in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all"):
+        r = _bench_one(op, 8192, trials=2, warmups=1)
+        assert r["latency_us"] > 0 and r["algbw_GBps"] > 0
+        assert r["world"] == 8
+
+
+def test_dstpu_ssh_cmd(tmp_path, monkeypatch):
+    """dstpu_ssh builds the pdsh fan-out over the hostfile (reference bin/ds_ssh)."""
+    import deepspeed_tpu.launcher.ssh as ssh_mod
+
+    hf = tmp_path / "hostfile"
+    hf.write_text("worker-0 slots=4\nworker-1 slots=4\n")
+    captured = {}
+    monkeypatch.setattr(ssh_mod.subprocess, "call",
+                        lambda cmd: captured.setdefault("cmd", cmd) and 0)
+    ssh_mod.main(["-H", str(hf), "--exclude", "worker-1", "--", "hostname"])
+    cmd = captured["cmd"]
+    assert cmd[0] == "pdsh" and cmd[cmd.index("-w") + 1] == "worker-0"
+    assert cmd[-1] == "hostname"

@@ -218,3 +218,28 @@ class TestModuleProfile:
         assert 0 < attn_row[2] * 2 < block_row[2]  # attn is a strict subset
         head_row = next(r for r in rows if "head" in r[1])
         assert head_row[2] > 0
+
+
+def test_membership_change_relaunches(tmp_path):
+    """A world-size change observed mid-run relaunches the group under the
+    new world WITHOUT consuming the failure-restart budget."""
+    import itertools
+
+    from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+    # worker sleeps long enough for the agent to observe the world change
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import pathlib, sys, time\n"
+        f"m = pathlib.Path({str(tmp_path / 'runs')!r})\n"
+        "n = int(m.read_text()) if m.exists() else 0\n"
+        "m.write_text(str(n + 1))\n"
+        "time.sleep(0.4 if n == 0 else 0)\n"
+        "sys.exit(0)\n")
+    worlds = itertools.chain([4, 2], itertools.repeat(2))
+    res = DSElasticAgent(WorkerSpec(
+        cmd=[sys.executable, str(script)], ds_config={},
+        max_restarts=0, monitor_interval=0.05,
+        world_fn=lambda: next(worlds))).run()
+    assert res.succeeded and res.restarts == 0
+    assert res.world_sizes[:2] == [4, 2]  # relaunched under the new world
