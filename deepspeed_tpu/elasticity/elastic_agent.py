@@ -114,7 +114,15 @@ class DSElasticAgent:
                             f"elastic agent: world changed {world} -> "
                             f"{new_world}; relaunching")
                         proc.terminate()
-                        proc.wait(timeout=30)
+                        try:
+                            proc.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            # worker traps SIGTERM (checkpoint flush) or is
+                            # wedged — escalate rather than orphan it
+                            logger.warning(
+                                "elastic agent: worker ignored SIGTERM; killing")
+                            proc.kill()
+                            proc.wait()
                         membership_change = True
                         break
                 time.sleep(spec.monitor_interval)

@@ -19,10 +19,14 @@ def main(argv=None):
     p.add_argument("--exclude", default="")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
-    cmd = [c for c in args.command if c != "--"]
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":  # only the leading separator — a command may
+        cmd = cmd[1:]           # legitimately contain "--" (git checkout --)
     if not cmd:
         p.error("no command given (usage: dstpu_ssh [-H hostfile] -- cmd ...)")
     pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        p.error(f"hostfile not found or empty: {args.hostfile}")
     active = parse_inclusion_exclusion(pool, args.include, args.exclude)
     hosts = ",".join(active.keys())
     full = ["pdsh", "-w", hosts, " ".join(map(shlex.quote, cmd))]

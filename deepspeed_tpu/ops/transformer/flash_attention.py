@@ -119,51 +119,23 @@ def _fwd(q, k, v, *, causal, num_kv_groups, scale, block_q, block_k):
 # backward
 # ----------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_q, block_k, causal, scale):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :]  # bf16: MXU inputs stay in storage dtype
-    do = do_ref[0, 0, :, :]
-    lse = lse_ref[0, 0, :, 0]
-    delta = delta_ref[0, 0, :, 0]
-    skv = k_ref.shape[2]
-    hd = q.shape[-1]
-    q_start = qi * block_q
-
-    if causal:
-        num_kv = jnp.minimum((q_start + block_q + block_k - 1) // block_k,
-                             skv // block_k)
-    else:
-        num_kv = skv // block_k
-
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (BQ, BK) fp32
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-
-    dq = jax.lax.fori_loop(0, num_kv, body, jnp.zeros((block_q, hd), jnp.float32))
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, block_q, block_k, causal, scale):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, block_q, block_k, causal, scale):
+    """One pass producing dk/dv for this KV block AND accumulating this
+    block's dq contributions. The QK^T, exp and do·v^T work is computed once
+    instead of once per backward kernel; dq is a REVISITED fp32 output (same
+    block for every ki — TPU grids run sequentially, so the accumulator
+    stays resident in VMEM across the kv sweep)."""
     ki = pl.program_id(2)
     k = k_ref[0, 0, :, :]  # (BK, hd) bf16: MXU inputs stay in storage dtype
     v = v_ref[0, 0, :, :]
     sq = q_ref.shape[2]
     hd = k.shape[-1]
     k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _zero_dq():
+        dq_ref[0, 0, :, :] = jnp.zeros((sq, hd), jnp.float32)
 
     # first q block that can see this kv block
     start_q = (k_start // block_q) if causal else 0
@@ -189,6 +161,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
+        dq_blk = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        sl = pl.ds(i * block_q, block_q)
+        dq_ref[0, 0, sl, :] = dq_ref[0, 0, sl, :] + dq_blk
         return dk_new, dv_new
 
     init = (jnp.zeros((block_k, hd), jnp.float32), jnp.zeros((block_k, hd), jnp.float32))
@@ -205,26 +181,11 @@ def _bwd(causal, num_kv_groups, scale, block_q, block_k, res, do):
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[..., None]  # (B,nh,Sq,1)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          causal=causal, scale=scale),
-        grid=(B, nh, Sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
-            pl.BlockSpec((1, 1, Skv, hd), lambda b, h, i: (b, h // g, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-
-    # dk/dv per q-head, reduced over the GQA group below
-    dkh, dvh = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+    # ONE fused kernel: dk/dv per kv block + dq accumulated into a revisited
+    # fp32 output across the kv sweep (sequential TPU grid) — halves the
+    # QK^T/exp/do·v^T recompute of the former split dq / dkv kernels
+    dq, dkh, dvh = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, scale=scale),
         grid=(B, nh, Skv // block_k),
         in_specs=[
@@ -236,15 +197,18 @@ def _bwd(causal, num_kv_groups, scale, block_q, block_k, res, do):
             pl.BlockSpec((1, 1, Sq, 1), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=[
+            pl.BlockSpec((1, 1, Sq, hd), lambda b, h, i: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((B, nh, Sq, hd), jnp.float32),
             jax.ShapeDtypeStruct((B, nh, Skv, hd), q.dtype),
             jax.ShapeDtypeStruct((B, nh, Skv, hd), q.dtype),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
+    dq = dq.astype(q.dtype)
 
     if g > 1:
         dk = dkh.reshape(B, kvh, g, Skv, hd).astype(jnp.float32).sum(axis=2).astype(k.dtype)
@@ -308,10 +272,14 @@ def flash_attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
     block_k = fit(block_k, Skv)
     if block_q < 128 or block_k < 128 or hd not in (64, 128, 256):
         raise NotImplementedError("flash kernel: unsupported shape")
-    # K/V are streamed per (batch, head) grid cell from a full-length VMEM
-    # window; guard the window size (long-context should use ring attention)
-    if 2 * Skv * hd * k.dtype.itemsize > 12 * 1024 * 1024:
-        raise NotImplementedError("flash kernel: KV window exceeds VMEM budget")
+    # VMEM budget guard (long-context should use ring attention): the forward
+    # stages a full-length K/V window per grid cell; the fused backward
+    # additionally holds full-length q/do windows PLUS the revisited fp32 dq
+    # accumulator (Sq*hd*(2+2+4) bytes)
+    fwd_bytes = 2 * Skv * hd * k.dtype.itemsize
+    bwd_bytes = Sq * hd * 8 + 2 * 512 * hd * k.dtype.itemsize
+    if max(fwd_bytes, bwd_bytes) > 12 * 1024 * 1024:
+        raise NotImplementedError("flash kernel: VMEM window exceeds budget")
     scale = scale if scale is not None else hd ** -0.5
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))

@@ -31,6 +31,38 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             raise ValueError("hybrid engine requires a model with KV-cache decode "
                              "(TransformerLM protocol)")
         self._gen_fns = {}
+        self._lora = None  # (adapters, scale); set via set_lora
+        self._lora_fused = False
+
+    # ------------------------------------------------------------------
+    # LoRA fuse/unfuse (reference hybrid_engine.py:138-158): generation sees
+    # base+adapter as ONE weight; training resumes on the unfused base
+    # ------------------------------------------------------------------
+    def set_lora(self, adapters, scale: float):
+        """Attach LoRA adapters (e.g. from ``runtime.lora.init_lora``)."""
+        if self._lora_fused:
+            self.unfuse_lora_weight()
+        self._lora = (adapters, float(scale))
+
+    def fuse_lora_weight(self):
+        """Merge the adapters into ``self.params`` (reference ``:138``)."""
+        if self._lora is None or self._lora_fused:
+            return
+        from .lora import fuse_lora
+
+        adapters, scale = self._lora
+        self.params = fuse_lora(self.params, adapters, scale)
+        self._lora_fused = True
+
+    def unfuse_lora_weight(self):
+        """Subtract the adapters back out (reference ``:151``)."""
+        if self._lora is None or not self._lora_fused:
+            return
+        from .lora import unfuse_lora
+
+        adapters, scale = self._lora
+        self.params = unfuse_lora(self.params, adapters, scale)
+        self._lora_fused = False
 
     def _build_generate(self, S: int, max_new: int, temperature, top_k, top_p):
         model = self.module
@@ -61,8 +93,22 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,
                  seed: Optional[int] = None, **kwargs):
-        """Generate with the CURRENT training weights (reference ``generate:174``)."""
+        """Generate with the CURRENT training weights (reference ``generate:174``).
+        With LoRA attached, the adapters are fused for the generation and
+        unfused afterwards so training continues on the base weights."""
         input_ids = jnp.asarray(input_ids, jnp.int32)
+        fuse_here = self._lora is not None and not self._lora_fused
+        if fuse_here:
+            self.fuse_lora_weight()
+        try:
+            return self._generate_inner(input_ids, max_new_tokens, temperature,
+                                        top_k, top_p, eos_token_id, seed)
+        finally:
+            if fuse_here:
+                self.unfuse_lora_weight()
+
+    def _generate_inner(self, input_ids, max_new_tokens, temperature, top_k,
+                        top_p, eos_token_id, seed):
         key = (input_ids.shape[1], max_new_tokens, float(temperature), int(top_k),
                float(top_p))
         if key not in self._gen_fns:

@@ -1,0 +1,75 @@
+"""LoRA adapter fuse/unfuse for the hybrid (RLHF) engine.
+
+Reference: ``runtime/hybrid_engine.py:138-158`` — ``fuse_lora_weight()`` merges
+each LoRA pair into its base weight before generation (so the inference
+kernels see ONE matmul) and ``unfuse_lora_weight()`` subtracts it back out
+before training resumes; the adapters themselves come from the user's PEFT
+setup, the engine only owns the fuse/unfuse mechanics.
+
+TPU design: adapters are a pytree mirroring the targeted ``TransformerLM``
+block leaves — ``{leaf: {"a": (L, in, r), "b": (L, r, out)}}`` — and fusing is
+one jitted ``w + scale * a @ b`` per leaf. Unfused-state training composes the
+same einsum inside the loss (not provided here: the reference likewise leaves
+adapter training to the client); the engine guarantees generation always sees
+the fused view and training the unfused one.
+"""
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_up", "w_gate", "w_down")
+
+
+def init_lora(params, rank: int, *, rng, targets: Sequence[str] = DEFAULT_TARGETS,
+              alpha: float = 1.0) -> Tuple[Dict, float]:
+    """Zero-init LoRA adapters for the targeted block leaves.
+
+    Standard LoRA init: ``a`` gaussian, ``b`` zeros — fusing a fresh adapter
+    is the identity. Returns (adapters, scale) with scale = alpha / rank.
+    """
+    blocks = params["blocks"]
+    adapters: Dict = {}
+    keys = jax.random.split(rng, len(targets))
+    for k, name in zip(keys, targets):
+        if name not in blocks:
+            continue
+        w = blocks[name]
+        if w.ndim != 3:  # stacked (L, in, out) matmul leaves only
+            continue
+        L, fan_in, fan_out = w.shape
+        adapters[name] = {
+            "a": jax.random.normal(k, (L, fan_in, rank), w.dtype) * 0.02,
+            "b": jnp.zeros((L, rank, fan_out), w.dtype),
+        }
+    return adapters, alpha / rank
+
+
+def _delta(ad, dtype):
+    return (jnp.einsum("lir,lro->lio", ad["a"].astype(jnp.float32),
+                       ad["b"].astype(jnp.float32))).astype(dtype)
+
+
+@jax.jit
+def fuse_lora(params, adapters, scale):
+    """params with each targeted block leaf replaced by ``w + scale * a @ b``."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, ad in adapters.items():
+        w = blocks[name]
+        blocks[name] = w + scale * _delta(ad, w.dtype)
+    out["blocks"] = blocks
+    return out
+
+
+@jax.jit
+def unfuse_lora(params, adapters, scale):
+    """Inverse of :func:`fuse_lora` (exact up to one fp add/sub round trip)."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name, ad in adapters.items():
+        w = blocks[name]
+        blocks[name] = w - scale * _delta(ad, w.dtype)
+    out["blocks"] = blocks
+    return out

@@ -266,6 +266,51 @@ class TestHybridEngine:
         # with lr=1e-3 on random init the argmax shifts essentially always)
         assert out1.shape == out2.shape
 
+    def test_lora_fuse_unfuse(self):
+        """Reference hybrid_engine.py:138-158: generation sees base+adapter
+        fused into one weight; unfuse restores the base for training."""
+        topo_mod.reset_topology()
+        import deepspeed_tpu.comm as comm
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        from deepspeed_tpu.runtime.lora import fuse_lora, init_lora
+
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+        comm.init_distributed(mesh_config=cfg.mesh_config)
+        engine = DeepSpeedHybridEngine(tiny_model(), cfg)
+        base = jax.tree.map(lambda a: np.asarray(a), engine.params)
+        adapters, scale = init_lora(engine.params, rank=4,
+                                    rng=jax.random.PRNGKey(3))
+        # standard zero-B init: fusing is the identity
+        fused0 = fuse_lora(engine.params, adapters, scale)
+        for a, b in zip(jax.tree.leaves(fused0), jax.tree.leaves(engine.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # non-trivial adapters
+        adapters = jax.tree.map(
+            lambda a: a + 0.05 * jax.random.normal(
+                jax.random.PRNGKey(7), a.shape, a.dtype), adapters)
+        engine.set_lora(adapters, scale)
+        prompt = batch()["input_ids"][:2, :8]
+        out_base = np.asarray(engine._generate_inner(
+            jnp.asarray(prompt, jnp.int32), 4, 0.0, 0, 1.0, -1, 0))
+        out_lora = np.asarray(engine.generate(prompt, max_new_tokens=4,
+                                              temperature=0.0, seed=0))
+        # generation used the FUSED weights (differs from base) and the
+        # engine unfused afterwards (params restored)
+        assert not engine._lora_fused
+        for k, v in engine.params["blocks"].items():
+            np.testing.assert_allclose(np.asarray(v), base["blocks"][k],
+                                       rtol=2e-6, atol=2e-6)
+        engine.fuse_lora_weight()
+        manual = fuse_lora(jax.tree.map(jnp.asarray, base), adapters, scale)
+        for k, v in engine.params["blocks"].items():
+            np.testing.assert_allclose(np.asarray(v),
+                                       np.asarray(manual["blocks"][k]),
+                                       rtol=1e-6, atol=1e-6)
+        engine.unfuse_lora_weight()
+        assert out_base.shape == out_lora.shape
+
 
 class TestAutotuner:
     def test_search_picks_runnable_config(self):
