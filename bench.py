@@ -69,7 +69,11 @@ def main():
     seq = 1024
     # unrolled layers (no stacked-residual update-slice traffic) + "dots"
     # remat (saves matmul outputs AND the flash kernel's out/lse residuals)
-    # measured 203 ms/step vs 226 for scan+plain-dots on v5e
+    # measured 203 ms/step vs 226 for scan+plain-dots on v5e. Round-3 sweeps
+    # (see memory/tests/perf): dots_ln, bf16 moments, steps_per_execution,
+    # prescaled-q flash, fused-CE head — all neutral-to-negative on v5e; the
+    # step is at the practical floor for this model/precision (fwd flash at
+    # the hd=64 MXU half-rate bound, matmuls at 0.92 MFU, Adam HBM-bound).
     mk_cfg = lambda: gpt2_config(  # noqa: E731
         "350m", max_seq_len=seq, remat=True, remat_policy="dots",
         scan_layers=False)

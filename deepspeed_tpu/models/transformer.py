@@ -104,6 +104,7 @@ class TransformerConfig:
     # "dots_plain" save weight-side matmul outputs only (attention fwd reruns
     #              in the backward)
     # "dots_batch" save every matmul output incl. batch dims
+    # "dots_ln"    "dots" plus the per-layer LN outputs (no LN recompute)
     # "dots_elem"  "dots" plus LN/MLP-activation outputs (no recompute at all)
     # "dots_lean"  "dots" minus MLP up/gate outputs (recompute one matmul,
     #              biggest activation-memory saver)
@@ -720,6 +721,14 @@ class TransformerLM:
             "dots": policies.save_from_both_policies(
                 policies.dots_with_no_batch_dims_saveable,
                 policies.save_only_these_names("attn_out", "attn_lse"),
+            ),
+            # "dots" plus the two per-layer LN outputs (16 MB/layer at 350M
+            # shapes): backward no longer re-runs the mean/rsqrt/scale chain,
+            # at a fraction of dots_elem's activation footprint
+            "dots_ln": policies.save_from_both_policies(
+                policies.dots_with_no_batch_dims_saveable,
+                policies.save_only_these_names(
+                    "attn_out", "attn_lse", "ln_out"),
             ),
             # additionally keep LN and MLP-activation outputs: the backward
             # pass then recomputes nothing at all (more HBM, fewer VPU passes)

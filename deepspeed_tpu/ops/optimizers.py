@@ -22,8 +22,8 @@ import jax
 import jax.numpy as jnp
 
 
-def _tree_zeros_like(tree):
-    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), tree)
+def _tree_zeros_like(tree, dtype=jnp.float32):
+    return jax.tree.map(lambda p: jnp.zeros_like(p, dtype=dtype), tree)
 
 
 class OptState(NamedTuple):
@@ -63,7 +63,8 @@ class FusedAdam(Optimizer):
     decoupled weight decay exactly as the reference flag does)."""
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 bias_correction=True, adam_w_mode=True, amsgrad=False):
+                 bias_correction=True, adam_w_mode=True, amsgrad=False,
+                 moment_dtype=None):
         super().__init__(lr, weight_decay)
         if amsgrad:
             raise ValueError("FusedAdam does not support the AMSGrad variant (parity with reference)")
@@ -71,11 +72,17 @@ class FusedAdam(Optimizer):
         self.eps = eps
         self.bias_correction = bias_correction
         self.adam_w_mode = adam_w_mode
+        # precision-aware moments (Megatron-core --use-precision-aware-optimizer
+        # precedent): store exp_avg/exp_avg_sq in a reduced dtype, compute in
+        # fp32. None (default) keeps fp32 moments — reference FusedAdam parity.
+        # On HBM-bound steps this trims 4 of the ~10 optimizer bytes/param.
+        self.moment_dtype = jnp.dtype(moment_dtype) if moment_dtype else None
 
     def init(self, master_params) -> OptState:
+        md = self.moment_dtype or jnp.float32
         return OptState(step=jnp.zeros((), jnp.int32),
-                        m=_tree_zeros_like(master_params),
-                        v=_tree_zeros_like(master_params))
+                        m=_tree_zeros_like(master_params, md),
+                        v=_tree_zeros_like(master_params, md))
 
     def update(self, grads, state, master_params, lr, weight_decay_mask=None):
         b1, b2 = self.betas
@@ -87,17 +94,20 @@ class FusedAdam(Optimizer):
         else:
             bc1 = bc2 = 1.0
         wd = self._wd_tree(master_params, weight_decay_mask)
+        md = self.moment_dtype
 
         def upd(p, g, m, v, w):
             g = g.astype(jnp.float32)
             if not self.adam_w_mode:
                 g = g + w * p  # classic Adam: decay folded into the gradient
-            m_ = b1 * m + (1.0 - b1) * g
-            v_ = b2 * v + (1.0 - b2) * (g * g)
+            m_ = b1 * m.astype(jnp.float32) + (1.0 - b1) * g
+            v_ = b2 * v.astype(jnp.float32) + (1.0 - b2) * (g * g)
             denom = jnp.sqrt(v_ / bc2) + self.eps
             new_p = p - lr * (m_ / bc1) / denom
             if self.adam_w_mode:
                 new_p = new_p - lr * w * p
+            if md is not None:
+                m_, v_ = m_.astype(md), v_.astype(md)
             return new_p, m_, v_
 
         flat = jax.tree.map(upd, master_params, grads, state.m, state.v, wd)

@@ -39,8 +39,16 @@ def _flatten(state_dict):
         elif isinstance(obj, (int, float)):
             meta[path] = {"kind": "scalar", "value": obj}
         else:
-            flat[path] = np.asarray(jax.device_get(obj))
-            meta[path] = {"kind": "array"}
+            arr = np.asarray(jax.device_get(obj))
+            if arr.dtype.kind == "V" or arr.dtype.name not in np.sctypeDict:
+                # ml_dtypes arrays (bfloat16, float8_*) round-trip through npz
+                # as raw void bytes — store a uint view + the dtype name
+                meta[path] = {"kind": "array", "dtype": arr.dtype.name}
+                arr = arr.view({1: np.uint8, 2: np.uint16, 4: np.uint32}[
+                    arr.dtype.itemsize])
+            else:
+                meta[path] = {"kind": "array"}
+            flat[path] = arr
 
     walk(state_dict, "")
     return flat, meta
@@ -63,7 +71,12 @@ def _unflatten(flat, meta):
             return None
         if kind == "scalar":
             return info["value"]
-        return flat[path]
+        arr = flat[path]
+        if "dtype" in info:
+            import ml_dtypes
+
+            arr = arr.view(np.dtype(getattr(ml_dtypes, info["dtype"])))
+        return arr
 
     return build("")
 

@@ -235,6 +235,8 @@ class DeepSpeedConfig:
 
         # --- logging / profiling ---
         self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.steps_per_execution = int(pd.get(
+            C.STEPS_PER_EXECUTION, C.STEPS_PER_EXECUTION_DEFAULT))
         self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
         self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)

@@ -103,6 +103,12 @@ DISABLE_ALLGATHER_DEFAULT = False
 STEPS_PER_PRINT = "steps_per_print"
 STEPS_PER_PRINT_DEFAULT = 10
 
+# TPU-native extension (Keras `steps_per_execution` precedent): number of
+# optimizer steps executed inside ONE compiled program dispatch. Amortizes
+# per-dispatch host/runtime overhead; requires GAS=1 and bf16/fp32.
+STEPS_PER_EXECUTION = "steps_per_execution"
+STEPS_PER_EXECUTION_DEFAULT = 1
+
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 WALL_CLOCK_BREAKDOWN_DEFAULT = False
 

@@ -21,6 +21,7 @@ around a functional core:
   loss scaling from ``fp16/loss_scaler.py``.
 """
 
+import collections
 import os
 from typing import Any, Callable, Optional
 
@@ -528,6 +529,49 @@ class DeepSpeedEngine:
         else:
             self._fused_step_fn = None
 
+        # multi-step dispatch (`steps_per_execution`, Keras precedent): K
+        # optimizer steps as ONE compiled program — a lax.scan over the fused
+        # micro-step with the K batches stacked on a leading axis. Amortizes
+        # per-dispatch host/runtime overhead (~ms-scale on remote/tunneled
+        # device transports) across K steps. bf16/fp32 only: the fp16
+        # overflow-skip bookkeeping needs a host sync per step.
+        n_exec = cfg.steps_per_execution
+        if n_exec > 1 and cfg.fp16_enabled:
+            raise ValueError(
+                "steps_per_execution > 1 requires bf16/fp32: the fp16 "
+                "overflow-skip bookkeeping syncs the host every step")
+        if n_exec > 1 and cfg.gradient_accumulation_steps != 1:
+            raise ValueError(
+                "steps_per_execution > 1 requires gradient_accumulation_steps"
+                " == 1 (each scanned step is a full optimizer step)")
+        if opt is not None and n_exec > 1 and not cfg.fp16_enabled:
+            def multi_step(lp_params, master, opt_state, scaler_state,
+                           batches, step0, lrs):
+                def body(carry, xs):
+                    lp, mst, ost, scs = carry
+                    batch, i, lr = xs
+                    lp, mst, ost, scs, loss, gnorm, _ = fused_step(
+                        lp, mst, ost, scs, batch, step0 + i, lr)
+                    return (lp, mst, ost, scs), (loss, gnorm)
+
+                (lp, mst, ost, scs), (losses, gnorms) = jax.lax.scan(
+                    body, (lp_params, master, opt_state, scaler_state),
+                    (batches, jnp.arange(n_exec, dtype=jnp.int32), lrs))
+                return lp, mst, ost, scs, losses, gnorms
+
+            self._multi_step_fn = jax.jit(
+                multi_step,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(
+                    self._param_shardings,
+                    self._opt_shardings if mixed else None,
+                    None, None,
+                    self._replicated, self._replicated,
+                ),
+            )
+        else:
+            self._multi_step_fn = None
+
     # ------------------------------------------------------------------
     # explicit-collective (shard_map) gradient paths: 1-bit EF and ZeRO++ qgZ
     # ------------------------------------------------------------------
@@ -1029,9 +1073,18 @@ class DeepSpeedEngine:
                 and self._ltd_keep_now() is None
                 and not self._onebit_active() and not self._qgz_active()
                 and getattr(self, "_training", True)):
-            loss = self._fused_micro_step(next(it))
+            if self._multi_step_fn is not None:
+                loss = self._multi_exec_step(it)
+            else:
+                loss = self._fused_micro_step(next(it))
             self.tput_timer.stop(global_step=True)
             return loss
+        if self._multi_step_fn is not None and not getattr(self, "_warned_spe", False):
+            self._warned_spe = True
+            logger.warning(
+                "steps_per_execution > 1 is inactive this step: the engine is "
+                "on the unfused path (offload/compression/1-bit/qgZ/random-LTD "
+                "take per-step dispatches)")
         losses = []
         for _ in range(self.config.gradient_accumulation_steps):
             batch = next(it)
@@ -1041,6 +1094,78 @@ class DeepSpeedEngine:
         self.step()
         self.tput_timer.stop(global_step=True)
         return jnp.mean(jnp.stack(losses))
+
+    def _multi_exec_step(self, it):
+        """steps_per_execution path: every K-th call pulls K batches, stacks
+        them on a leading axis and dispatches ONE compiled program running K
+        full optimizer steps; the K per-step losses are queued and returned
+        one per call (device arrays — no host sync, so dispatch stays
+        pipelined). Counters/lr-scheduler advance K at dispatch time, so
+        ``global_steps``/``get_lr()`` move in K-sized jumps between
+        executions (documented `steps_per_execution` semantics)."""
+        queue = getattr(self, "_exec_queue", None)
+        if queue is None:
+            queue = self._exec_queue = collections.deque()
+        if not queue:
+            K = self.config.steps_per_execution
+            batches = []
+            for _ in range(K):
+                try:
+                    batches.append(self._inject_train_kwargs(next(it)))
+                except StopIteration:
+                    break
+            if not batches:
+                raise StopIteration
+            if len(batches) < K:
+                # iterator exhausted mid-window: run the tail as plain
+                # single-step dispatches instead of crashing after some
+                # optimizer steps already applied
+                for b in batches:
+                    queue.append(self._fused_micro_step(b))
+                return queue.popleft()
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+            stacked = self._shard_stacked_batch(stacked)
+            lrs = []
+            for _ in range(K):
+                lrs.append(self.get_lr()[0])
+                if self.lr_scheduler is not None:
+                    self.lr_scheduler.step()
+            step0 = jnp.asarray(self.micro_steps, jnp.int32)
+            (new_lp, new_master, new_opt, new_scaler, losses, gnorms) = \
+                self._multi_step_fn(
+                    self.params,
+                    self.master_params if self._mixed else None,
+                    self.opt_state, self.scaler_state, stacked, step0,
+                    jnp.asarray(lrs, jnp.float32),
+                )
+            self.params = new_lp
+            if self._mixed:
+                self.master_params = new_master
+            self.opt_state = new_opt
+            self.scaler_state = new_scaler
+            self.micro_steps += K
+            self.global_steps += K
+            self.global_samples += K * self.config.train_batch_size
+            self._last_global_norm = gnorms[-1]
+            self._step_telemetry(gnorms[-1])
+            for i in range(K):
+                queue.append(losses[i])
+        return queue.popleft()
+
+    def _shard_stacked_batch(self, stacked):
+        """Place a K-stacked batch: batch leaves shard over DP on dim 1 (dim 0
+        is the steps axis), everything else replicates."""
+        spec = batch_spec(self.topology)
+        stacked_sh = NamedSharding(
+            self.topology.mesh, PartitionSpec(None, *spec))
+
+        def put(x):
+            x = jnp.asarray(x)
+            if x.ndim >= 2 and x.shape[1] % self.topology.data_parallel_size == 0:
+                return jax.device_put(x, stacked_sh)
+            return jax.device_put(x, self._replicated)
+
+        return jax.tree.map(put, stacked)
 
     def _fused_micro_step(self, batch):
         """One fwd+bwd+optimizer step as a single compiled program (GAS=1 path)."""

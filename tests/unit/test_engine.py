@@ -256,3 +256,47 @@ def test_mesh_config_argument_honored():
         mesh_config={"model": 2},
     )
     assert engine.topology.model_parallel_size == 2
+
+
+def test_steps_per_execution_matches_single_step():
+    """`steps_per_execution` (multi-step scan dispatch) must reproduce the
+    per-step trajectory of the default path and keep counters in sync."""
+    losses = {}
+    for K in (1, 4):
+        model = make_simple_model(HIDDEN, seed=3)
+        cfg = base_config(
+            train_batch_size=8,
+            scheduler={"type": "WarmupLR", "params": {"warmup_num_steps": 4}},
+        )
+        if K > 1:
+            cfg["steps_per_execution"] = K
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        batches = [random_batch(batch_size=8, hidden_dim=HIDDEN, seed=s)
+                   for s in range(8)]
+
+        def it():
+            i = 0
+            while True:
+                yield batches[i % len(batches)]
+                i += 1
+
+        g = it()
+        losses[K] = [float(engine.train_batch(g)) for _ in range(8)]
+        assert engine.global_steps == 8
+    np.testing.assert_allclose(losses[1], losses[4], rtol=2e-4, atol=2e-5)
+
+
+def test_moment_dtype_bf16_trains():
+    """Precision-aware optimizer (bf16 moments, fp32 master/compute): state is
+    stored reduced, training still converges."""
+    model = make_simple_model(HIDDEN)
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "Adam",
+                        "params": {"lr": 1e-2, "moment_dtype": "bfloat16"}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    losses = train_steps(engine, steps=10)
+    assert losses[-1] < losses[0]
+    for leaf in jax.tree.leaves(engine.opt_state.m):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(engine.opt_state.v):
+        assert leaf.dtype == jnp.bfloat16
