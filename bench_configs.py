@@ -132,9 +132,14 @@ def bench_llama7b_zero3():
 
     # full 7B hidden/FFN/head geometry, 2 of 32 layers: the per-layer compute
     # and memory behavior (the thing the config tracks) is preserved; depth is
-    # cut so master+moments fit one 16 GB chip
+    # cut so master+moments fit one 16 GB chip. mb=2: the round-3 decomposition
+    # (tests/perf/breakdown_7b.py) showed the round-2 number (mfu 0.405) was a
+    # micro-batch artifact — fwd+bwd mfu is 0.70/0.77/0.83 at mb 1/2/4, and at
+    # mb=1 the fixed per-step Adam pass (666M params, HBM-bound) amortizes over
+    # only 2048 tokens. mb=4 is fastest but leaves <2 GB HBM headroom with the
+    # fp32 master+moments resident; mb=2 is the stable pick.
     L = 2
-    seq, mb = 2048, 1
+    seq, mb = 2048, 2
     cfg = llama_config("7b", num_layers=L, max_seq_len=seq, remat=True,
                        remat_policy="dots")
     tok_s, loss, step_s = _train_throughput(cfg, {
@@ -154,9 +159,14 @@ def bench_llama7b_zero3():
     return {
         "metric": "llama7b_zero3_remat_tokens_per_sec_per_chip",
         "value": round(tok_s / n, 1), "unit": "tokens/s/chip",
-        "vs_baseline": None,
+        "vs_baseline": round(mfu / 0.54, 3),
         "detail": {"standin": f"full 7B layer geometry, {L}/32 layers, seq "
                               f"{seq}, mb {mb}", "mfu": round(mfu, 4),
+                   "normalization": "vs_baseline = mfu / 0.54 (same Ulysses "
+                                    ">54%-of-peak basis as the headline)",
+                   "decomposition": "tests/perf/breakdown_7b.py: fwd+bwd mfu "
+                                    "0.70/0.77/0.83 at mb 1/2/4; Adam on 666M "
+                                    "params is the fixed per-step cost",
                    "final_loss": loss, "step_ms": round(step_s * 1000, 1)},
     }
 

@@ -1073,7 +1073,11 @@ class DeepSpeedEngine:
                 and self._ltd_keep_now() is None
                 and not self._onebit_active() and not self._qgz_active()
                 and getattr(self, "_training", True)):
-            if self._multi_step_fn is not None:
+            pld = self.config.progressive_layer_drop
+            if self._multi_step_fn is not None and not (
+                    pld and pld.get("enabled")):
+                # (PLD excluded: its per-step theta is computed host-side from
+                # global_steps, which would be stale for steps 2..K of a window)
                 loss = self._multi_exec_step(it)
             else:
                 loss = self._fused_micro_step(next(it))
@@ -1143,11 +1147,17 @@ class DeepSpeedEngine:
                 self.master_params = new_master
             self.opt_state = new_opt
             self.scaler_state = new_scaler
+            old_steps = self.global_steps
             self.micro_steps += K
             self.global_steps += K
             self.global_samples += K * self.config.train_batch_size
             self._last_global_norm = gnorms[-1]
-            self._step_telemetry(gnorms[-1])
+            # counters jump by K: emit telemetry when the print cadence was
+            # crossed ANYWHERE inside the window, not only on exact multiples
+            every = self.config.steps_per_print
+            self._step_telemetry(
+                gnorms[-1],
+                force=bool(every) and (old_steps // every != self.global_steps // every))
             for i in range(K):
                 queue.append(losses[i])
         return queue.popleft()
@@ -1200,10 +1210,12 @@ class DeepSpeedEngine:
         self.timers(STEP_MICRO_TIMER).stop()
         return loss
 
-    def _step_telemetry(self, gnorm):
-        """Print-cadence logging + monitor events (shared by all step paths)."""
+    def _step_telemetry(self, gnorm, force=False):
+        """Print-cadence logging + monitor events (shared by all step paths).
+        ``force`` fires the cadence actions regardless of the modulo — used by
+        the multi-step path whose counters advance in K-jumps."""
         every = self.config.steps_per_print
-        if every and self.global_steps % every == 0:
+        if every and (force or self.global_steps % every == 0):
             log_dist(
                 f"step={self.global_steps} lr={self.get_lr()} "
                 f"grad_norm={float(gnorm):.4f} skipped={self.skipped_steps}",
@@ -1211,7 +1223,7 @@ class DeepSpeedEngine:
             )
         if self.monitor.enabled and jax.process_index() == 0:
             # float() is a device sync — pay it only at the print cadence
-            if self.global_steps % max(1, every or 1) == 0:
+            if force or self.global_steps % max(1, every or 1) == 0:
                 self.monitor.write_events([
                     ("Train/Samples/lr", float(self.get_lr()[0]), self.global_samples),
                     ("Train/Samples/loss_scale", float(self.scaler_state.cur_scale),
