@@ -1,0 +1,180 @@
+"""Serving load test for InferenceEngineV2 (the FastGen-equivalent engine).
+
+Reference benchmark shape: ``blogs/deepspeed-fastgen/README.md:139,155`` —
+sustained mixed workload (Poisson arrivals, prompts + decodes interleaved),
+reporting effective throughput and per-token latency percentiles.
+
+Per run: requests arrive by a Poisson process; each brings a random-length
+prompt and decodes a random number of tokens (greedy). Finished sequences are
+flushed (eviction) and queued requests admitted when ``can_schedule`` says so
+(readmission). Two measurement phases per configuration:
+
+- throughput: no per-step host sync — steps pipeline; tokens/s = all generated
+  tokens / wall.
+- latency: one host sync per decode step; p50/p95 per-token latency over steps.
+
+``python bench_serve.py`` writes BENCH_SERVE.json and prints one JSON line per
+configuration. Compiled-program counts are recorded — the paged engine must
+hold at most TWO ragged programs (mixed-budget + decode-round shape)
+regardless of load — the fixed-shape design.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
+             prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False):
+    """Drive the engine with Poisson arrivals until all requests finish."""
+    import jax
+
+    vocab = engine.cfg.vocab_size
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n_requests))
+    prompts = [rng.integers(0, vocab, rng.integers(prompt_lo, prompt_hi + 1)).tolist()
+               for _ in range(n_requests)]
+    gen_targets = rng.integers(gen_lo, gen_hi + 1, n_requests)
+
+    queued: List[int] = list(range(n_requests))
+    live: Dict[int, int] = {}      # uid -> tokens still to generate
+    next_tok: Dict[int, int] = {}  # uid -> sampled token to feed next
+    generated = 0
+    step_lat: List[float] = []
+    step_sizes: List[int] = []
+    t_start = time.perf_counter()
+    sim_clock = 0.0
+
+    def admit():
+        while queued:
+            uid = queued[0]
+            if arrivals[uid] > sim_clock:
+                break
+            if not engine.can_schedule(1):
+                break
+            queued.pop(0)
+            lg = engine.put([uid], [prompts[uid]], greedy=engine.paged)
+            if uid in lg:
+                next_tok[uid] = int(lg[uid]) if engine.paged else int(np.argmax(lg[uid]))
+                live[uid] = int(gen_targets[uid])
+
+    while queued or live:
+        sim_clock = time.perf_counter() - t_start
+        # admit everything whose arrival time has passed (plus fast-forward
+        # when idle so the run is not wall-clock-bound by the arrival process)
+        if not live and queued:
+            sim_clock = max(sim_clock, arrivals[queued[0]])
+        admit()
+        if not live:
+            continue
+        t0 = time.perf_counter()
+        toks = {uid: next_tok[uid] for uid in live}
+        greedy = engine.paged  # on-device argmax: ship tokens, not logit rows
+        lgs = engine.decode_step(toks, greedy=greedy)
+        if sync_each_step:
+            step_lat.append(time.perf_counter() - t0)
+            step_sizes.append(len(toks))
+        for uid, lg in lgs.items():
+            next_tok[uid] = int(lg) if greedy else int(np.argmax(lg))
+            generated += 1
+            live[uid] -= 1
+            if live[uid] <= 0:
+                del live[uid]
+                del next_tok[uid]
+                engine.flush(uid)
+    # drain async work before stopping the clock
+    jax.block_until_ready(engine.kv)
+    wall = time.perf_counter() - t_start
+    out = {"generated_tokens": int(generated), "wall_s": round(wall, 2),
+           "tokens_per_s": round(generated / wall, 1)}
+    if step_lat:
+        per_tok = np.array(step_lat)  # decode-step latency == per-token latency
+        out["p50_token_ms"] = round(float(np.percentile(per_tok, 50)) * 1000, 2)
+        out["p95_token_ms"] = round(float(np.percentile(per_tok, 95)) * 1000, 2)
+        out["mean_batch"] = round(float(np.mean(step_sizes)), 1)
+    return out
+
+
+def run_config(mode: str, max_seqs: int) -> dict:
+    import logging
+
+    logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    cfg = gpt2_config("350m", max_seq_len=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(7)
+    eng = InferenceEngineV2(
+        model, params, max_seqs=max_seqs, max_seq_len=1024,
+        prefill_chunk=256, dtype=jnp.bfloat16, paged=(mode == "paged"),
+        block_size=64, token_budget=256 if mode == "paged" else 0,
+        # paged value proposition: the pool is sized for the WORKLOAD (≤320
+        # tokens/seq = 5 blocks), not max_seqs×max_ctx — 3.2× less KV memory
+        # than the slot layout at the same max_seqs
+        num_blocks=(1 + max_seqs * 5) if mode == "paged" else None)
+    # phase 1: pipelined throughput
+    tput = run_load(eng, n_requests=120, arrival_rate=200.0, rng=rng)
+    # phase 2: per-token latency (synced steps), fresh engine state
+    for uid in list(eng.state.seqs):
+        eng.flush(uid)
+    lat = run_load(eng, n_requests=60, arrival_rate=200.0, rng=rng,
+                   sync_each_step=True)
+    row = {
+        "metric": f"serve_{mode}_{max_seqs}seq_tokens_per_s",
+        "value": tput["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": {
+            "mode": mode, "max_seqs": max_seqs, "model": "gpt2-350m bf16",
+            "workload": "Poisson arrivals, prompts U[32,256], gen U[16,64]",
+            "throughput": tput, "latency": lat,
+            "compiled_programs": (
+                eng.ragged_cache_size if mode == "paged"
+                else len(eng._prefill_fns) + 1),
+        },
+    }
+    if mode == "paged":
+        # two fixed shapes ever: mixed-budget + decode-round (O(1) vs load)
+        assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
+    return row
+
+
+def main():
+    # one subprocess per configuration: device-memory frees are asynchronous
+    # through remote-device transports, so sequential engines in ONE process
+    # can OOM on buffers that are already logically freed
+    import subprocess
+    import sys
+
+    results = []
+    for mode, max_seqs in (("paged", 32), ("paged", 64), ("slot", 32)):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode, str(max_seqs)],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            row = {"metric": f"serve_{mode}_{max_seqs}seq_tokens_per_s",
+                   "error": proc.stderr[-400:]}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SERVE.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    import sys
+
+    if len(sys.argv) == 3:
+        print(json.dumps(run_config(sys.argv[1], int(sys.argv[2]))))
+    else:
+        main()

@@ -46,12 +46,17 @@ def _bucket(n: int, lo: int = 16) -> int:
 class InferenceEngineV2:
     """Continuous-batching engine over a ``TransformerLM``."""
 
-    def __init__(self, model, params=None, *, max_seqs: int = 8,
+    def __init__(self, model, params=None, *, max_seqs: Optional[int] = None,
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
                  num_blocks: Optional[int] = None, token_budget: int = 0):
         self.model = model
         self.cfg = model.config
+        # default serving width: paged mode shares one block pool so 32 slots
+        # cost little; the slot layout allocates max_seqs × max_ctx dedicated
+        # KV, so its default stays conservative
+        if max_seqs is None:
+            max_seqs = 32 if paged else 8
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len or model.config.max_seq_len
         self.prefill_chunk = prefill_chunk
@@ -59,8 +64,10 @@ class InferenceEngineV2:
         self.paged = paged
         # paged mode: every engine step is ONE compiled ragged forward over
         # exactly token_budget token-rows (prefill chunks and decodes mixed —
-        # reference engine_v2.py:107 put); the budget is the latency knob
-        self.token_budget = token_budget or max(max_seqs, min(prefill_chunk, 64))
+        # reference engine_v2.py:107 put); the budget is the latency knob.
+        # Default: enough rows for a full decode round plus prefill headroom
+        # (bench_serve.py load-tests at 256)
+        self.token_budget = token_budget or max(max_seqs, min(prefill_chunk, 256))
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
 
@@ -150,7 +157,7 @@ class InferenceEngineV2:
             )
             return logits[0], (new_kv[0][:, 0], new_kv[1][:, 0])
 
-        def decode(params, kv, toks, poss, active):
+        def decode(params, kv, toks, poss, active, greedy):
             k, v = kv
             lg, (nk, nv) = jax.vmap(one, in_axes=(None, ((1, 1)), 0, 0))(
                 params, (k, v), toks, poss
@@ -158,9 +165,12 @@ class InferenceEngineV2:
             mask = active[None, :, None, None, None]
             k = jnp.where(mask, nk.transpose(1, 0, 2, 3, 4), k)
             v = jnp.where(mask, nv.transpose(1, 0, 2, 3, 4), v)
+            if greedy:  # ship (B,) token ids, not (B, V) logits
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32), (k, v)
             return lg, (k, v)
 
-        self._decode_fn = jax.jit(decode, donate_argnums=(1,))
+        self._decode_fn = jax.jit(decode, donate_argnums=(1,),
+                                  static_argnums=(5,))
         return self._decode_fn
 
     def _get_ragged(self):
@@ -170,44 +180,66 @@ class InferenceEngineV2:
         prefill-chunk tokens and decode tokens mixed freely (the reference's
         ragged batch, ``engine_v2.py:107 put`` + ``ragged/ragged_wrapper.py``).
         A row carries its sequence's block table and its own position; padding
-        rows carry the all-zero table (trash block 0) and are ignored. One
-        shape → one compile, ever.
+        rows carry the all-zero table (trash block 0) and are ignored.
+
+        TWO fixed shapes per greedy mode, ever: the full-budget mixed program
+        and (when ``token_budget > max_seqs``) a ``max_seqs``-row decode
+        program — a pure decode round must not pay the prefill budget's
+        padded rows, which dominate steady-state serving latency. (A workload
+        mixing greedy and full-logit steps holds both variants of each shape:
+        ≤ 4 compiled traces, still O(1) in the load.)
         """
         if "ragged" in self._prefill_fns:
             return self._prefill_fns["ragged"]
         model = self.model
 
-        def ragged(params, pool, ids, tables, starts, logit_rows):
+        def ragged(params, pool, ids, tables, starts, logit_rows, greedy):
             # ids (T, 1): every row is its own length-1 "sequence" against the
             # shared pool; only the (max_seqs,) logit_rows are projected
             # through the vocab head (reference ragged_ops/logits_gather)
-            return model.forward_paged(params, ids, pool, tables, starts,
-                                       logit_rows=logit_rows)
+            lg, pool = model.forward_paged(params, ids, pool, tables, starts,
+                                           logit_rows=logit_rows)
+            if greedy:
+                # device-side greedy sampling: ship (R,) token ids instead of
+                # (R, V) fp32 logits — the host↔device transfer is the serving
+                # loop's latency floor on remote-device transports
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32), pool
+            return lg, pool
 
-        fn = jax.jit(ragged, donate_argnums=(1,))
+        fn = jax.jit(ragged, donate_argnums=(1,), static_argnums=(6,))
         self._prefill_fns["ragged"] = fn
         return fn
 
     @property
     def ragged_cache_size(self) -> int:
         """Number of compiled traces of the ragged-step program (tests assert
-        this stays 1 — the whole point of the fixed-shape design)."""
+        this stays <= 2: the mixed-budget shape + the decode-round shape —
+        fixed shapes, independent of load)."""
         fn = self._prefill_fns.get("ragged")
         return 0 if fn is None else fn._cache_size()
 
-    def _put_paged(self, out: Dict[int, np.ndarray]) -> None:
+    def _put_paged(self, out: Dict[int, np.ndarray], greedy: bool = False) -> None:
         """Drain all pending tokens through fixed-budget ragged steps.
 
         Scheduling policy (the token-budget scheduler the reference hides
         behind ``query``/``can_schedule``): sequences with the fewest pending
         tokens go first — live decodes (1 token) always beat prefill chunks,
         bounding decode latency under heavy prefill (split-fuse)."""
-        T = self.token_budget
         while True:
             work = [d for d in self.state.seqs.values() if d.in_flight > 0]
             if not work:
                 return
             work.sort(key=lambda d: (d.in_flight, d.slot))
+            # decode-round fast path: when every pending item is a single
+            # token and they fit in max_seqs rows, use the small compiled
+            # shape — steady-state decode must not pay the prefill budget's
+            # padded rows (second of the two fixed shapes, see _get_ragged)
+            if (self.token_budget > self.max_seqs
+                    and len(work) <= self.max_seqs
+                    and all(d.in_flight == 1 for d in work)):
+                T = self.max_seqs
+            else:
+                T = self.token_budget
             plan: List[Tuple] = []
             used = 0
             for d in work:
@@ -246,25 +278,31 @@ class InferenceEngineV2:
             fn = self._get_ragged()
             lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
                              jnp.asarray(tables), jnp.asarray(starts),
-                             jnp.asarray(logit_rows))
+                             jnp.asarray(logit_rows), greedy)
             lg = np.asarray(lg)
             for i, d in enumerate(finals):
-                out[d.uid] = lg[i]
+                out[d.uid] = int(lg[i]) if greedy else lg[i]
 
     # ------------------------------------------------------------------
     # reference surface
     # ------------------------------------------------------------------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
-            do_checks: bool = True) -> Dict[int, np.ndarray]:
+            do_checks: bool = True, greedy: bool = False) -> Dict[int, np.ndarray]:
         """Advance the engine one step with new/continuing requests
         (reference ``engine_v2.py:107``).
 
         For each uid: if new (or given fresh tokens), the tokens are prefilled
         (chunked); every live sequence then yields its next-token logits.
-        Returns {uid: (V,) numpy logits}.
+        Returns {uid: (V,) numpy logits} — or, with ``greedy=True`` (paged
+        mode), {uid: int token} sampled on-device (argmax), which avoids
+        shipping the full logit rows to the host.
         """
         if do_checks and len(batch_uids) > self.state.max_seqs:
             raise RuntimeError(f"batch of {len(batch_uids)} exceeds {self.state.max_seqs} slots")
+        if greedy and not self.paged:
+            raise ValueError(
+                "put(greedy=True) is paged-mode only (the slot prefill path "
+                "returns logits; decode_step supports greedy in both modes)")
         # 1. register / extend sequences
         for uid, toks in zip(batch_uids, batch_tokens):
             desc = self.state.get_or_create_sequence(uid)
@@ -274,7 +312,7 @@ class InferenceEngineV2:
         out: Dict[int, np.ndarray] = {}
         if self.paged:
             # single compiled ragged program over a fixed token budget
-            self._put_paged(out)
+            self._put_paged(out, greedy=greedy)
             return out
         # 2. slot mode: chunked prefill for pending prompt tokens (split-fuse:
         # bounded chunks, grouped by padded segment length). A sequence near
@@ -318,9 +356,11 @@ class InferenceEngineV2:
                         out[d.uid] = lg[i]
         return out
 
-    def decode_step(self, tokens: Dict[int, int]) -> Dict[int, np.ndarray]:
+    def decode_step(self, tokens: Dict[int, int],
+                    greedy: bool = False) -> Dict[int, np.ndarray]:
         """One continuous-batching decode step: feed each live uid its sampled
-        token, get next-token logits for all of them."""
+        token, get next-token logits for all of them (or, with
+        ``greedy=True``, the on-device argmax token per uid)."""
         if self.paged:
             # all-or-nothing validation BEFORE any state is touched (matches
             # slot mode): unknown uids KeyError rather than silently becoming
@@ -340,7 +380,7 @@ class InferenceEngineV2:
             # decode tokens ride the same compiled ragged program as prefill —
             # mixed arrivals and decodes in one step is the normal case
             uids = list(tokens)
-            return self.put(uids, [[tokens[u]] for u in uids])
+            return self.put(uids, [[tokens[u]] for u in uids], greedy=greedy)
         toks = np.zeros((self.max_seqs,), np.int32)
         poss = np.zeros((self.max_seqs,), np.int32)
         active = np.zeros((self.max_seqs,), bool)
@@ -363,10 +403,11 @@ class InferenceEngineV2:
             d.seen_tokens += 1
         lg, self.kv = self._get_decode()(
             self.params, self.kv, jnp.asarray(toks), jnp.asarray(poss),
-            jnp.asarray(active),
+            jnp.asarray(active), greedy,
         )
         lg = np.asarray(lg)
-        return {uid: lg[slot] for slot, uid in by_slot.items()}
+        return {uid: (int(lg[slot]) if greedy else lg[slot])
+                for slot, uid in by_slot.items()}
 
     def flush(self, uid: int):
         if self.paged and uid in self.state.seqs:

@@ -256,7 +256,10 @@ def flash_attention(q, k, v, *, causal=True, q_offset=0, num_kv_groups=1,
 
     Default 512-blocks: measured 1.5× faster than 128-blocks on v5e (the MXU
     starves below ~512×hd work per grid cell)."""
-    if bias is not None or (softcap and softcap > 0.0) or q_offset != 0:
+    if bias is not None or (softcap and softcap > 0.0) or (
+            not isinstance(q_offset, int)) or q_offset != 0:
+        # a TRACED q_offset (KV-cache decode under jit/vmap) must also fall
+        # back — comparing it would raise TracerBoolConversionError
         raise NotImplementedError("flash kernel: bias/softcap/q_offset unsupported")
     B, Sq, nh, hd = q.shape
     Skv = k.shape[1]

@@ -66,7 +66,134 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0, :, :] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q, k_pool, v_pool, tables, lens, *, scale=None):
+def _decode_kernel_stream(tables_ref, lens_ref, q_ref, kpool_ref, vpool_ref,
+                          o_ref, kbuf, vbuf, ksem, vsem, *, block_size, scale,
+                          pack):
+    """Grid (B, kvh): ONE cell per (sequence, kv head); the kernel itself
+    streams this sequence's ACTIVE pool blocks from HBM with double-buffered
+    DMA (prefetch j+1 while computing j). Versus the grid-per-block variant
+    this cuts grid cells by MAXB× and does work proportional to each
+    sequence's real length — the serving regime has mostly-short sequences
+    against a long max-context table.
+
+    ``pack``: Mosaic requires HBM DMA slices 128-lane-aligned; for hd=64 the
+    pool arrives viewed as (kvh, NB, BS/2, 128) — each buffer row holds two
+    interleaved tokens ([t_{2i} | t_{2i+1}]), and the kernel processes the
+    even/odd half-lanes as two sub-tiles of the same block."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    seq_len = lens_ref[b]
+    nblk = (seq_len + block_size - 1) // block_size
+    g = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # (g, hd)
+
+    def start(j, slot):
+        blk = tables_ref[b, j]
+        pltpu.make_async_copy(kpool_ref.at[h, blk], kbuf.at[slot],
+                              ksem.at[slot]).start()
+        pltpu.make_async_copy(vpool_ref.at[h, blk], vbuf.at[slot],
+                              vsem.at[slot]).start()
+
+    @pl.when(nblk > 0)
+    def _prologue():
+        start(0, 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            start(j + 1, 1 - slot)
+
+        blk = tables_ref[b, j]
+        pltpu.make_async_copy(kpool_ref.at[h, blk], kbuf.at[slot],
+                              ksem.at[slot]).wait()
+        pltpu.make_async_copy(vpool_ref.at[h, blk], vbuf.at[slot],
+                              vsem.at[slot]).wait()
+        kb = kbuf[slot].astype(jnp.float32)  # (BS, hd) or packed (BS/2, 2hd)
+        vb = vbuf[slot].astype(jnp.float32)
+        iota1 = jax.lax.broadcasted_iota
+
+        def online_update(carry, k, v, kpos):
+            m, l, acc = carry
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = jnp.where(kpos < seq_len, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        base = j * block_size
+        if pack:
+            # two interleaved sub-tiles of the block = two online updates
+            # (online softmax is associative over any partition of the keys)
+            half = iota1(jnp.int32, (q.shape[0], kb.shape[0]), 1)
+            carry = online_update((m, l, acc), kb[:, :hd], vb[:, :hd],
+                                  base + 2 * half)
+            return online_update(carry, kb[:, hd:], vb[:, hd:],
+                                 base + 2 * half + 1)
+        kpos = base + iota1(jnp.int32, (q.shape[0], kb.shape[0]), 1)
+        return online_update((m, l, acc), kb, vb, kpos)
+
+    m0 = jnp.full((g,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g,), jnp.float32)
+    acc0 = jnp.zeros((g, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0, :, :] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _paged_decode_stream(q, k_pool, v_pool, tables, lens, *, scale):
+    B, nh, hd = q.shape
+    kvh, NB, BS, _ = k_pool.shape
+    g = nh // kvh
+    qg = q.reshape(B, kvh, g, hd)
+    pack = hd < 128
+    if pack:
+        if BS % 2:
+            raise NotImplementedError("packed stream kernel needs even block_size")
+        # free view: two consecutive tokens side by side → 128-lane DMA slices
+        k_pool = k_pool.reshape(kvh, NB, BS // 2, 2 * hd)
+        v_pool = v_pool.reshape(kvh, NB, BS // 2, 2 * hd)
+    buf_shape = (2,) + k_pool.shape[2:]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lens
+        grid=(B, kvh),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd), lambda b, h, tables, lens: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # v pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda b, h, tables, lens: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM(buf_shape, k_pool.dtype),   # k double buffer
+            pltpu.VMEM(buf_shape, v_pool.dtype),   # v double buffer
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel_stream, block_size=BS, scale=scale,
+                          pack=pack),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, kvh, g, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(tables, lens, qg, k_pool, v_pool)
+    return out.reshape(B, nh, hd)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lens, *, scale=None,
+                           stream: bool = True):
     """One-token decode attention against a blocked KV pool.
 
     q: (B, nh, hd) — this step's query per sequence.
@@ -74,7 +201,18 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lens, *, scale=None):
     Mosaic-tileable (BS, hd) tile; tables: (B, MAXB) int32 pool block ids
     (0-padded); lens: (B,) int32 valid token counts (position + 1).
     Returns (B, nh, hd) in q's dtype.
+
+    ``stream=True`` (default) uses the (B, kvh)-grid kernel with an in-kernel
+    double-buffered DMA loop over only the ACTIVE blocks; ``stream=False``
+    keeps the (B, kvh, MAXB)-grid variant whose block fetch rides the
+    BlockSpec index map (one grid cell per table slot — simpler, but cell
+    count scales with max context rather than actual lengths).
     """
+    if stream:
+        B, nh, hd = q.shape
+        scale_v = scale if scale is not None else hd ** -0.5
+        return _paged_decode_stream(q, k_pool, v_pool, tables, lens,
+                                    scale=scale_v)
     B, nh, hd = q.shape
     kvh, NB, BS, _ = k_pool.shape
     MAXB = tables.shape[1]

@@ -27,8 +27,9 @@ def oracle(q, kp, vp, tables, lens):
     return out.reshape(B, nh, hd).astype(q.dtype)
 
 
+@pytest.mark.parametrize("stream", [True, False])  # DMA-loop vs grid-per-block
 @pytest.mark.parametrize("kvh,nh", [(4, 4), (2, 8), (1, 8)])  # MHA, GQA, MQA
-def test_paged_decode_matches_oracle(kvh, nh):
+def test_paged_decode_matches_oracle(kvh, nh, stream):
     B, hd, BS, MAXB = 3, 64, 16, 5
     NB = 1 + B * MAXB
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -42,7 +43,8 @@ def test_paged_decode_matches_oracle(kvh, nh):
         for j in range(-(-int(lens[b]) // BS)):
             tables[b, j] = nxt
             nxt += 1
-    out = paged_decode_attention(q, kp, vp, jnp.asarray(tables), lens)
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(tables), lens,
+                                 stream=stream)
     ref = oracle(q, kp, vp, jnp.asarray(tables), lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -97,3 +99,26 @@ def test_engine_kernel_path_matches_xla_path(monkeypatch):
     ker = run(True)
     for a, b in zip(ker, xla):
         np.testing.assert_allclose(a, b, atol=3e-5)
+
+
+def test_paged_decode_long_context_8k():
+    """ctx >= 8k stays on the Pallas path: the kernel streams one pool block
+    per grid step (no VMEM window over the whole context), so an 8192-token
+    table-addressed sequence must match the oracle with no fallback."""
+    B, nh, kvh, hd, BS = 2, 4, 4, 64, 512
+    MAXB = 16  # 16 x 512 = 8192-token logical context
+    NB = 1 + B * MAXB
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, nh, hd))
+    kp = jax.random.normal(ks[1], (kvh, NB, BS, hd))
+    vp = jax.random.normal(ks[2], (kvh, NB, BS, hd))
+    lens = jnp.asarray([8192, 5000], jnp.int32)
+    tables = np.zeros((B, MAXB), np.int32)
+    nxt = 1
+    for b in range(B):
+        for j in range(-(-int(lens[b]) // BS)):
+            tables[b, j] = nxt
+            nxt += 1
+    out = paged_decode_attention(q, kp, vp, jnp.asarray(tables), lens)
+    ref = oracle(q, kp, vp, jnp.asarray(tables), lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
