@@ -129,6 +129,32 @@ def main(args=None):
     env = os.environ.copy()
     cmd = [sys.executable, "-u", args.user_script] + args.user_args
 
+    if args.launcher == "local" and args.num_nodes > 1:
+        # N processes on THIS host with a real jax.distributed rendezvous —
+        # the reference test harness's forked multi-proc world
+        # (tests/unit/common.py:259 sets RANK/WORLD_SIZE per fork); used by
+        # the in-repo two-process integration test and for debugging
+        # multi-controller semantics without a pod
+        procs = []
+        master_addr = args.master_addr or "127.0.0.1"
+        for i in range(args.num_nodes):
+            penv = env.copy()
+            penv["DSTPU_NUM_PROCESSES"] = str(args.num_nodes)
+            penv["DSTPU_PROCESS_ID"] = str(i)
+            penv["COORDINATOR_ADDRESS"] = f"{master_addr}:{args.master_port}"
+            logger.info(f"launching local process {i}/{args.num_nodes}")
+            procs.append(subprocess.Popen(cmd, env=penv))
+        rc = 0
+        try:
+            for p in procs:
+                rc |= p.wait()
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGINT)
+            for p in procs:
+                p.wait()
+        sys.exit(rc)
+
     if not resource_pool or len(resource_pool) == 1:
         # single-host: exec in place, one controller process for all local chips
         env.setdefault("DSTPU_NUM_PROCESSES", "1")

@@ -66,7 +66,8 @@ class PipelineModule:
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  loss_fn: Optional[Callable] = None, topology=None,
                  partition_method: str = "uniform",
-                 activation_checkpoint_interval: int = 0):
+                 activation_checkpoint_interval: int = 0,
+                 example_input=None):
         self.specs = list(layers)
         topo = topology or get_topology()
         self.topology = topo
@@ -81,6 +82,7 @@ class PipelineModule:
         self._tied_idx = {i: s.name for i, s in enumerate(self.specs)
                           if isinstance(s, TiedLayerSpec)}
         self._heterogeneous = bool(self._tied_idx) or partition_method != "uniform"
+        self._plan = None
         if not self._heterogeneous:
             try:
                 shapes = [jax.eval_shape(lyr.init_params, jax.random.PRNGKey(0))
@@ -104,6 +106,68 @@ class PipelineModule:
                 f"{len(self.specs)} layers not divisible by {self.num_stages} "
                 "stages (use partition_method='parameters' for unequal stages)"
             )
+        if self._heterogeneous and example_input is not None:
+            # stage assignment needs the activation shape chain; with an
+            # example input available at construction, middle-layer params can
+            # be flat-packed per stage and SHARDED over the pipe axis (each
+            # stage holds ≈ its own share instead of the full model —
+            # reference _partition_layers memory behavior). Without it, the
+            # fully-replicated functional mode is used.
+            self._plan = self._make_plan(example_input)
+
+    # ------------------------------------------------------------------
+    # stage-sharded heterogeneous packing
+    # ------------------------------------------------------------------
+    def _shape_params(self, i):
+        return jax.eval_shape(self._built[i].init_params, jax.random.PRNGKey(0))
+
+    def _make_plan(self, example_input):
+        """Static packing plan: per-stage flat rows (one buffer per dtype);
+        every untied MIDDLE layer's leaves get (dtype, start, shape) slots in
+        its owner stage's row. Prefix/suffix/tied layers stay replicated (the
+        SPMD body computes them on every stage, gated)."""
+        if not isinstance(example_input, (jax.ShapeDtypeStruct,)):
+            example_input = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a)),
+                example_input)
+        p_end, q_start, ranges = self._analyze_shapes(example_input)
+        stage_of = {}
+        for k, (lo, hi) in enumerate(ranges):
+            for i in range(lo, hi):
+                stage_of[i] = k
+        cursor = [dict() for _ in range(self.num_stages)]  # dtype -> next elem
+        offsets: Dict[int, list] = {}
+        treedefs: Dict[int, Any] = {}
+        for i in range(p_end, q_start):
+            if i in self._tied_idx:
+                continue
+            leaves, treedef = jax.tree.flatten(self._shape_params(i))
+            k = stage_of[i]
+            slots = []
+            for leaf in leaves:
+                dt = str(jnp.dtype(leaf.dtype))
+                start = cursor[k].get(dt, 0)
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                cursor[k][dt] = start + size
+                slots.append((dt, start, tuple(leaf.shape)))
+            offsets[i] = slots
+            treedefs[i] = treedef
+        max_elems = {}
+        for c in cursor:
+            for dt, n in c.items():
+                max_elems[dt] = max(max_elems.get(dt, 0), n)
+        return {"p_end": p_end, "q_start": q_start, "ranges": ranges,
+                "stage_of": stage_of, "offsets": offsets,
+                "treedefs": treedefs, "max_elems": max_elems}
+
+    def _unpack_layer(self, flat_local, i):
+        """Rebuild layer ``i``'s param tree from a stage's local flat row(s).
+        On non-owner stages the slices read other layers' values — harmless:
+        the per-layer ownership gate zeroes their outputs AND cotangents."""
+        plan = self._plan
+        leaves = [flat_local[dt][start:start + int(np.prod(shape) or 1)].reshape(shape)
+                  for dt, start, shape in plan["offsets"][i]]
+        return jax.tree.unflatten(plan["treedefs"][i], leaves)
 
     # ------------------------------------------------------------------
     def init_params(self, rng):
@@ -111,13 +175,28 @@ class PipelineModule:
         keys = jax.random.split(rng, L)
         if self._heterogeneous:
             params = {"layers": {}, "tied": {}}
+            packed = set(self._plan["offsets"]) if self._plan else set()
+            rows = {}
+            if self._plan:
+                rows = {dt: np.zeros((self.num_stages, n), dtype=dt)
+                        for dt, n in self._plan["max_elems"].items()}
             for i, (lyr, k) in enumerate(zip(self._built, keys)):
                 name = self._tied_idx.get(i)
                 if name is not None:
                     if name not in params["tied"]:
                         params["tied"][name] = lyr.init_params(k)
+                elif i in packed:
+                    sk = self._plan["stage_of"][i]
+                    leaves = jax.tree.leaves(lyr.init_params(k))
+                    for leaf, (dt, start, shape) in zip(
+                            leaves, self._plan["offsets"][i]):
+                        size = int(np.prod(shape) or 1)
+                        rows[dt][sk, start:start + size] = np.asarray(
+                            leaf, dtype=dt).ravel()
                 else:
                     params["layers"][f"l{i}"] = lyr.init_params(k)
+            if self._plan:
+                params["stages"] = {dt: jnp.asarray(a) for dt, a in rows.items()}
             return params
         per_layer = [lyr.init_params(k) for lyr, k in zip(self._built, keys)]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
@@ -131,10 +210,15 @@ class PipelineModule:
     def tp_specs(self):
         dummy = jax.eval_shape(lambda: self.init_params(jax.random.PRNGKey(0)))
         if self._heterogeneous:
-            # per-stage structures differ, so every leaf is replicated (the
-            # lax.switch branches read the full tree); tied leaves must be
-            # replicated for the transpose-psum to realize ReduceTiedGrads
-            return jax.tree.map(lambda a: P(*([None] * a.ndim)), dummy)
+            # tied/prefix/suffix leaves replicate (every stage computes them,
+            # gated; the transpose-psum realizes ReduceTiedGrads); the packed
+            # middle rows — when an example_input enabled the plan — shard
+            # over the pipe axis so each stage holds ≈ its own share
+            specs = jax.tree.map(lambda a: P(*([None] * a.ndim)), dummy)
+            if self._plan:
+                specs["stages"] = jax.tree.map(
+                    lambda a: P("pipe", None), dummy["stages"])
+            return specs
 
         def spec_of(a):
             return P("pipe", *([None] * (a.ndim - 1)))
@@ -153,11 +237,24 @@ class PipelineModule:
         Returns ``(prefix_end, suffix_start, stage_ranges)`` — layers
         [0, prefix_end) are the ingest prefix, [suffix_start, n) the head
         suffix, and stage_ranges partitions [prefix_end, suffix_start)."""
+        return self._analyze_shapes(
+            inputs_mb, get_params=lambda i: self._layer_params(params, i))
+
+    def _analyze_shapes(self, inputs_mb, get_params=None):
+        if get_params is None:
+            tied_first = {}
+            for i, name in self._tied_idx.items():
+                tied_first.setdefault(name, i)
+
+            def get_params(i):
+                name = self._tied_idx.get(i)
+                j = i if name is None else tied_first[name]
+                return self._shape_params(j)
         n = len(self._built)
         cur = jax.eval_shape(lambda x: x, inputs_mb)
         chain = [cur]
         for i, lyr in enumerate(self._built):
-            cur = jax.eval_shape(lyr.apply, self._layer_params(params, i), cur)
+            cur = jax.eval_shape(lyr.apply, get_params(i), cur)
             chain.append(cur)
 
         def sig(s):
@@ -191,7 +288,7 @@ class PipelineModule:
             counts = []
             for i in middle:
                 leaves = jax.tree.leaves(jax.eval_shape(
-                    lambda i=i: self._layer_params(params, i)))
+                    lambda i=i: get_params(i)))
                 counts.append(sum(int(np.prod(l.shape)) for l in leaves))
             total = float(sum(counts)) or 1.0
             prefix = np.cumsum([0] + counts)  # len m+1
@@ -249,13 +346,25 @@ class PipelineModule:
         return loss
 
     def _apply_heterogeneous(self, params, inputs, labels):
-        """Arbitrary LayerSpec lists (+ TiedLayerSpec): every stage holds the
-        full replicated param tree and runs its own layer segment via
-        per-layer ownership gating — the functional memory/compute tradeoff
-        for non-uniform stacks (the homogeneous path keeps stage-sharded
-        params and is the performance mode)."""
+        """Arbitrary LayerSpec lists (+ TiedLayerSpec), two storage modes:
+
+        - plan (constructed with ``example_input``): untied middle layers'
+          params live flat-packed in per-stage rows SHARDED over the pipe axis
+          (each stage holds ≈ its share — reference ``_partition_layers``
+          memory behavior); tied/prefix/suffix replicate and their cotangents
+          psum across the pipe axis (ReduceTiedGrads).
+        - no plan: everything replicated — the always-available functional
+          fallback.
+
+        Compute uses per-layer ownership gating either way (every stage traces
+        all middle layers; non-owned outputs AND their cotangents are gated to
+        zero) — the homogeneous stacked path remains the performance mode."""
         mb0 = jax.eval_shape(lambda a: a[0], inputs)
-        p_end, q_start, ranges = self._analyze(params, mb0)
+        if self._plan:
+            p_end, q_start = self._plan["p_end"], self._plan["q_start"]
+            ranges = self._plan["ranges"]
+        else:
+            p_end, q_start, ranges = self._analyze(params, mb0)
 
         def run_range(pp, h, lo, hi):
             for i in range(lo, hi):
@@ -270,17 +379,27 @@ class PipelineModule:
             for i in range(lo, hi):
                 stage_of[i] = k
 
-        def stage_fn(pp, state, feed_t, rng_t):
+        plan = self._plan
+
+        def middle_params(seg, pp, i):
+            if plan and i in plan["offsets"]:
+                return self._unpack_layer(seg, i)
+            return self._layer_params(pp, i)
+
+        def stage_fn(seg_pp, state, feed_t, rng_t):
             # per-layer gating instead of lax.switch (switch inside the
             # pipeline scan transpose crashes XLA's CPU backend): every stage
             # applies only its own layers, passing the state through
-            # elsewhere. Non-owned layers still trace, so the het path trades
-            # compute for arbitrary per-stage structures — the homogeneous
-            # stacked path remains the performance mode.
+            # elsewhere.
+            if plan:
+                # (local flat rows already unwrapped to (E,), replicated rest)
+                seg, pp = seg_pp
+            else:
+                seg, pp = None, seg_pp
             sid = jax.lax.axis_index("pipe")
             h = state
             for i in range(p_end, q_start):
-                y = self._built[i].apply(self._layer_params(pp, i), h)
+                y = self._built[i].apply(middle_params(seg, pp, i), h)
                 own = (sid == stage_of[i])
                 h = jax.tree.map(
                     lambda a, b: jnp.where(own, a, b), y, h)
@@ -297,6 +416,7 @@ class PipelineModule:
         loss, _ = spmd_pipeline(
             first_fn, stage_fn, last_fn, params, (inputs, labels),
             mesh=self.topology.mesh, num_micro=self.num_micro, remat=False,
+            pass_full_params=bool(plan),
         )
         return loss
 

@@ -39,6 +39,7 @@ def spmd_pipeline(
     axis: str = "pipe",
     remat: bool = True,
     rng=None,
+    pass_full_params: bool = False,
 ):
     """Run a pipelined forward over ``num_micro`` microbatches.
 
@@ -114,6 +115,10 @@ def spmd_pipeline(
             if rng is not None:
                 rng_t = jax.random.fold_in(jax.random.fold_in(rng, t), sid)
             seg_params = stages_local if stages_local is not None else params
+            if pass_full_params:
+                # stage-sharded heterogeneous pipelines need both: the local
+                # flat-packed stage row AND the replicated rest (tied/prefix)
+                seg_params = (stages_local, params)
             y, aux = stage_fn(seg_params, x_in, feed_at(here_idx), rng_t)
             # validity of the microbatch currently at this stage: mb = t - sid
             valid_here = (t - sid >= 0) & (t - sid < M)

@@ -40,7 +40,8 @@ class StreamedParamStore:
         self.device = device
         self.compute_dtype = compute_dtype
         self.shardings = shardings  # optional list of per-group sharding pytrees
-        self._pending: Dict[int, tuple] = {}  # gi -> (buf, request_id)
+        self._pending: Dict[int, tuple] = {}  # gi -> (buf, request_id) reads
+        self._wpending: Dict[int, tuple] = {}  # gi -> (buf, request_id) writes
         self._live = 0
         self.max_live_groups = 0  # peak simultaneously-fetched groups (tests)
         self._np_dtype = np.dtype(jnp.dtype(compute_dtype).name) \
@@ -75,13 +76,35 @@ class StreamedParamStore:
 
     def writeback(self, gi: int, wait: bool = True):
         """NVMe mode: rewrite a group's compute-dtype file after its master
-        was updated by the optimizer sweep. No-op in cpu mode."""
+        was updated by the optimizer sweep. No-op in cpu mode.
+
+        ``wait=False`` queues the write asynchronously (the reference's
+        ``pipelined_optimizer_swapper`` double-buffering): the write buffer is
+        held alive and the next read of the SAME group first drains the
+        pending write — other groups' reads and the next step's compute
+        overlap the I/O."""
         if self._aio is None:
             return
+        self._drain_write(gi)
         buf = np.ascontiguousarray(self._flat_cast(gi))
         rid = self._aio.pwrite(self._paths[gi], buf)
         if wait:
             self._aio.wait(rid)
+        else:
+            self._wpending[gi] = (buf, rid)
+            # true double buffer: cap in-flight writes so queued buffers don't
+            # pin a full compute-dtype model copy in host RAM
+            while len(self._wpending) > 2:
+                self._drain_write(next(iter(self._wpending)))
+
+    def _drain_write(self, gi: int):
+        if getattr(self, "_wpending", None) and gi in self._wpending:
+            _, rid = self._wpending.pop(gi)
+            assert self._aio.wait(rid) == 0, f"NVMe writeback failed (group {gi})"
+
+    @property
+    def writes_in_flight(self) -> int:
+        return len(getattr(self, "_wpending", {}) or {})
 
     def prefetch(self, gi: int):
         """Issue the read-ahead for group ``gi`` (nvme: AIO pread; cpu: no-op —
@@ -90,6 +113,7 @@ class StreamedParamStore:
             return
         if not 0 <= gi < len(self.groups):
             return
+        self._drain_write(gi)  # a queued async writeback must land first
         total = sum(s for _, _, s in self._meta[gi])
         buf = np.empty((total,), self._np_dtype)
         rid = self._aio.pread(self._paths[gi], buf)

@@ -17,8 +17,13 @@ resident and are updated by the C++ CPUAdam sweep (``OffloadedAdamState``),
 i.e. the parameter tier composes with — and subsumes — the optimizer tier.
 
 Scope: ``TransformerLM`` dense models (no MoE/PLD/LTD), bf16 or fp32 compute,
-fp16 loss scaling unsupported. Checkpointing via ``state_dict``/
-``load_state_dict`` on the host masters.
+fp16 loss scaling unsupported. GAS > 1 accumulates gradients host-side
+(resident-engine mean semantics); dropout runs with a streamed-engine rng
+stream (fold_in(seed, micro_step, layer) — a valid dropout pattern, but a
+DIFFERENT stream than the resident engine's, so dropout trajectories are not
+bit-comparable across engines); data-parallel meshes shard the batch over
+'data' with GSPMD psum-ing the parameter grads. Checkpointing via
+``state_dict``/``load_state_dict`` on the host masters.
 """
 
 from typing import Any, Dict, List, Optional
@@ -48,16 +53,6 @@ class StreamedZeroEngine:
                 "(no MoE / PLD / random-LTD)")
         if config.fp16_enabled:
             raise ValueError("offload_param streaming: use bf16 or fp32, not fp16")
-        if mcfg.dropout > 0:
-            raise ValueError(
-                "offload_param streaming does not support dropout (the "
-                "per-layer programs run rng-free; it would silently differ "
-                "from the resident engine)")
-        if config.gradient_accumulation_steps > 1:
-            raise ValueError(
-                "offload_param streaming runs one optimizer step per "
-                "micro-batch; gradient_accumulation_steps > 1 is not "
-                "supported (it would silently change the effective batch)")
         self.model = model
         self.config = config
         self.lr_scheduler = lr_scheduler
@@ -66,6 +61,27 @@ class StreamedZeroEngine:
         self.compute_dtype = jnp.bfloat16 if config.bfloat16_enabled else jnp.float32
         self.global_steps = 0
         self.global_samples = 0
+        self.micro_steps = 0
+
+        # mesh composition: with a data axis > 1, the per-layer programs run
+        # under GSPMD — batch sharded over 'data', weights replicated; the
+        # parameter-gradient outputs are marked replicated so GSPMD inserts
+        # the psum (the distributed ZeRO-3 grad reduction of the reference's
+        # swapped tier). Host masters/moments stay whole per controller.
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ...comm.topology import get_topology
+
+        topo = get_topology(required=False)
+        # only an EXPLICIT mesh request turns on the dp path (the default
+        # topology spreads over every local device, which a single-controller
+        # param-tier run on a laptop/test mesh should not silently shard over)
+        self._dp = (topo.data_parallel_size
+                    if topo is not None and config.mesh_config.data > 0 else 1)
+        if self._dp > 1:
+            self._bsh = NamedSharding(topo.mesh, PartitionSpec("data"))
+            self._repl = NamedSharding(topo.mesh, PartitionSpec())
+        else:
+            self._bsh = self._repl = None
 
         off = config.zero_config.offload_param
         opt_off = config.zero_config.offload_optimizer
@@ -143,16 +159,18 @@ class StreamedZeroEngine:
         def pos(B, S):
             return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
+        use_rng = model.config.dropout > 0
+
         def embed(stem, ids):
             return model._embed(stem, ids, pos(*ids.shape), self.compute_dtype)
 
-        def block(blk, x):
+        def block(blk, x, rng):
             y, _, _ = model._block(x, blk, positions=pos(x.shape[0], x.shape[1]),
-                                   rng=None, train=True)
+                                   rng=rng if use_rng else None, train=True)
             return y
 
-        def block_vjp(blk, x, dy):
-            _, pull = jax.vjp(block, blk, x)
+        def block_vjp(blk, x, dy, rng):
+            _, pull = jax.vjp(lambda b, h: block(b, h, rng), blk, x)
             dblk, dx = pull(dy)
             return dx, dblk
 
@@ -176,24 +194,42 @@ class StreamedZeroEngine:
             (dstem,) = pull(dx0)
             return dstem
 
-        progs = {
-            "embed": jax.jit(embed),
-            "block": jax.jit(block),
-            "block_vjp": jax.jit(block_vjp),
-            "head_grad": jax.jit(head_grad),
-            "embed_vjp": jax.jit(embed_vjp),
-        }
+        if self._bsh is None:
+            progs = {
+                "embed": jax.jit(embed),
+                "block": jax.jit(block),
+                "block_vjp": jax.jit(block_vjp),
+                "head_grad": jax.jit(head_grad),
+                "embed_vjp": jax.jit(embed_vjp),
+            }
+        else:
+            # dp composition: batch/activations shard over 'data'; weights
+            # replicate; replicated grad outputs make GSPMD psum them
+            b, r = self._bsh, self._repl
+            progs = {
+                "embed": jax.jit(embed, in_shardings=(r, b), out_shardings=b),
+                "block": jax.jit(block, in_shardings=(r, b, r), out_shardings=b),
+                "block_vjp": jax.jit(block_vjp, in_shardings=(r, b, b, r),
+                                     out_shardings=(b, r)),
+                "head_grad": jax.jit(head_grad, in_shardings=(r, b, b),
+                                     out_shardings=(r, b, r)),
+                "embed_vjp": jax.jit(embed_vjp, in_shardings=(r, b, b),
+                                     out_shardings=r),
+            }
         self._jit_cache[key] = progs
         return progs
 
     # ------------------------------------------------------------------
-    def train_batch(self, data_iter=None):
-        batch = next(data_iter) if data_iter is not None else None
-        ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        ids = jnp.asarray(ids, jnp.int32)
+    def _micro_fwd_bwd(self, ids, rng_base):
+        """One streamed fwd+bwd; returns (loss, flat grad list np.float32)."""
         B, S = ids.shape
         progs = self._programs(B, S)
         L = self.L
+        if self._bsh is not None:
+            ids = jax.device_put(ids, self._bsh)
+
+        def layer_rng(i):
+            return jax.random.fold_in(rng_base, i)
 
         stem = self.store.get(0)
         x = progs["embed"](stem, ids)
@@ -202,7 +238,7 @@ class StreamedZeroEngine:
         for i in range(L):
             w = self.store.get(1 + i)
             self.store.prefetch(2 + i)
-            x = progs["block"](w, x)
+            x = progs["block"](w, x, layer_rng(i))
             xs.append(x)
             self.store.release()  # layer weights retire after the fwd
         loss, dx, dstem_h = progs["head_grad"](stem, xs[L], ids)
@@ -212,7 +248,7 @@ class StreamedZeroEngine:
             w = self.store.get(1 + i)
             if i > 0:
                 self.store.prefetch(i)  # read-ahead: layer i-1's weights
-            dx, dblk = progs["block_vjp"](w, xs[i], dx)
+            dx, dblk = progs["block_vjp"](w, xs[i], dx, layer_rng(i))
             grads[1 + i] = {k: np.asarray(v, np.float32)
                             for k, v in dblk.items()}
             xs[i + 1] = None  # retire the activation stash as we go
@@ -222,8 +258,39 @@ class StreamedZeroEngine:
                              + b.astype(jnp.float32), dstem_h, dstem_e)
         grads[0] = {k: np.asarray(v, np.float32) for k, v in dstem.items()}
         self.store.release()  # stem
+        return loss, [g[k] for g in grads for k in sorted(g)]
 
-        flat_grads = [g[k] for g in grads for k in sorted(g)]
+    def train_batch(self, data_iter=None):
+        """GAS micro-steps (grads accumulated host-side, matching the
+        resident engine's mean-of-micro-losses semantics) + one host Adam
+        sweep + async NVMe writeback (overlaps the next step's compute; a
+        group's next read drains its pending write first)."""
+        gas = self.config.gradient_accumulation_steps
+        flat_grads = None
+        losses = []
+        B = 0
+        for m in range(gas):
+            batch = next(data_iter) if data_iter is not None else None
+            ids = batch["input_ids"] if isinstance(batch, dict) else batch
+            ids = jnp.asarray(ids, jnp.int32)
+            B = ids.shape[0]
+            rng_base = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(self.config.seed or 0),
+                                   self.micro_steps), m)
+            loss, g = self._micro_fwd_bwd(ids, rng_base)
+            losses.append(loss)
+            if flat_grads is None:
+                # writable copies only when accumulating (np.asarray views of
+                # device arrays are read-only)
+                flat_grads = g if gas == 1 else [np.array(a) for a in g]
+            else:
+                for a, b in zip(flat_grads, g):
+                    a += b
+            self.micro_steps += 1
+        if gas > 1:
+            inv = 1.0 / gas
+            for a in flat_grads:
+                a *= inv
         clip = self.config.gradient_clipping
         clip_coef = 1.0
         gnorm = None
@@ -235,14 +302,16 @@ class StreamedZeroEngine:
         self.adam_state.adam_step(self.cpu_opt, flat_grads, lr,
                                   clip_coef=clip_coef)
         if self.store.device == "nvme":
+            # async double-buffered writeback (reference
+            # pipelined_optimizer_swapper): queue all groups; reads drain
             for gi in range(len(self._groups)):
-                self.store.writeback(gi, wait=True)
+                self.store.writeback(gi, wait=False)
         self.global_steps += 1
-        self.global_samples += B
+        self.global_samples += B * gas
         self._last_global_norm = gnorm
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
-        return loss
+        return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
 
     def _current_lr(self) -> float:
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_lr"):
