@@ -1,0 +1,105 @@
+"""Spatial (diffusers) attention path — the UNet/VAE injection equivalent.
+
+Reference: ``deepspeed/module_inject/containers/{unet,vae,clip}.py`` replace
+HF diffusers' spatial attention blocks with fused kernels, and
+``csrc/spatial/csrc/opt_bias_add.cu`` fuses the residual bias-add. The TPU
+re-design: one functional ``spatial_attention`` block (GroupNorm → qkv →
+attention over the H·W token grid → proj → residual) that dispatches through
+the same attention registry as the language models (Pallas flash / XLA), with
+XLA fusing the bias+residual epilogue the reference hand-writes in CUDA.
+
+``convert_diffusers_attention`` consumes a diffusers ``AttentionBlock``-format
+state dict (numpy arrays keyed ``group_norm.weight``, ``query.weight``, …) so
+checkpoints exported from diffusers models drop in without the library being
+present.
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def group_norm(x, scale, bias, *, groups: int = 32, eps: float = 1e-6):
+    """GroupNorm over channel-last (B, H, W, C) activations."""
+    B, H, W, C = x.shape
+    g = x.reshape(B, H * W, groups, C // groups).astype(jnp.float32)
+    mu = jnp.mean(g, axis=(1, 3), keepdims=True)
+    var = jnp.mean(jnp.square(g - mu), axis=(1, 3), keepdims=True)
+    g = (g - mu) * jax.lax.rsqrt(var + eps)
+    out = g.reshape(B, H, W, C).astype(x.dtype)
+    return out * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+def spatial_attention(x, params: Dict[str, jnp.ndarray], *, num_heads: int = 1,
+                      groups: int = 32, eps: float = 1e-6):
+    """Diffusers-style AttentionBlock: self-attention over the H·W grid.
+
+    x: (B, H, W, C) channel-last feature map. params: ``gn_scale``, ``gn_bias``
+    (C,), ``wq/wk/wv/wo`` (C, C), ``bq/bk/bv/bo`` (C,). Returns x + attn(x),
+    the residual form the reference's UNet/VAE containers fuse.
+    """
+    from ..ops.transformer.attention import attention as attention_op
+
+    B, H, W, C = x.shape
+    hd = C // num_heads
+    h = group_norm(x, params["gn_scale"], params["gn_bias"], groups=groups, eps=eps)
+    t = h.reshape(B, H * W, C)
+
+    def proj(t, w, b):
+        out = t @ w.astype(t.dtype)
+        return out + b.astype(t.dtype) if b is not None else out
+
+    q = proj(t, params["wq"], params.get("bq")).reshape(B, H * W, num_heads, hd)
+    k = proj(t, params["wk"], params.get("bk")).reshape(B, H * W, num_heads, hd)
+    v = proj(t, params["wv"], params.get("bv")).reshape(B, H * W, num_heads, hd)
+    # bidirectional attention over the token grid (no causal mask)
+    o = attention_op(q, k, v, causal=False)
+    o = proj(o.reshape(B, H * W, C), params["wo"], params.get("bo"))
+    # the opt_bias_add fusion (csrc/spatial): bias + residual in one epilogue —
+    # XLA fuses this chain into the projection matmul automatically
+    return x + o.reshape(B, H, W, C)
+
+
+def convert_diffusers_attention(sd: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Map a diffusers ``AttentionBlock`` state dict to ``spatial_attention``
+    params. Accepts both the pre-0.18 names (query/key/value/proj_attn) and
+    the unified names (to_q/to_k/to_v/to_out.0). Linear weights arrive
+    (out, in) torch-layout and are transposed; 1x1-conv weights (out, in, 1, 1)
+    are squeezed first."""
+
+    def pick(*names):
+        for n in names:
+            if n in sd:
+                return np.asarray(sd[n])
+        raise KeyError(f"none of {names} in state dict (keys: {sorted(sd)[:8]}...)")
+
+    def w(*names):
+        a = pick(*names)
+        if a.ndim == 4:  # 1x1 conv kernel
+            a = a[:, :, 0, 0]
+        return jnp.asarray(a.T)  # torch (out,in) -> (in,out)
+
+    def b(*names):
+        try:
+            return jnp.asarray(pick(*names))
+        except KeyError:
+            return None
+
+    params = {
+        "gn_scale": jnp.asarray(pick("group_norm.weight")),
+        "gn_bias": jnp.asarray(pick("group_norm.bias")),
+        "wq": w("query.weight", "to_q.weight"),
+        "wk": w("key.weight", "to_k.weight"),
+        "wv": w("value.weight", "to_v.weight"),
+        "wo": w("proj_attn.weight", "to_out.0.weight"),
+    }
+    for name, keys in (("bq", ("query.bias", "to_q.bias")),
+                       ("bk", ("key.bias", "to_k.bias")),
+                       ("bv", ("value.bias", "to_v.bias")),
+                       ("bo", ("proj_attn.bias", "to_out.0.bias"))):
+        bias = b(*keys)
+        if bias is not None:
+            params[name] = bias
+    return params
