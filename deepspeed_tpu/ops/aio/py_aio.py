@@ -22,6 +22,9 @@ def _load():
             raise RuntimeError("aio builder unavailable")
         _lib = builder().load()
         _lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        _lib.ds_aio_handle_new2.restype = ctypes.c_void_p
+        _lib.ds_aio_handle_new2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int64]
         _lib.ds_aio_pread.restype = ctypes.c_int64
         _lib.ds_aio_pwrite.restype = ctypes.c_int64
         _lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
@@ -31,16 +34,31 @@ def _load():
         _lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
         _lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+        _lib.ds_aio_stats.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_int64)]
     return _lib
 
 
 class AsyncIOHandle:
-    """Threaded async pread/pwrite (reference ``aio_handle``)."""
+    """Threaded async pread/pwrite (reference ``aio_handle``).
 
-    def __init__(self, num_threads: int = 4, use_direct: bool = False):
+    ``num_threads`` is the queue depth (concurrent in-flight sub-requests);
+    requests larger than ``block_size`` split into block-sized sub-requests
+    fanned across the pool (reference aio_config {block_size, queue_depth,
+    thread_count}). ``use_direct`` stages I/O through 4 KiB-aligned bounce
+    buffers with O_DIRECT; ``stats()`` reports whether the direct path
+    actually engaged (vs the filesystem refusing it)."""
+
+    def __init__(self, num_threads: int = 4, use_direct: bool = False,
+                 block_size: int = 8 << 20):
+        if block_size < 4096:
+            raise ValueError(
+                f"block_size {block_size} below the 4 KiB floor (O_DIRECT "
+                "alignment unit); the C side would silently keep its default")
         self._lib = _load()
-        self._h = self._lib.ds_aio_handle_new(ctypes.c_int(num_threads),
-                                              ctypes.c_int(1 if use_direct else 0))
+        self._h = self._lib.ds_aio_handle_new2(
+            ctypes.c_int(num_threads), ctypes.c_int(1 if use_direct else 0),
+            ctypes.c_int64(block_size))
 
     def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
         """Submit an async read into ``buf``; returns a request id."""
@@ -59,6 +77,12 @@ class AsyncIOHandle:
 
     def wait_all(self) -> int:
         return self._lib.ds_aio_wait_all(self._h)
+
+    def stats(self) -> dict:
+        """O_DIRECT engagement counters: {"direct_opens", "fallback_opens"}."""
+        out = (ctypes.c_int64 * 2)()
+        self._lib.ds_aio_stats(self._h, out)
+        return {"direct_opens": int(out[0]), "fallback_opens": int(out[1])}
 
     def close(self):
         if self._h is not None:

@@ -71,6 +71,168 @@ class DataAnalyzer:
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
 
+    # ------------------------------------------------------------------
+    # corpus scale: chunked map-reduce over an mmap-backed index
+    # (reference ``data_sampling/data_analyzer.py`` run_map/run_reduce over
+    # ``indexed_dataset`` mmap files — here numpy memmaps)
+    # ------------------------------------------------------------------
+    def run_map(self, metrics: Sequence[str], output_dir: str, *,
+                worker_id: int = 0, num_workers: int = 1,
+                chunk_size: int = 1024,
+                freq: Optional[np.ndarray] = None) -> None:
+        """Worker pass: compute this worker's contiguous sample shard in
+        ``chunk_size`` pieces, writing per-worker metric files (and, when
+        ``vocab_rarity`` needs it and no global ``freq`` is given, a partial
+        token-count file for the reduce phase to merge). Holds at most one
+        chunk of samples in memory."""
+        import os
+
+        os.makedirs(output_dir, exist_ok=True)
+        n = len(self.dataset)
+        lo = (n * worker_id) // num_workers
+        hi = (n * (worker_id + 1)) // num_workers
+
+        needs_freq = ("vocab_rarity" in metrics
+                      and "vocab_rarity" not in self.metric_fns)
+        if needs_freq and freq is None:
+            # phase-1 map: partial bincount only; metrics wait for the reduce
+            counts = np.zeros(1, np.int64)
+            total = 0
+            for s0 in range(lo, hi, chunk_size):
+                ids = np.concatenate([
+                    np.asarray(self._ids(self.dataset[i])).reshape(-1)
+                    for i in range(s0, min(s0 + chunk_size, hi))])
+                if ids.size:
+                    counts = _merge_bincount(counts, np.bincount(ids))
+                    total += ids.size
+            np.savez(os.path.join(output_dir, f"counts_{worker_id}.npz"),
+                     counts=counts, total=total)
+            return
+
+        out = {m: np.empty(hi - lo, np.float32) for m in metrics}
+        for s0 in range(lo, hi, chunk_size):
+            s1 = min(s0 + chunk_size, hi)
+            chunk = [self.dataset[i] for i in range(s0, s1)]
+            for m in metrics:
+                if m in self.metric_fns:
+                    vals = [self.metric_fns[m](s) for s in chunk]
+                elif m == "seqlen":
+                    vals = [self._seqlen(s) for s in chunk]
+                elif m == "vocab_rarity":
+                    vals = [self._vocab_rarity(s, freq) for s in chunk]
+                else:
+                    raise ValueError(f"unknown metric '{m}'")
+                out[m][s0 - lo:s1 - lo] = vals
+        for m in metrics:
+            np.save(os.path.join(output_dir, f"metric_{m}_{worker_id}.npy"),
+                    out[m])
+
+    def run_reduce(self, metrics: Sequence[str], output_dir: str, *,
+                   num_workers: int = 1) -> Dict[str, np.ndarray]:
+        """Reduce pass: merge the workers' files into ONE mmap-backed index
+        per metric (``metric_<m>.dat`` + sidecar shape), chunk-copied so the
+        full index never materializes in RAM. Returns read-only memmaps."""
+        import os
+
+        n = len(self.dataset)
+        result = {}
+        for m in metrics:
+            mm = np.memmap(os.path.join(output_dir, f"metric_{m}.dat"),
+                           dtype=np.float32, mode="w+", shape=(n,))
+            pos = 0
+            for w in range(num_workers):
+                part = np.load(os.path.join(output_dir, f"metric_{m}_{w}.npy"),
+                               mmap_mode="r")
+                mm[pos:pos + part.shape[0]] = part
+                pos += part.shape[0]
+            mm.flush()
+            result[m] = np.memmap(os.path.join(output_dir, f"metric_{m}.dat"),
+                                  dtype=np.float32, mode="r", shape=(n,))
+        return result
+
+    def merge_counts(self, output_dir: str, num_workers: int) -> np.ndarray:
+        """Merge phase-1 partial token counts into the global frequency table
+        (the map-reduce midpoint ``vocab_rarity`` needs)."""
+        import os
+
+        counts = np.zeros(1, np.int64)
+        total = 0
+        for w in range(num_workers):
+            with np.load(os.path.join(output_dir, f"counts_{w}.npz")) as z:
+                c, t = z["counts"], int(z["total"])
+            counts = _merge_bincount(counts, c)
+            total += t
+        return counts / max(1, total)
+
+    def run_distributed(self, metrics: Sequence[str], output_dir: str, *,
+                        num_workers: int = 2, chunk_size: int = 1024,
+                        processes: bool = False) -> Dict[str, np.ndarray]:
+        """Full map-reduce: counts map → freq reduce → metric map → index
+        reduce. ``processes=True`` fans the map phases out over a
+        multiprocessing pool — the dataset AND any custom ``metric_fns``
+        must then be picklable (module-level functions, not lambdas/
+        closures); otherwise workers run in-process (same I/O layout,
+        deterministic)."""
+        needs_freq = ("vocab_rarity" in metrics
+                      and "vocab_rarity" not in self.metric_fns)
+        freq = None
+
+        def fan(fn_args):
+            if processes:
+                import multiprocessing as mp
+
+                with mp.get_context("spawn").Pool(num_workers) as pool:
+                    pool.starmap(_analyzer_worker, fn_args)
+            else:
+                for args in fn_args:
+                    _analyzer_worker(*args)
+
+        if needs_freq:
+            fan([(self.dataset, self.metric_fns, metrics, output_dir, w,
+                  num_workers, chunk_size, None, True)
+                 for w in range(num_workers)])
+            freq = self.merge_counts(output_dir, num_workers)
+        fan([(self.dataset, self.metric_fns, metrics, output_dir, w,
+              num_workers, chunk_size, freq, False)
+             for w in range(num_workers)])
+        return self.run_reduce(metrics, output_dir, num_workers=num_workers)
+
+    @staticmethod
+    def _ids(sample):
+        return np.asarray(
+            sample["input_ids"] if isinstance(sample, dict) else sample[0])
+
+    @staticmethod
+    def load_index(output_dir: str, metrics: Sequence[str],
+                   n: int) -> Dict[str, np.ndarray]:
+        import os
+
+        return {m: np.memmap(os.path.join(output_dir, f"metric_{m}.dat"),
+                             dtype=np.float32, mode="r", shape=(n,))
+                for m in metrics}
+
+
+def _merge_bincount(counts: np.ndarray, c: np.ndarray) -> np.ndarray:
+    """Grow-and-add merge of two bincount arrays of differing lengths."""
+    if c.size > counts.size:
+        c = c.copy()
+        c[:counts.size] += counts
+        return c
+    counts[:c.size] += c
+    return counts
+
+
+def _analyzer_worker(dataset, metric_fns, metrics, output_dir, worker_id,
+                     num_workers, chunk_size, freq, counts_only):
+    """Module-level map-phase entry (picklable for multiprocessing)."""
+    an = DataAnalyzer(dataset, metric_fns)
+    if counts_only:
+        an.run_map(metrics, output_dir, worker_id=worker_id,
+                   num_workers=num_workers, chunk_size=chunk_size)
+    else:
+        an.run_map(metrics, output_dir, worker_id=worker_id,
+                   num_workers=num_workers, chunk_size=chunk_size, freq=freq)
+
 
 class DeepSpeedDataSampler:
     """Difficulty-gated batch sampler (reference ``data_sampler.py:349``).
@@ -90,6 +252,8 @@ class DeepSpeedDataSampler:
         self.drop_last = drop_last
         self.epoch = 0
         self.global_step = 0
+        self.consumed_batches = 0
+        self._iter_step = None
         self.dp_rank = data_parallel_rank
         self.dp_size = data_parallel_size
         if batch_size % data_parallel_size:
@@ -98,36 +262,66 @@ class DeepSpeedDataSampler:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+        self.consumed_batches = 0
+        self._iter_step = None
 
     def set_step(self, global_step: int):
         self.global_step = global_step
 
-    def eligible_indices(self) -> np.ndarray:
-        cutoff = self.scheduler.get_difficulty(self.global_step)
+    def eligible_indices(self, at_step: Optional[int] = None) -> np.ndarray:
+        cutoff = self.scheduler.get_difficulty(
+            self.global_step if at_step is None else at_step)
         idx = np.nonzero(self.difficulties <= cutoff)[0]
         if idx.size == 0:  # always serve something: the easiest samples
             k = max(1, self.batch_size)
             idx = np.argsort(self.difficulties)[:k]
         return idx
 
+    # ------------------------------------------------------------------
+    # mid-epoch save/resume (reference data_sampler state_dict): the epoch's
+    # permutation is a pure function of (seed, epoch, iter-start step), so
+    # resuming = rebuilding it and skipping the consumed batches
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict:
+        return {"epoch": self.epoch, "global_step": self.global_step,
+                "consumed_batches": getattr(self, "consumed_batches", 0),
+                "iter_step": getattr(self, "_iter_step", None)}
+
+    def load_state_dict(self, sd: Dict):
+        self.epoch = int(sd["epoch"])
+        self.global_step = int(sd["global_step"])
+        self.consumed_batches = int(sd.get("consumed_batches", 0))
+        self._iter_step = sd.get("iter_step")
+
     def __iter__(self) -> Iterator[List[int]]:
         """Yields this rank's slice of each global batch. Difficulty is read
-        from the step set via ``set_step`` — the caller advances it at
-        optimizer-step rate (yielding does NOT mutate sampler state, so
-        multiprocess loader workers stay consistent)."""
+        ONCE at iteration start (frozen for the epoch pass, so a mid-epoch
+        resume rebuilds the identical permutation); ``consumed_batches``
+        advances per yield and a fresh iterator skips past it."""
+        if getattr(self, "_iter_step", None) is None:
+            self._iter_step = self.global_step
+        start = getattr(self, "consumed_batches", 0)
         rng = np.random.default_rng(self.seed + self.epoch)
-        idx = self.eligible_indices()
+        idx = self.eligible_indices(at_step=self._iter_step)
         perm = rng.permutation(idx)
         per_rank = self.batch_size // self.dp_size
         n_full = len(perm) // self.batch_size
-        for b in range(n_full):
+        for b in range(start, n_full):
             g = perm[b * self.batch_size:(b + 1) * self.batch_size]
+            self.consumed_batches = b + 1
             yield g[self.dp_rank * per_rank:(self.dp_rank + 1) * per_rank].tolist()
-        if not self.drop_last and len(perm) % self.batch_size >= self.dp_size:
+        if not self.drop_last and len(perm) % self.batch_size >= self.dp_size \
+                and start <= n_full:
             rest = perm[n_full * self.batch_size:]
             n = (len(rest) // self.dp_size) * self.dp_size
             rest = rest[:n]
+            self.consumed_batches = n_full + 1
             yield rest[self.dp_rank::self.dp_size].tolist()
+        # a COMPLETED pass resets the resume cursor: plain
+        # `for epoch ...: for batch in sampler` keeps yielding full epochs
+        # (only an interrupted pass leaves state for state_dict/resume)
+        self.consumed_batches = 0
+        self._iter_step = None
 
     def __len__(self):
         n = len(self.eligible_indices())

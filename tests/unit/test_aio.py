@@ -1,0 +1,80 @@
+"""AIO library tests (reference ``tests/unit/ops/aio``): threaded async I/O
+with request splitting, queue-depth control, and aligned O_DIRECT."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio.py_aio import AsyncIOHandle
+
+
+def _roundtrip(h, path, n, seed=0):
+    data = np.random.default_rng(seed).integers(0, 255, n, dtype=np.uint8)
+    rid = h.pwrite(path, data)
+    assert h.wait(rid) == 0
+    buf = np.empty_like(data)
+    rid = h.pread(path, buf)
+    assert h.wait(rid) == 0
+    np.testing.assert_array_equal(buf, data)
+
+
+@pytest.mark.parametrize("qd", [1, 4])
+def test_roundtrip_with_request_splitting(tmp_path, qd):
+    """A request much larger than block_size splits into sub-requests across
+    the pool and still completes as ONE id with correct contents."""
+    h = AsyncIOHandle(num_threads=qd, block_size=1 << 16)  # 64 KiB blocks
+    _roundtrip(h, str(tmp_path / "f.bin"), (1 << 20) + 12345)  # 16+ subs, odd tail
+    h.close()
+
+
+def test_many_concurrent_requests(tmp_path):
+    h = AsyncIOHandle(num_threads=4, block_size=1 << 16)
+    datas = [np.random.default_rng(i).integers(0, 255, 200_000, dtype=np.uint8)
+             for i in range(8)]
+    rids = [h.pwrite(str(tmp_path / f"f{i}.bin"), d)
+            for i, d in enumerate(datas)]
+    assert all(h.wait(r) == 0 for r in rids)
+    bufs = [np.empty_like(d) for d in datas]
+    rids = [h.pread(str(tmp_path / f"f{i}.bin"), b)
+            for i, b in enumerate(bufs)]
+    assert h.wait_all() == 0
+    for b, d in zip(bufs, datas):
+        np.testing.assert_array_equal(b, d)
+    h.close()
+
+
+def test_o_direct_roundtrip_and_engagement(tmp_path):
+    """O_DIRECT mode: unaligned user buffers/lengths round-trip exactly via
+    the aligned bounce path, and stats report whether the direct path
+    actually engaged (not silently fallen back)."""
+    h = AsyncIOHandle(num_threads=2, use_direct=True, block_size=1 << 18)
+    path = str(tmp_path / "d.bin")
+    _roundtrip(h, path, (1 << 19) + 777)  # odd length: aligned main + tail
+    st = h.stats()
+    assert st["direct_opens"] + st["fallback_opens"] > 0
+    h.close()
+    if st["direct_opens"] == 0:
+        pytest.skip(f"filesystem refused O_DIRECT here (stats={st}) — "
+                    "correctness verified via the fallback path")
+
+
+def test_o_direct_on_root_fs():
+    """Try O_DIRECT on the repo's filesystem (tmp dirs are often tmpfs which
+    refuses it); assert engagement when the fs allows it."""
+    d = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".aio_test_tmp")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "direct.bin")
+    try:
+        h = AsyncIOHandle(num_threads=2, use_direct=True, block_size=1 << 18)
+        _roundtrip(h, path, 1 << 19)
+        st = h.stats()
+        h.close()
+        if st["direct_opens"] == 0:
+            pytest.skip(f"repo filesystem refused O_DIRECT (stats={st})")
+        assert st["direct_opens"] > 0
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+        os.rmdir(d)
