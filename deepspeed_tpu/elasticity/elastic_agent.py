@@ -110,6 +110,13 @@ class DSElasticAgent:
                     except Exception:  # probe failures never kill the group
                         new_world = world
                     if new_world != world:
+                        # a worker that ALREADY exited is a crash/exit, not a
+                        # membership change — classify by its return code (the
+                        # probe may observe the shrunk world in the window
+                        # between our poll() and this check)
+                        rc = proc.poll()
+                        if rc is not None:
+                            break
                         logger.warning(
                             f"elastic agent: world changed {world} -> "
                             f"{new_world}; relaunching")

@@ -688,6 +688,15 @@ class DeepSpeedEngine:
 
     def _qgz_fwd_bwd(self, batch):
         """Local grads under shard_map over the DP axes + quantized reduce."""
+        self._build_qgz_fn(batch)
+        return self._qgz_fn(
+            self.params, batch, self.scaler_state.cur_scale,
+            jnp.asarray(self.micro_steps, jnp.int32),
+        )
+
+    def _build_qgz_fn(self, batch):
+        """Build (once) the qgZ shard_map program WITHOUT executing it — the
+        wire-byte tests lower it directly from this seam."""
         from jax.sharding import PartitionSpec as P
 
         from ..comm.topology import ZERO_AXES
@@ -735,10 +744,6 @@ class DeepSpeedEngine:
                 out_specs=(P(), jax.tree.map(lambda _: P(), self.params)),
                 axis_names=set(axes), check_vma=False,
             ))
-        return self._qgz_fn(
-            self.params, batch, self.scaler_state.cur_scale,
-            jnp.asarray(self.micro_steps, jnp.int32),
-        )
 
     # ------------------------------------------------------------------
     # ZeRO-Offload / Offload++ / ZeRO-Infinity (reference stage_1_and_2.py

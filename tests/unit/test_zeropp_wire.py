@@ -50,7 +50,7 @@ def _collective_bytes(zero_over, mb=2, seq=128):
     batch = engine._shard_batch({"input_ids": jnp.asarray(
         rng.integers(0, cfg.vocab_size, (mb * 8, seq), dtype=np.int32))})
     if engine._qgz_active():
-        engine._qgz_fwd_bwd(batch)  # builds the shard_map program
+        engine._build_qgz_fn(batch)  # build WITHOUT executing a step
         hlo = engine._qgz_fn.lower(
             engine.params, batch, engine.scaler_state.cur_scale,
             jnp.asarray(0, jnp.int32)).compile().as_text()
