@@ -140,7 +140,14 @@ struct Handle {
         // splits on block_size boundaries and callers start at offset 0 —
         // offsets not 4 KiB-aligned take the plain path)
         if (req.offset % kAlign) {
-            return run_plain(fd, req.write, req.buf, req.nbytes, req.offset);
+            // an unaligned offset cannot ride the O_DIRECT fd (pread/pwrite
+            // would EINVAL); reopen plain, as the write-tail path does
+            int pfd = open(req.path.c_str(), req.write ? O_WRONLY : O_RDONLY,
+                           0644);
+            if (pfd < 0) return -1;
+            int st = run_plain(pfd, req.write, req.buf, req.nbytes, req.offset);
+            close(pfd);
+            return st;
         }
         int64_t chunk_cap = std::min<int64_t>(block_size, 8 << 20);
         // the read loop fills up to align_up(chunk): size the bounce for it
@@ -193,7 +200,10 @@ extern "C" {
 void* ds_aio_handle_new2(int n_threads, int use_direct, int64_t block_size) {
     auto* h = new Handle();
     h->use_direct = use_direct != 0;
-    if (block_size >= (1 << 12)) h->block_size = block_size;
+    // round up to a 4 KiB multiple: any other granularity makes every
+    // sub-request offset (s * block_size) unaligned for O_DIRECT
+    if (block_size >= (1 << 12))
+        h->block_size = (block_size + kAlign - 1) & ~(kAlign - 1);
     if (n_threads < 1) n_threads = 1;
     for (int i = 0; i < n_threads; ++i)
         h->workers.emplace_back([h] { h->worker(); });

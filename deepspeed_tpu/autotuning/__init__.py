@@ -1,3 +1,8 @@
 """Autotuning (reference deepspeed/autotuning/)."""
 
 from .autotuner import Autotuner, TuneResult, estimate_memory_per_chip  # noqa: F401
+from .resolve import (  # noqa: F401
+    find_auto_keys,
+    generate_experiments,
+    resolve_auto_config,
+)

@@ -1,0 +1,239 @@
+"""``"auto"`` resolution over a user ds_config + experiment ledger.
+
+Reference surface: ``deepspeed/autotuning/autotuner.py`` — experiment
+generation from the ``"auto"``-valued entries of the user's config (``:304``),
+per-experiment records (``:708``), and the winning values merged back into the
+user's config (``:1075``). The TPU redesign keeps the same contract with
+in-process profiling (see ``autotuner.py``): only the keys the user marked
+``"auto"`` are searched; everything else stays pinned; every trial is recorded
+to a JSONL ledger; the result is the user's config with each ``"auto"``
+replaced by the winning value.
+
+Supported ``"auto"`` keys and their candidate spaces:
+
+- ``train_micro_batch_size_per_gpu`` → powers of two (1..16)
+- ``zero_optimization.stage``        → 0/1/2/3
+- ``gradient_accumulation_steps``    → 1/2/4 (or derived from a pinned
+  ``train_batch_size``)
+- ``mesh``                           → data-only and data×model layouts over
+  the live device count
+
+Candidates that violate the pinned batch triple
+(``train_batch_size = micro · gas · dp``) are dropped before profiling; the
+memory model prunes the rest (reference ``:278``).
+"""
+
+import copy
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import log_dist, logger
+from .autotuner import Autotuner, TuneResult
+
+AUTO = "auto"
+
+
+def _is_auto(v) -> bool:
+    return isinstance(v, str) and v.lower() == AUTO
+
+
+def find_auto_keys(cfg: Dict[str, Any], _path: str = "") -> List[str]:
+    """Dotted paths of every ``"auto"``-valued entry."""
+    out = []
+    for k, v in cfg.items():
+        p = f"{_path}.{k}" if _path else str(k)
+        if isinstance(v, dict):
+            out.extend(find_auto_keys(v, p))
+        elif _is_auto(v):
+            out.append(p)
+    return out
+
+
+def _set_path(cfg: Dict[str, Any], dotted: str, value) -> None:
+    parts = dotted.split(".")
+    d = cfg
+    for p in parts[:-1]:
+        d = d.setdefault(p, {})
+    d[parts[-1]] = value
+
+
+def _get_path(cfg: Dict[str, Any], dotted: str, default=None):
+    d = cfg
+    for p in dotted.split("."):
+        if not isinstance(d, dict) or p not in d:
+            return default
+        d = d[p]
+    return d
+
+
+def _candidate_axes(auto_keys: List[str], n_devices: int) -> Dict[str, List]:
+    axes: Dict[str, List] = {}
+    for key in auto_keys:
+        if key == "train_micro_batch_size_per_gpu":
+            axes[key] = [1, 2, 4, 8, 16]
+        elif key == "zero_optimization.stage":
+            axes[key] = [0, 1, 2, 3]
+        elif key == "gradient_accumulation_steps":
+            axes[key] = [1, 2, 4]
+        elif key == "mesh":
+            meshes = [{"data": n_devices}]
+            if n_devices % 2 == 0 and n_devices > 1:
+                meshes.append({"data": n_devices // 2, "model": 2})
+            axes[key] = meshes
+        elif key == "train_batch_size":
+            continue  # derived: micro · gas · dp (generate_experiments)
+        else:
+            raise ValueError(
+                f"no candidate space for \"auto\" key '{key}' — supported: "
+                "train_micro_batch_size_per_gpu, zero_optimization.stage, "
+                "gradient_accumulation_steps, mesh")
+    return axes
+
+
+def _dp_of(cfg: Dict[str, Any], n_devices: int) -> int:
+    mesh = cfg.get("mesh") or {}
+    if not isinstance(mesh, dict):
+        mesh = {}
+    denom = max(1, mesh.get("model", 1) * mesh.get("pipe", 1)
+                * mesh.get("seq", 1) * mesh.get("expert", 1))
+    return max(1, n_devices // denom)
+
+
+def generate_experiments(ds_config: Dict[str, Any],
+                         n_devices: int) -> Tuple[List[Dict], List[str]]:
+    """Expand the ``"auto"`` keys into concrete candidate configs
+    (reference experiment generation, ``autotuner.py:304``)."""
+    auto_keys = find_auto_keys(ds_config)
+    if not auto_keys:
+        return [], []
+    axes = _candidate_axes(auto_keys, n_devices)
+    tbs = ds_config.get("train_batch_size")
+    tbs = None if _is_auto(tbs) else tbs
+    cands = []
+    for combo in itertools.product(*axes.values()):
+        cfg = copy.deepcopy(ds_config)
+        for key, val in zip(axes.keys(), combo):
+            _set_path(cfg, key, val)
+        dp = _dp_of(cfg, n_devices)
+        mb = cfg.get("train_micro_batch_size_per_gpu")
+        gas = cfg.get("gradient_accumulation_steps")
+        if isinstance(tbs, int) and isinstance(mb, int):
+            if _is_auto(gas) or gas is None:
+                if tbs % (mb * dp):
+                    continue  # no integral gas satisfies the pinned triple
+                _set_path(cfg, "gradient_accumulation_steps", tbs // (mb * dp))
+            elif mb * gas * dp != tbs:
+                continue  # violates the pinned batch triple
+        elif _is_auto(cfg.get("gradient_accumulation_steps")):
+            _set_path(cfg, "gradient_accumulation_steps", 1)
+        if _is_auto(cfg.get("train_batch_size")):
+            mb_v = cfg.get("train_micro_batch_size_per_gpu", 1)
+            gas_v = cfg.get("gradient_accumulation_steps", 1)
+            _set_path(cfg, "train_batch_size", mb_v * gas_v * dp)
+        cands.append(cfg)
+    return cands, auto_keys
+
+
+def resolve_auto_config(
+    model_fn: Callable[[], Any],
+    ds_config: Dict[str, Any],
+    batch_fn: Optional[Callable[[int], Any]] = None,
+    *,
+    tuner_type: str = "gridsearch",
+    max_trials: int = 16,
+    steps: int = 3,
+    results_dir: Optional[str] = None,
+) -> Tuple[Dict[str, Any], TuneResult]:
+    """Profile the ``"auto"`` space and return ``(merged_config, best)``.
+
+    ``merged_config`` is the user's config with every ``"auto"`` replaced by
+    the winning value (reference merge-back, ``autotuner.py:1075``). Each
+    trial is appended to ``<results_dir>/ledger.jsonl`` and the merged config
+    written to ``<results_dir>/best_config.json`` (reference records,
+    ``autotuner.py:708``).
+    """
+    import jax
+
+    n = jax.device_count()
+    cands, auto_keys = generate_experiments(ds_config, n)
+    if not auto_keys:
+        logger.info("resolve_auto_config: no \"auto\" keys — config unchanged")
+        return copy.deepcopy(ds_config), None
+    if not cands:
+        raise RuntimeError(
+            "no candidate satisfies the pinned batch triple — check "
+            "train_batch_size vs the auto'd micro-batch/mesh")
+
+    if results_dir is None:
+        results_dir = (ds_config.get("autotuning") or {}).get(
+            "results_dir", "autotuning_results")
+    os.makedirs(results_dir, exist_ok=True)
+    ledger_path = os.path.join(results_dir, "ledger.jsonl")
+
+    if batch_fn is None:
+        batch_fn = _default_batch_fn(model_fn())
+
+    tuner = Autotuner(model_fn, ds_config)
+    kept = tuner.prune_by_memory(cands, model_fn())
+    if not kept:
+        raise RuntimeError("no candidate configs survive the memory model")
+
+    from .tuner import TUNERS
+
+    strategy = TUNERS[tuner_type](tuner)
+    t0 = time.time()
+    best = strategy.tune(kept, batch_fn, steps=steps, max_trials=max_trials)
+
+    with open(ledger_path, "a") as f:
+        for i, r in enumerate(tuner.results):
+            f.write(json.dumps({
+                "exp_id": i,
+                "tuner": tuner_type,
+                "auto_keys": auto_keys,
+                "values": {k: _get_path(r.config, k) for k in auto_keys},
+                "gradient_accumulation_steps":
+                    r.config.get("gradient_accumulation_steps"),
+                "throughput_samples_per_s": r.throughput,
+                "step_ms": r.step_ms,
+                "error": r.error,
+                "wall_s": round(time.time() - t0, 2),
+            }) + "\n")
+
+    merged = copy.deepcopy(ds_config)
+    for k in auto_keys:
+        _set_path(merged, k, _get_path(best.config, k))
+    # the triple derived during generation must land in the merged config too
+    for k in ("gradient_accumulation_steps", "train_batch_size"):
+        if _is_auto(merged.get(k)) or (k in best.config and k not in merged):
+            merged[k] = best.config[k]
+    with open(os.path.join(results_dir, "best_config.json"), "w") as f:
+        json.dump(merged, f, indent=2)
+    log_dist(
+        f"resolve_auto_config: {auto_keys} -> "
+        f"{ {k: _get_path(merged, k) for k in auto_keys} } "
+        f"@ {best.throughput:.1f} samples/s "
+        f"({len(tuner.results)} experiments, ledger at {ledger_path})",
+        ranks=[0])
+    return merged, best
+
+
+def _default_batch_fn(model):
+    """LM batch synthesizer for models exposing the TransformerLM config."""
+    mcfg = getattr(model, "config", None)
+    if mcfg is None or not hasattr(mcfg, "vocab_size"):
+        raise ValueError(
+            "pass batch_fn= explicitly: the model has no .config with "
+            "vocab_size/max_seq_len to synthesize LM batches from")
+    import numpy as np
+
+    seq = min(mcfg.max_seq_len, 128)
+
+    def batch_fn(global_bs):
+        rng = np.random.default_rng(0)
+        return {"input_ids": rng.integers(
+            0, mcfg.vocab_size, (global_bs, seq)).astype("int32")}
+
+    return batch_fn

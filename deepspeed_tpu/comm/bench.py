@@ -77,7 +77,21 @@ def main(argv=None):
     p.add_argument("--maxsize", type=int, default=1 << 24)
     p.add_argument("--trials", type=int, default=10)
     p.add_argument("--warmups", type=int, default=3)
+    p.add_argument("--tune", metavar="DS_CONFIG.json", default=None,
+                   help="resolve \"auto\" values in a ds_config by in-process "
+                        "profiling (reference `deepspeed --autotuning`); "
+                        "prints the merged config")
+    p.add_argument("--model", default="125m",
+                   help="TransformerLM preset for --tune (e.g. 125m, 350m)")
+    p.add_argument("--seq", type=int, default=128,
+                   help="sequence length for --tune profiling batches")
+    p.add_argument("--tuner", default="gridsearch",
+                   choices=["gridsearch", "random", "model_based"])
+    p.add_argument("--max-trials", type=int, default=16)
     args = p.parse_args(argv)
+
+    if args.tune:
+        return _tune(args)
 
     from . import init_distributed
 
@@ -93,6 +107,25 @@ def main(argv=None):
             print(json.dumps(r))
         size *= 4
     return results
+
+
+def _tune(args):
+    """`dstpu_bench --tune ds_config.json`: resolve "auto" values against a
+    TransformerLM preset and print the merged config."""
+    with open(args.tune) as f:
+        ds_config = json.load(f)
+
+    from ..autotuning import resolve_auto_config
+    from ..models import TransformerLM, gpt2_config
+
+    def model_fn():
+        return TransformerLM(gpt2_config(args.model, max_seq_len=args.seq))
+
+    merged, best = resolve_auto_config(
+        model_fn, ds_config, tuner_type=args.tuner,
+        max_trials=args.max_trials)
+    print(json.dumps(merged, indent=2))
+    return merged
 
 
 if __name__ == "__main__":

@@ -212,9 +212,11 @@ class InferenceEngineV2:
 
     @property
     def ragged_cache_size(self) -> int:
-        """Number of compiled traces of the ragged-step program (tests assert
-        this stays <= 2: the mixed-budget shape + the decode-round shape —
-        fixed shapes, independent of load)."""
+        """Number of compiled traces of the ragged-step program. Bounded at
+        <= 4, independent of load: two shapes (the mixed-budget shape + the
+        decode-round shape) × two ``greedy`` modes (``greedy`` is a
+        static_argnum of the same jit, so each mode holds its own traces).
+        A workload using a single greedy mode stays <= 2."""
         fn = self._prefill_fns.get("ragged")
         return 0 if fn is None else fn._cache_size()
 

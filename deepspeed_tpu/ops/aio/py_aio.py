@@ -55,6 +55,11 @@ class AsyncIOHandle:
             raise ValueError(
                 f"block_size {block_size} below the 4 KiB floor (O_DIRECT "
                 "alignment unit); the C side would silently keep its default")
+        if block_size % 4096:
+            raise ValueError(
+                f"block_size {block_size} is not a 4 KiB multiple: every "
+                "sub-request offset (k * block_size) would be unaligned for "
+                "O_DIRECT (the C side rounds up; keep the two in agreement)")
         self._lib = _load()
         self._h = self._lib.ds_aio_handle_new2(
             ctypes.c_int(num_threads), ctypes.c_int(1 if use_direct else 0),

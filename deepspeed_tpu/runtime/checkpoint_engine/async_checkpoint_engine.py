@@ -1,0 +1,138 @@
+"""Async checkpoint engine: serialization + disk writes off the step path.
+
+Fills the role of the reference's Nebula engine
+(reference runtime/checkpoint_engine/nebula_checkpoint_engine.py:1, config
+nebula/config.py:1): ``save()`` snapshots the already-host-resident state and
+returns immediately; a single writer thread serializes and writes in FIFO
+order, overlapping checkpoint I/O with the training steps that follow. The
+device→host gather stays on the caller (the unavoidable synchronous slice) —
+what moves off the step path is npz serialization and disk I/O, which dominate
+checkpoint latency at large model sizes.
+
+Durability contract:
+- every file is written tmp→``os.replace``, so a partially-written file never
+  shadows a complete one;
+- ``commit(tag)`` is *eventually durable* (nebula semantics): it returns
+  immediately; once the writer drains everything queued before it, the tag is
+  complete on disk. ``DeepSpeedEngine.save_checkpoint`` rides the ``latest``
+  pointer write on the same FIFO queue (``enqueue_task``), so ``latest`` can
+  never point at a tag whose files are still in flight — a crash mid-save
+  resumes from the previous complete checkpoint;
+- ``wait()`` is the hard barrier (drains the queue, re-raises writer errors);
+  ``load()`` on a path with an in-flight save waits for that save first
+  (read-your-writes within a process).
+"""
+
+import atexit
+import queue
+import threading
+
+from ...utils.logging import logger
+from .native_checkpoint_engine import NativeCheckpointEngine
+
+
+class AsyncCheckpointEngine(NativeCheckpointEngine):
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._q = queue.Queue()
+        self._cv = threading.Condition()
+        self._enq_seq = 0    # items handed to the queue
+        self._done_seq = 0   # items fully executed (FIFO ⇒ monotone)
+        self._inflight = {}  # path -> newest enqueued seq for that path
+        self._errors = []    # exceptions, surfaced at wait()
+        self._thread = threading.Thread(
+            target=self._drain, name="dstpu-async-ckpt", daemon=True)
+        self._thread.start()
+        # drain on normal interpreter exit — without this, a script whose last
+        # act is save_checkpoint() would exit with the writes still queued and
+        # the daemon writer killed mid-flight (rc=0, checkpoint silently gone)
+        self._atexit = atexit.register(self._drain_at_exit)
+
+    def _drain_at_exit(self):
+        try:
+            self.wait()
+        except Exception as e:
+            logger.error(f"[AsyncCheckpointEngine] exit drain: {e}")
+
+    # ------------------------------------------------------------------
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            seq, fn, path = item
+            try:
+                with self._cv:
+                    poisoned = bool(self._errors) and path is None
+                if poisoned:
+                    # a queued SAVE failed earlier: ordered side-effects (the
+                    # `latest` pointer write) must not run, or `latest` would
+                    # advance onto a tag with missing files — saves for later
+                    # tags still proceed; the error surfaces at wait()/load()
+                    logger.error(
+                        "[AsyncCheckpointEngine] skipping queued task after "
+                        "earlier save failure")
+                else:
+                    fn()
+            except Exception as e:
+                logger.error(f"[AsyncCheckpointEngine] write failed: {e}")
+                with self._cv:
+                    self._errors.append(e)
+            finally:
+                with self._cv:
+                    self._done_seq = seq
+                    if path is not None and self._inflight.get(path) == seq:
+                        del self._inflight[path]
+                    self._cv.notify_all()
+
+    def _enqueue(self, fn, path=None):
+        with self._cv:
+            self._enq_seq += 1
+            seq = self._enq_seq
+            if path is not None:
+                self._inflight[path] = seq
+        self._q.put((seq, fn, path))
+        return seq
+
+    # ------------------------------------------------------------------
+    def save(self, state_dict, path):
+        """Enqueue and return. ``state_dict`` leaves must be host-owned (the
+        engine's ``_gather_to_host`` yields fresh numpy copies, so the
+        training loop mutating device state cannot race the writer)."""
+        self._enqueue(
+            lambda: NativeCheckpointEngine.save(self, state_dict, path),
+            path=path)
+
+    def enqueue_task(self, fn):
+        """Run ``fn`` on the writer thread after everything queued so far —
+        used for ordered side-effects like the ``latest`` pointer write."""
+        self._enqueue(fn)
+
+    def wait(self, path=None):
+        """Block until the newest save for ``path`` (or the whole queue) has
+        fully hit disk; re-raise the first writer error."""
+        with self._cv:
+            target = self._inflight.get(path, 0) if path is not None \
+                else self._enq_seq
+            self._cv.wait_for(lambda: self._done_seq >= target)
+            if self._errors:
+                raise RuntimeError("async checkpoint save failed") \
+                    from self._errors.pop(0)
+
+    def load(self, path, map_location=None):
+        self.wait(path)
+        return super().load(path, map_location)
+
+    def commit(self, tag) -> bool:
+        """Eventually-durable commit (reference nebula commit): non-blocking;
+        the tag is complete once the queue drains past this point. Use
+        ``wait()`` for a hard durability barrier."""
+        self.enqueue_task(
+            lambda: logger.debug(f"[AsyncCheckpointEngine] tag {tag} durable"))
+        return True
+
+    def close(self):
+        self.wait()
+        self._q.put(None)
+        self._thread.join()
+        atexit.unregister(self._drain_at_exit)

@@ -2,8 +2,9 @@
 
 Fills the role of the reference's ``TorchCheckpointEngine`` (torch.save/load).
 Arrays are written as full (unsharded) global values — see the ABC docstring for why
-that makes every checkpoint "universal". An Orbax-based async engine is the Nebula
-analogue and can be selected via config.
+that makes every checkpoint "universal". The Nebula analogue is
+``AsyncCheckpointEngine`` (same directory), selected via
+``{"checkpoint": {"async_save": true}}``.
 """
 
 import json

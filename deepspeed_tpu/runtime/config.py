@@ -127,6 +127,9 @@ class CheckpointConfig(DeepSpeedConfigModel):
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write: dict = field(default_factory=dict)
+    # serialize+write off the training step path (reference nebula engine,
+    # runtime/checkpoint_engine/nebula_checkpoint_engine.py:1)
+    async_save: bool = False
 
     def _validate(self):
         if self.tag_validation.lower().capitalize() not in C.CHECKPOINT_TAG_VALIDATION_MODES:

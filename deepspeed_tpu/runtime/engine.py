@@ -67,7 +67,9 @@ def _gather_to_host(tree):
             if not x.is_fully_addressable:
                 from jax.experimental import multihost_utils
 
-                return np.asarray(multihost_utils.process_allgather(x))
+                # tiled=True: reassemble the GLOBAL value from the per-process
+                # shards (required for non-fully-addressable global arrays)
+                return np.asarray(multihost_utils.process_allgather(x, tiled=True))
             return np.asarray(jax.device_get(x))
         return x
 
@@ -83,6 +85,153 @@ def _global_norm(grads):
     if not leaves:
         return jnp.asarray(0.0, jnp.float32)
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+_WARNED_FORCE_THEN_BACKWARD = False
+
+
+class LazyLoss:
+    """Loss placeholder returned by a training-mode ``forward()``.
+
+    Nothing is dispatched at forward time. The fused fwd+bwd program launches
+    when ``backward()`` consumes this — the training fast path keeps exactly
+    one program per micro-step, same as eager dispatch. Reading the value
+    without ever calling ``backward()`` (``float(loss)``, any jnp op) instead
+    launches a loss-only program, so a validation-style forward never pays a
+    backward. This mirrors the reference's torch semantics, where ``forward``
+    only builds the autograd graph and the backward cost lands in
+    ``loss.backward()`` (reference runtime/engine.py forward/backward split).
+
+    After ``backward()`` the forced value is the fused program's loss (no
+    extra compute). Interops with python/numpy via ``float()``/``__array__``;
+    for jnp ops use ``.value`` (jax 0.9 removed the ``__jax_array__``
+    abstractification hook, so jnp cannot consume the wrapper directly).
+    """
+
+    __slots__ = ("_fused_fn", "_loss_fn", "_args", "_loss", "_forced_early")
+
+    def __init__(self, fused_fn, loss_fn, args):
+        self._fused_fn = fused_fn
+        self._loss_fn = loss_fn
+        self._args = args
+        self._loss = None
+        self._forced_early = False
+
+    def _run_fused(self):
+        """Launch the fused fwd+bwd (called by ``engine.backward`` once)."""
+        global _WARNED_FORCE_THEN_BACKWARD
+        if self._forced_early and not _WARNED_FORCE_THEN_BACKWARD:
+            _WARNED_FORCE_THEN_BACKWARD = True
+            logger.warning(
+                "loss value was read BEFORE backward(): that read ran a "
+                "loss-only forward, and backward() now recomputes the fused "
+                "fwd+bwd — ~2x forward cost this micro-step. Read losses "
+                "after backward() (or use engine.eval() for validation). "
+                "[warned once]")
+        loss, grads = self._fused_fn(*self._args)
+        self._loss = loss
+        self._args = None
+        return loss, grads
+
+    def _force(self):
+        if self._loss is None:
+            params, batch, _scale, step_idx = self._args
+            self._forced_early = True
+            self._loss = self._loss_fn(params, batch, step_idx)
+        return self._loss
+
+    # -- jax / python interop ------------------------------------------------
+    @property
+    def value(self):
+        """The concrete replicated loss array (forces if still pending)."""
+        return self._force()
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self._force())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self._force())
+
+    def __bool__(self):
+        return bool(self._force())
+
+    def item(self):
+        return self._force().item()
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._force())
+        return self
+
+    @property
+    def dtype(self):
+        return self._force().dtype
+
+    @property
+    def shape(self):
+        return self._force().shape
+
+    def astype(self, dtype):
+        return self._force().astype(dtype)
+
+    def __repr__(self):
+        # never forces: repr must stay side-effect-free (debuggers, logging of
+        # containers); str()/format() DO force and show the value
+        if self._loss is None:
+            return "LazyLoss(<pending>)"
+        return f"LazyLoss({self._loss!r})"
+
+    def __str__(self):
+        return str(self._force())
+
+    def __format__(self, spec):
+        return format(self._force(), spec)
+
+    def __add__(self, o):
+        return self._force() + o
+
+    __radd__ = __add__
+
+    def __mul__(self, o):
+        return self._force() * o
+
+    __rmul__ = __mul__
+
+    def __sub__(self, o):
+        return self._force() - o
+
+    def __rsub__(self, o):
+        return o - self._force()
+
+    def __truediv__(self, o):
+        return self._force() / o
+
+    def __rtruediv__(self, o):
+        return o / self._force()
+
+    def __lt__(self, o):
+        return self._force() < o
+
+    def __le__(self, o):
+        return self._force() <= o
+
+    def __gt__(self, o):
+        return self._force() > o
+
+    def __ge__(self, o):
+        return self._force() >= o
+
+    def __eq__(self, o):
+        if o is self:
+            return True
+        return self._force() == o
+
+    def __ne__(self, o):
+        if o is self:
+            return False
+        return self._force() != o
+
+    __hash__ = object.__hash__  # identity hash: eq forces, hash must not
 
 
 class DeepSpeedEngine:
@@ -106,7 +255,14 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.skipped_steps = 0
         self._cached = None  # (loss, grads) from the last forward
-        self.checkpoint_engine = NativeCheckpointEngine()
+        if config.checkpoint_config.async_save:
+            from .checkpoint_engine.async_checkpoint_engine import (
+                AsyncCheckpointEngine,
+            )
+
+            self.checkpoint_engine = AsyncCheckpointEngine()
+        else:
+            self.checkpoint_engine = NativeCheckpointEngine()
         self.loaded_checkpoint_tag = None
 
         # ---- precision ----
@@ -442,6 +598,34 @@ class DeepSpeedEngine:
         self._make_fwd_bwd = make_fwd_bwd
         self._fwd_bwd_variants = {}
         self._fwd_bwd = make_fwd_bwd(None)
+
+        def make_train_loss(comp_key, ltd_keep=None):
+            """Loss-ONLY train-mode program (dropout on, no gradients): what a
+            LazyLoss runs when its value is read without a backward()."""
+
+            def train_loss(lp_params, batch, step_idx):
+                rng = jax.random.fold_in(base_rng, step_idx)
+                p = lp_params
+                if qwz is not None:
+                    p = qwz(p)
+                if comp_key is not None and comp_key[0]:
+                    from ..compression.compress import compress_params
+
+                    p = compress_params(p, self._compression,
+                                        num_bits=comp_key[1],
+                                        tp_specs=self._param_specs,
+                                        topo=self.topology)
+                b = batch
+                if ltd_keep is not None and isinstance(batch, dict):
+                    b = dict(batch, ltd_keep=ltd_keep)
+                out = apply_fn(p, b, train=True, rng=rng)
+                return self._loss_of(out).astype(jnp.float32)
+
+            return jax.jit(train_loss, out_shardings=self._replicated)
+
+        self._make_train_loss = make_train_loss
+        self._train_loss_variants = {}
+        self._train_loss = make_train_loss(None)
 
         def eval_loss(lp_params, batch):
             out = apply_fn(lp_params, batch, train=False, rng=None)
@@ -844,20 +1028,33 @@ class DeepSpeedEngine:
                 jnp.asarray(mgr["host"].step_count, jnp.int32),
             )
 
-        host_grads = [np.asarray(grads_flat[i], np.float32) for i in mgr["host_idx"]]
-        new_master = mgr["host"].adam_step(
-            mgr["cpu_opt"], host_grads, lr, grad_scale=inv_scale,
-            clip_coef=clip_coef,
-        )
+        # twin-flow overlap (reference Offload++ blog): start EVERY host
+        # leaf's D2H gradient transfer now (native dtype — half the wire bytes
+        # under bf16), so the per-leaf Adam loop below finds its grad already
+        # host-resident while later leaves are still in flight
+        host_idx = mgr["host_idx"]
+        host_grads_dev = [grads_flat[i] for i in host_idx]
+        for g in host_grads_dev:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
 
-        # assemble the new lp tree
         params_flat = list(jax.tree.leaves(self.params))
         shard_flat = jax.tree.leaves(self._param_shardings)
-        for j, i in enumerate(mgr["host_idx"]):
-            lp = jnp.asarray(new_master[j], dtype=jnp.float32)
-            if self.compute_dtype != jnp.float32:
-                lp = lp.astype(self.compute_dtype)
-            params_flat[i] = jax.device_put(lp, shard_flat[i])
+        np_compute = np.dtype(self.compute_dtype)
+
+        def _writeback(j, master_np):
+            # per-leaf H2D upload, dispatched while the NEXT leaf's host Adam
+            # runs; cast on host so the tunnel moves compute-dtype bytes (2
+            # instead of 4 per element under bf16/fp16)
+            i = host_idx[j]
+            lp_np = master_np if np_compute == master_np.dtype else \
+                master_np.astype(np_compute)
+            params_flat[i] = jax.device_put(lp_np, shard_flat[i])
+
+        mgr["host"].adam_step(
+            mgr["cpu_opt"], host_grads_dev, lr, grad_scale=inv_scale,
+            clip_coef=clip_coef, on_leaf=_writeback,
+        )
         if dev_out is not None:
             d = mgr["dev"]
             d["master"], d["m"], d["v"] = dev_out
@@ -902,10 +1099,16 @@ class DeepSpeedEngine:
         return batch
 
     def forward(self, batch, **kwargs):
-        """Compute loss AND cache gradients for the pending ``backward`` (see
-        module docstring). Returns the unscaled loss (a replicated jax scalar).
-        After ``eval()``, runs loss-only with ``train=False`` (no dropout, no
-        gradient caching)."""
+        """Return the micro-step loss and arm the pending ``backward`` (see
+        module docstring). After ``eval()``, runs loss-only with
+        ``train=False`` (no dropout, no gradients) and returns a concrete
+        replicated jax scalar.
+
+        In training mode this returns a :class:`LazyLoss`: the fused fwd+bwd
+        program launches when ``backward()`` consumes it (one program per
+        micro-step — the fast path is unchanged), while reading the value
+        without a backward launches a loss-only program, so a training-mode
+        validation forward never silently pays a backward."""
         if kwargs:
             raise TypeError(
                 f"forward() got unexpected kwargs {sorted(kwargs)}: pass model inputs "
@@ -918,6 +1121,7 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).stop()
             return loss
         fwd_bwd = self._fwd_bwd
+        train_loss = self._train_loss
         comp_key = None
         if self._compression is not None:
             comp_key = (self._compression.active(), self._compression.weight_bits())
@@ -932,18 +1136,27 @@ class DeepSpeedEngine:
             if fwd_bwd is None:
                 fwd_bwd = self._fwd_bwd_variants[vkey] = self._make_fwd_bwd(
                     comp_key, ltd_keep)
+            train_loss = self._train_loss_variants.get(vkey)
+            if train_loss is None:
+                train_loss = self._train_loss_variants[vkey] = \
+                    self._make_train_loss(comp_key, ltd_keep)
         if self._onebit_active():
             loss, grads = self._onebit_fwd_bwd(batch)
-        elif self._qgz_active():
+            self._cached = (loss, grads)
+            self.timers(FORWARD_MICRO_TIMER).stop()
+            return loss
+        if self._qgz_active():
             loss, grads = self._qgz_fwd_bwd(batch)
-        else:
-            loss, grads = fwd_bwd(
-                self.params, batch, self.scaler_state.cur_scale,
-                jnp.asarray(self.micro_steps, jnp.int32),
-            )
-        self._cached = (loss, grads)
+            self._cached = (loss, grads)
+            self.timers(FORWARD_MICRO_TIMER).stop()
+            return loss
+        lazy = LazyLoss(fwd_bwd, train_loss, (
+            self.params, batch, self.scaler_state.cur_scale,
+            jnp.asarray(self.micro_steps, jnp.int32),
+        ))
+        self._cached = lazy
         self.timers(FORWARD_MICRO_TIMER).stop()
-        return loss
+        return lazy
 
     def _ltd_keep_now(self):
         """Current random-LTD kept-token count (None = full sequence)."""
@@ -960,7 +1173,12 @@ class DeepSpeedEngine:
         if self._cached is None:
             raise RuntimeError("backward() called without a preceding forward()")
         self.timers(BACKWARD_MICRO_TIMER).start()
-        _, grads = self._cached
+        if isinstance(self._cached, LazyLoss):
+            # the fused fwd+bwd launches HERE — forward() deferred it so a
+            # never-backwarded forward doesn't pay gradient compute
+            _, grads = self._cached._run_fused()
+        else:
+            _, grads = self._cached
         self._cached = None
         if self.config.gradient_accumulation_steps == 1:
             self._acc_grads = grads
@@ -991,6 +1209,27 @@ class DeepSpeedEngine:
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return self.micro_steps % self.config.gradient_accumulation_steps == 0
+
+    def block_until_ready(self):
+        """Wait for every in-flight device program touching the engine's state.
+
+        JAX dispatch is asynchronous: ``step()`` returns as soon as the update
+        program is enqueued. On real hardware that is the point (overlap), but
+        the in-process CPU communicator used by the virtual-mesh gate can
+        deadlock its collective rendezvous when two programs' collectives
+        overlap on an oversubscribed host, so correctness harnesses serialize
+        program boundaries through this method. Plays the role of
+        ``torch.cuda.synchronize()`` in the reference's distributed test
+        harness (reference tests/unit/common.py:113).
+        """
+        jax.block_until_ready(jax.tree.leaves((
+            self.params,
+            getattr(self, "master_params", None),
+            getattr(self, "opt_state", None),
+            getattr(self, "scaler_state", None),
+            getattr(self, "_acc_grads", None),
+        )))
+        return self
 
     def get_lr(self):
         if self.lr_scheduler is not None:
@@ -1099,7 +1338,7 @@ class DeepSpeedEngine:
             batch = next(it)
             loss = self.forward(batch)
             self.backward(loss)
-            losses.append(loss)
+            losses.append(loss.value if isinstance(loss, LazyLoss) else loss)
         self.step()
         self.tput_timer.stop(global_step=True)
         return jnp.mean(jnp.stack(losses))
@@ -1337,16 +1576,29 @@ class DeepSpeedEngine:
             if jax.process_index() == 0:
                 self.checkpoint_engine.save(optim_sd, optim_path)
 
-        if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
         self.checkpoint_engine.commit(tag)
+        if save_latest and jax.process_index() == 0:
+            def _write_latest():
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+
+            if hasattr(self.checkpoint_engine, "enqueue_task"):
+                # async engine: the pointer write rides the FIFO queue, so
+                # `latest` moves only after every file of this tag is on disk
+                # (a crash mid-save resumes from the previous complete tag)
+                self.checkpoint_engine.enqueue_task(_write_latest)
+            else:
+                _write_latest()
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        if hasattr(self.checkpoint_engine, "wait"):
+            # async engine: completion barrier — `latest` and all tag files
+            # must be on disk before we read them back
+            self.checkpoint_engine.wait()
         if self.config.load_universal_checkpoint and os.path.exists(
                 os.path.join(load_dir, "universal_meta.pkl")):
             from ..checkpoint.universal import load_universal_into_engine

@@ -111,7 +111,9 @@ class PipelineEngine(DeepSpeedEngine):
         finally:
             self._inside_train_batch = False
         self.tput_timer.stop(global_step=True)
-        return loss
+        from ..engine import LazyLoss
+
+        return loss.value if isinstance(loss, LazyLoss) else loss
 
     def eval_batch(self, data_iter, return_logits: bool = False):
         """Pipelined evaluation over one batch (reference ``eval_batch:438``)."""

@@ -62,22 +62,33 @@ class OffloadedAdamState:
         rid = self._aio.pread(self._paths[i], buf)
         return buf, rid
 
-    def adam_step(self, opt, grads: List[np.ndarray], lr: float,
-                  grad_scale: float = 1.0, clip_coef: float = 1.0) -> List[np.ndarray]:
+    def adam_step(self, opt, grads: List, lr: float,
+                  grad_scale: float = 1.0, clip_coef: float = 1.0,
+                  on_leaf=None) -> List[np.ndarray]:
         """Update all offloaded leaves in place; returns the master list.
 
-        NVMe: moments stream through a 2-deep prefetch pipeline — leaf i+1's
-        read is in flight while leaf i computes (reference
+        ``grads`` entries may be device (jax) arrays — each is materialized on
+        host per leaf, so a caller that issued ``copy_to_host_async`` on all
+        of them overlaps the remaining D2H transfers with this loop's compute
+        (twin-flow overlap, reference Offload++ blog). ``on_leaf(i, master_i)``
+        fires right after leaf ``i``'s update — the engine uses it to start
+        that leaf's H2D parameter upload while the next leaf computes.
+
+        NVMe: moments additionally stream through a 2-deep prefetch pipeline —
+        leaf i+1's read is in flight while leaf i computes (reference
         ``pipelined_optimizer_swapper`` double buffering).
         """
         self.step_count += 1
         n = len(self.master)
         if self._aio is None:
             for i in range(n):
+                g = np.asarray(grads[i], np.float32).reshape(-1)
                 p = self.master[i]
-                opt.step_flat(p.reshape(-1), grads[i].reshape(-1), self.m[i],
+                opt.step_flat(p.reshape(-1), g, self.m[i],
                               self.v[i], self.step_count, lr=lr,
                               grad_scale=grad_scale, clip_coef=clip_coef)
+                if on_leaf is not None:
+                    on_leaf(i, p)
             return self.master
         # NVMe tier with read-ahead
         pending = {}
@@ -88,11 +99,14 @@ class OffloadedAdamState:
             if i + 1 < n:
                 pending[i + 1] = self._fetch_mv(i + 1)
             assert self._aio.wait(rid) == 0, f"NVMe read failed for leaf {i}"
+            g = np.asarray(grads[i], np.float32).reshape(-1)
             p = self.master[i]
-            opt.step_flat(p.reshape(-1), grads[i].reshape(-1), buf[0], buf[1],
+            opt.step_flat(p.reshape(-1), g, buf[0], buf[1],
                           self.step_count, lr=lr, grad_scale=grad_scale,
                           clip_coef=clip_coef)
             wid = self._aio.pwrite(self._paths[i], buf)
+            if on_leaf is not None:
+                on_leaf(i, p)
             self._aio.wait(wid)
         return self.master
 

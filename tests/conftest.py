@@ -11,7 +11,14 @@ import os
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    # the concurrent thunk scheduler reorders independent collectives
+    # differently per device → intermittent rendezvous deadlocks on
+    # oversubscribed hosts (see __graft_entry__._TIMEOUT_FLAGS); the
+    # sequential scheduler is deterministic and faster on 1 vCPU
+    _flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+os.environ["XLA_FLAGS"] = _flags
 os.environ.setdefault("DS_ACCELERATOR", "cpu")
 
 import jax  # noqa: E402

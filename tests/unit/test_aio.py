@@ -59,6 +59,34 @@ def test_o_direct_roundtrip_and_engagement(tmp_path):
                     "correctness verified via the fallback path")
 
 
+def test_o_direct_unaligned_offset_roundtrip(tmp_path):
+    """Requests at a non-4KiB-aligned offset round-trip under use_direct=True:
+    the unaligned-offset path must NOT issue plain pread/pwrite on the
+    O_DIRECT fd (EINVAL → status -2). Regression: advisor round-3 finding."""
+    h = AsyncIOHandle(num_threads=2, use_direct=True, block_size=1 << 16)
+    path = str(tmp_path / "u.bin")
+    base = np.zeros(1 << 18, dtype=np.uint8)
+    rid = h.pwrite(path, base)
+    assert h.wait(rid) == 0
+    data = np.random.default_rng(7).integers(0, 255, 100_000, dtype=np.uint8)
+    rid = h.pwrite(path, data, offset=100)  # unaligned offset
+    assert h.wait(rid) == 0
+    buf = np.empty_like(data)
+    rid = h.pread(path, buf, offset=100)
+    assert h.wait(rid) == 0
+    np.testing.assert_array_equal(buf, data)
+    h.close()
+
+
+def test_block_size_must_be_4k_multiple():
+    """A block_size like 5000 would make every sub-request offset unaligned
+    for O_DIRECT; the handle rejects it up front."""
+    with pytest.raises(ValueError, match="4 KiB multiple"):
+        AsyncIOHandle(num_threads=1, block_size=5000)
+    with pytest.raises(ValueError, match="4 KiB floor"):
+        AsyncIOHandle(num_threads=1, block_size=1024)
+
+
 def test_o_direct_on_root_fs():
     """Try O_DIRECT on the repo's filesystem (tmp dirs are often tmpfs which
     refuses it); assert engagement when the fs allows it."""

@@ -1,0 +1,229 @@
+"""Async checkpoint engine tests (reference nebula engine role:
+runtime/checkpoint_engine/nebula_checkpoint_engine.py — save off the step
+path, eventually-durable commit, crash-consistent `latest`)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine.async_checkpoint_engine import (
+    AsyncCheckpointEngine,
+)
+from tests.unit.simple_model import make_simple_model, random_batch
+
+HIDDEN = 16
+
+
+def _cfg(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "checkpoint": {"async_save": True},
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _train(engine, steps, seed=0):
+    for _ in range(steps):
+        batch = random_batch(batch_size=16, hidden_dim=HIDDEN, seed=seed)
+        engine.backward(engine(batch))
+        engine.step()
+
+
+class TestAsyncEngineUnit:
+    def test_read_your_writes_and_order(self, tmp_path):
+        eng = AsyncCheckpointEngine()
+        p = str(tmp_path / "a.ckpt")
+        eng.save({"x": np.arange(4), "n": 3}, p)
+        eng.save({"x": np.arange(4) * 2, "n": 4}, p)  # newer snapshot wins
+        out = eng.load(p)  # waits for the in-flight saves first
+        np.testing.assert_array_equal(out["x"], np.arange(4) * 2)
+        assert out["n"] == 4
+        eng.close()
+
+    def test_enqueue_task_ordering(self, tmp_path):
+        eng = AsyncCheckpointEngine()
+        order = []
+        eng.save({"x": np.zeros(8)}, str(tmp_path / "b.ckpt"))
+        eng.enqueue_task(lambda: order.append("after_save"))
+        eng.wait()
+        assert order == ["after_save"]
+        assert os.path.exists(tmp_path / "b.ckpt")
+        eng.close()
+
+    def test_writer_error_surfaces_at_wait(self, tmp_path):
+        eng = AsyncCheckpointEngine()
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("file, not dir")  # makedirs under a file must fail
+        eng.save({"x": np.zeros(2)}, str(blocker / "sub" / "x.ckpt"))
+        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+            eng.wait()
+        eng.close()
+
+
+def test_save_is_off_the_step_path(tmp_path, monkeypatch):
+    """save_checkpoint returns while the (artificially slow) write is still
+    in flight; wait() is the durability barrier."""
+    from deepspeed_tpu.runtime.checkpoint_engine import native_checkpoint_engine
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=_cfg())
+    assert isinstance(engine.checkpoint_engine, AsyncCheckpointEngine)
+    _train(engine, 2)
+
+    real_save = native_checkpoint_engine.NativeCheckpointEngine.save
+
+    def slow_save(self, sd, path):
+        time.sleep(1.0)
+        real_save(self, sd, path)
+
+    monkeypatch.setattr(
+        native_checkpoint_engine.NativeCheckpointEngine, "save", slow_save)
+    t0 = time.perf_counter()
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    returned = time.perf_counter() - t0
+    assert returned < 0.9, f"save_checkpoint blocked {returned:.2f}s"
+    # latest must not be visible before the files are durable
+    engine.checkpoint_engine.wait()
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "t2"
+    # round-trip
+    engine2, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=_cfg())
+    engine2.load_checkpoint(str(tmp_path))
+    for a, b in zip(np.asarray(engine.params["layer_0"]["w"]),
+                    np.asarray(engine2.params["layer_0"]["w"])):
+        np.testing.assert_array_equal(a, b)
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.checkpoint_engine import native_checkpoint_engine
+    from tests.unit.simple_model import make_simple_model, random_batch
+
+    d = {d!r}
+    cfg = {{
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "checkpoint": {{"async_save": True}},
+        "steps_per_print": 0,
+    }}
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(16), config=cfg)
+    def train(n):
+        for _ in range(n):
+            batch = random_batch(batch_size=16, hidden_dim=16, seed=0)
+            engine.backward(engine(batch))
+            engine.step()
+    train(3)
+    engine.save_checkpoint(d, tag="t3")
+    engine.checkpoint_engine.wait()   # t3 fully durable
+    # record the exact params the survivor must resume with
+    np.savez(os.path.join(d, "expected.npz"),
+             w=np.asarray(jax.device_get(engine.params["layer_0"]["w"])))
+    train(2)
+    # every further write stalls: the t5 save will be in flight at crash time
+    real_save = native_checkpoint_engine.NativeCheckpointEngine.save
+    native_checkpoint_engine.NativeCheckpointEngine.save = (
+        lambda self, sd, path: (time.sleep(60), real_save(self, sd, path)))
+    engine.save_checkpoint(d, tag="t5")   # returns immediately (async)
+    os._exit(9)                           # hard crash, t5 write in flight
+""")
+
+
+def test_crash_during_inflight_save_resumes_bit_identical(tmp_path):
+    """Train → durable save t3 → train → crash while async save t5 is in
+    flight. `latest` must still point at t3 and a fresh engine must resume
+    bit-identical to the recorded t3 state (VERDICT r3 missing #1)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child = _CRASH_CHILD.format(repo=repo, d=str(tmp_path))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=repo)
+    assert proc.returncode == 9, f"child: {proc.stderr[-2000:]}"
+    with open(tmp_path / "latest") as f:
+        assert f.read().strip() == "t3", "latest moved past the crash point"
+    # the t5 model file must not exist as a complete checkpoint
+    assert not os.path.exists(tmp_path / "t5" / "model_states.ckpt")
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(HIDDEN), config=_cfg())
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine.global_steps == 3
+    expected = np.load(tmp_path / "expected.npz")["w"]
+    got = np.asarray(engine.params["layer_0"]["w"])
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_failed_save_blocks_latest_pointer(tmp_path):
+    """A failed queued save must poison later ordered tasks: the `latest`
+    pointer cannot advance onto a tag with missing files (review r4)."""
+    eng = AsyncCheckpointEngine()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    ran = []
+    eng.save({"x": np.zeros(2)}, str(blocker / "t5" / "model.ckpt"))
+    eng.enqueue_task(lambda: ran.append("latest"))
+    with pytest.raises(RuntimeError):
+        eng.wait()
+    assert ran == [], "`latest` task ran after a failed save"
+    eng.close()
+
+
+_EXIT_CHILD = textwrap.dedent("""
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.checkpoint_engine import native_checkpoint_engine
+    from tests.unit.simple_model import make_simple_model, random_batch
+
+    cfg = {{
+        "train_batch_size": 16,
+        "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+        "checkpoint": {{"async_save": True}},
+    }}
+    engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(16), config=cfg)
+    batch = random_batch(batch_size=16, hidden_dim=16, seed=0)
+    engine.backward(engine(batch)); engine.step()
+    real_save = native_checkpoint_engine.NativeCheckpointEngine.save
+    native_checkpoint_engine.NativeCheckpointEngine.save = (
+        lambda self, sd, path: (time.sleep(0.5), real_save(self, sd, path)))
+    engine.save_checkpoint({d!r}, tag="final")
+    # NO wait(), NO close(): normal interpreter exit must drain the queue
+""")
+
+
+def test_normal_exit_drains_queue(tmp_path):
+    """A script ending right after save_checkpoint() must not lose the
+    checkpoint: the atexit hook drains the writer queue (review r4)."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    child = _EXIT_CHILD.format(repo=repo, d=str(tmp_path))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=repo)
+    assert proc.returncode == 0, f"child: {proc.stderr[-2000:]}"
+    assert (tmp_path / "latest").read_text().strip() == "final"
+    assert os.path.exists(tmp_path / "final" / "model_states.ckpt")

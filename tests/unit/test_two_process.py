@@ -90,3 +90,93 @@ def test_two_process_boot(tmp_path):
         assert float(r[2]) == 24.0
     # identical ZeRO-1 trajectories on both ranks (replicated optimizer result)
     assert by_rank[0][3] == by_rank[1][3]
+
+
+WORKER4 = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu
+
+    deepspeed_tpu.init_distributed()
+    assert jax.process_count() == 4, jax.process_count()
+    rank = jax.process_index()
+
+    # real collective over the 4-process group
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(jax.devices(), ("data",))
+    local = jnp.full((1, 4), float(rank + 1))
+    g = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local)
+    s = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                              in_specs=P("data"), out_specs=P("data")))(g)
+    psum_val = float(jnp.sum(s))  # (1+2+3+4) * 4 lanes * 4 rows = 160
+
+    from tests.unit.simple_model import make_simple_model, random_batch
+    engine, *_ = deepspeed_tpu.initialize(
+        model=make_simple_model(16), config={{
+            "train_batch_size": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+            "zero_optimization": {{"stage": 1}},
+            "steps_per_print": 0,
+        }})
+    assert engine.topology.get_dim("data") == 4
+    losses = []
+    for step in range(2):
+        batch = random_batch(batch_size=8, hidden_dim=16, seed=step)
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(round(float(loss), 6))
+
+    # checkpoint across the group: every process participates in the host
+    # gather (multihost process_allgather), rank 0 writes, all ranks reload
+    ckdir = {ckdir!r}
+    engine.save_checkpoint(ckdir, tag="four")
+    # barrier: rank 0 reaches this psum only after its (synchronous) disk
+    # write, so no rank can race ahead to load a half-written checkpoint
+    jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                          in_specs=P("data"), out_specs=P("data")))(g).block_until_ready()
+    w_before = np.asarray(jax.device_get(engine.params["layer_0"]["w"]))
+    # perturb, then load back — the load must restore the saved state
+    engine.params["layer_0"]["w"] = engine.params["layer_0"]["w"] + 1.0
+    engine.load_checkpoint(ckdir)
+    w_after = np.asarray(jax.device_get(engine.params["layer_0"]["w"]))
+    ck_ok = bool(np.array_equal(w_before, w_after))
+    print(f"RESULT4 rank={{rank}} world={{jax.process_count()}} "
+          f"psum={{psum_val}} ck={{ck_ok}} losses={{losses}}", flush=True)
+""")
+
+
+def test_four_process_collective_and_checkpoint(tmp_path):
+    """4-REAL-process rendezvous: psum over the group, ZeRO-1 steps, and a
+    checkpoint save/load across the group (VERDICT r3 #9)."""
+    worker = tmp_path / "worker4.py"
+    worker.write_text(WORKER4.format(repo=REPO, ckdir=str(tmp_path / "ck")))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--launcher", "local", "--num_nodes", "4",
+         "--master_port", "29677", "--hostfile", "/nonexistent",
+         str(worker)],
+        env=env, capture_output=True, text=True, timeout=540, cwd=REPO)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    results = re.findall(r"RESULT4 rank=(\d) world=(\d) psum=([\d.]+) "
+                         r"ck=(\w+) losses=(\[[^\]]*\])", out)
+    assert len(results) == 4, out[-2000:]
+    by_rank = {int(r[0]): r for r in results}
+    assert set(by_rank) == {0, 1, 2, 3}
+    for r in results:
+        assert r[1] == "4"
+        assert float(r[2]) == 160.0  # (1+2+3+4) * 4 lanes * 4 global rows
+        assert r[3] == "True"
+    # identical replicated trajectories on every rank
+    assert len({r[4] for r in results}) == 1
