@@ -316,11 +316,20 @@ def bench_bert_offloadpp():
             "steps_per_print": 0,
         }, seq=seq, micro_bs=mb, steps=2, warmup=1, labels=True)
 
-    # the three points that decompose the row: twin-flow (ratio 0.4), FULL
-    # offload (ratio 1.0 — the reference's plain ZeRO-Offload baseline for
-    # its 3× Offload++ claim), and no offload (pure device compute)
-    tok_s, loss, step_s = run({"offload_optimizer": {"device": "cpu",
-                                                     "ratio": 0.4}})
+    # decomposition points: twin-flow at SWEPT ratios (the reference's 3×
+    # claim is explicitly "with some tuning on offload ratio",
+    # blogs/deepspeed-offloadpp/README.md:37 — smaller ratio = more device
+    # work = faster, bounded by HBM headroom), FULL offload (ratio 1.0, the
+    # reference's plain ZeRO-Offload baseline), and no offload (pure device)
+    sweep = {}
+    best_ratio, best = None, None
+    for ratio in (0.4, 0.3, 0.2):
+        tok_s, loss, step_s = run({"offload_optimizer": {"device": "cpu",
+                                                         "ratio": ratio}})
+        sweep[str(ratio)] = round(step_s * 1000, 1)
+        if best is None or step_s < best[2]:
+            best_ratio, best = ratio, (tok_s, loss, step_s)
+    tok_s, loss, step_s = best
     _, _, step_full = run({"offload_optimizer": {"device": "cpu",
                                                  "ratio": 1.0}})
     _, _, step_dev = run({})
@@ -330,13 +339,17 @@ def bench_bert_offloadpp():
         "value": round(tok_s, 1), "unit": "tokens/s",
         "vs_baseline": round(speedup / 3.0, 3),
         "detail": {"standin": "BERT-large dims, MLM-style random labels, seq "
-                              "256 mb 2, 2 steps; twin-flow ratio 0.4 "
-                              "(largest leaves host, rest device)",
-                   "normalization": "vs_baseline = measured twin-flow speedup "
+                              "256 mb 2, 2 steps; twin-flow ratio swept "
+                              f"(best {best_ratio}: largest leaves host, "
+                              "rest device)",
+                   "normalization": "vs_baseline = tuned twin-flow speedup "
                                     "over FULL offload (ratio 1.0) / 3.0 — "
-                                    "the reference Offload++ claim on A100 "
-                                    "(blogs/deepspeed-offloadpp/README.md:34)",
+                                    "the reference Offload++ claim on A100, "
+                                    "itself ratio-tuned "
+                                    "(blogs/deepspeed-offloadpp/README.md:34,37)",
                    "twinflow_speedup_vs_full_offload": round(speedup, 2),
+                   "ratio_sweep_step_ms": sweep,
+                   "best_ratio": best_ratio,
                    "device_compute_step_ms": round(step_dev * 1000, 1),
                    "host_tunnel_overhead_ms": round(
                        (step_s - step_dev) * 1000, 1),
@@ -417,7 +430,12 @@ def bench_pipe_zero1():
                                     "dp8 tokens/s on the same devices) ÷ the "
                                     "ideal 1F1B bubble efficiency M/(M+P-1)="
                                     f"{bubble:.3f} — 1.0 means the pipeline "
-                                    "achieves its theoretical efficiency",
+                                    "achieves its theoretical efficiency. "
+                                    ">1.0 is possible: the embed/head run "
+                                    "OUTSIDE the pipelined ticks (batched at "
+                                    "full efficiency, runtime/pipe/spmd.py), "
+                                    "while the 1F1B ideal assumes ALL work "
+                                    "pays the bubble",
                    "dp8_tokens_per_sec": round(dp_tok_s, 1),
                    "final_loss": loss},
     }

@@ -69,8 +69,25 @@ def _get_path(cfg: Dict[str, Any], dotted: str, default=None):
     return d
 
 
-def _candidate_axes(auto_keys: List[str], n_devices: int) -> Dict[str, List]:
+def _del_path(cfg: Dict[str, Any], dotted: str) -> None:
+    parts = dotted.split(".")
+    d = cfg
+    for p in parts[:-1]:
+        if not isinstance(d, dict) or p not in d:
+            return
+        d = d[p]
+    if isinstance(d, dict):
+        d.pop(parts[-1], None)
+
+
+def _candidate_axes(auto_keys: List[str], n_devices: int
+                    ) -> Tuple[Dict[str, List], List[str]]:
+    """Candidate space per supported key; unsupported ``"auto"`` keys (e.g.
+    ``optimizer.params.lr`` in HF-Trainer-style configs, resolved by the
+    trainer, not the autotuner — reference behavior) are returned separately
+    and left untouched."""
     axes: Dict[str, List] = {}
+    unsupported: List[str] = []
     for key in auto_keys:
         if key == "train_micro_batch_size_per_gpu":
             axes[key] = [1, 2, 4, 8, 16]
@@ -86,11 +103,13 @@ def _candidate_axes(auto_keys: List[str], n_devices: int) -> Dict[str, List]:
         elif key == "train_batch_size":
             continue  # derived: micro · gas · dp (generate_experiments)
         else:
-            raise ValueError(
-                f"no candidate space for \"auto\" key '{key}' — supported: "
+            unsupported.append(key)
+            logger.warning(
+                f"resolve_auto_config: leaving \"auto\" key '{key}' for the "
+                "caller to resolve (tunable keys: "
                 "train_micro_batch_size_per_gpu, zero_optimization.stage, "
-                "gradient_accumulation_steps, mesh")
-    return axes
+                "gradient_accumulation_steps, mesh, train_batch_size)")
+    return axes, unsupported
 
 
 def _dp_of(cfg: Dict[str, Any], n_devices: int) -> int:
@@ -109,7 +128,10 @@ def generate_experiments(ds_config: Dict[str, Any],
     auto_keys = find_auto_keys(ds_config)
     if not auto_keys:
         return [], []
-    axes = _candidate_axes(auto_keys, n_devices)
+    axes, unsupported = _candidate_axes(auto_keys, n_devices)
+    resolved_keys = [k for k in auto_keys if k not in unsupported]
+    if not axes and not any(k == "train_batch_size" for k in resolved_keys):
+        return [], []  # nothing tunable — all autos are caller-resolved
     tbs = ds_config.get("train_batch_size")
     tbs = None if _is_auto(tbs) else tbs
     cands = []
@@ -117,6 +139,11 @@ def generate_experiments(ds_config: Dict[str, Any],
         cfg = copy.deepcopy(ds_config)
         for key, val in zip(axes.keys(), combo):
             _set_path(cfg, key, val)
+        for key in unsupported:
+            # profiling candidates cannot carry an "auto" string into
+            # initialize(); drop the entry so subsystem defaults apply — the
+            # MERGED config keeps the user's "auto" for their trainer
+            _del_path(cfg, key)
         dp = _dp_of(cfg, n_devices)
         mb = cfg.get("train_micro_batch_size_per_gpu")
         gas = cfg.get("gradient_accumulation_steps")
@@ -134,7 +161,7 @@ def generate_experiments(ds_config: Dict[str, Any],
             gas_v = cfg.get("gradient_accumulation_steps", 1)
             _set_path(cfg, "train_batch_size", mb_v * gas_v * dp)
         cands.append(cfg)
-    return cands, auto_keys
+    return cands, resolved_keys
 
 
 def resolve_auto_config(

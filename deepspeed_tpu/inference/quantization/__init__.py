@@ -8,8 +8,11 @@ layer lives in ``ops/quantizer/woq.py``; this module is the user-facing API.
 Usage::
 
     model, params = from_hf(hf_model)
-    model, qparams = quantize_model(model, params, num_bits=4)
+    model, qparams = quantize_model(model, params, num_bits=6)  # 8 | 6 | 4
     engine = deepspeed_tpu.init_inference(model, params=qparams, dtype="bf16")
+
+``num_bits=6`` is the FP6-class density point (4 codes per 3 bytes, fidelity
+between int8 and int4); ``woq_matmul`` is the Pallas dequant-in-reads GEMM.
 """
 
 from ...ops.quantizer.woq import (  # noqa: F401
@@ -18,6 +21,7 @@ from ...ops.quantizer.woq import (  # noqa: F401
     quantize_param_tree,
     quantized_tp_specs,
 )
+from ...ops.quantizer.woq_gemm import woq_matmul  # noqa: F401
 
 
 def quantize_model(model, params, num_bits: int = 8, group_size: int = 128,

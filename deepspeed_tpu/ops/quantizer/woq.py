@@ -6,9 +6,10 @@ weight-only quantization with dequant matmul, ``quantization.py:111``,
 (``inference/v2/kernels/core_ops/cuda_linear``).
 
 TPU-native design: decode is HBM-bandwidth-bound, so the win is shrinking the
-weight bytes the matmul streams — int8 halves and packed int4 quarters them
-relative to bf16. Weights are stored as per-group symmetric codes + scales in
-the parameter pytree (``<name>::q8``/``<name>::q4`` + ``<name>::scale``); the
+weight bytes the matmul streams — int8 halves, packed int6 (FP6-class, 4
+codes per 3 bytes) takes 37.5%, and packed int4 quarters them relative to
+bf16. Weights are stored as per-group symmetric codes + scales in the
+parameter pytree (``<name>::q8``/``::q6``/``::q4`` + ``<name>::scale``); the
 model dequantizes per layer inside the scan body, so XLA fuses the dequant
 into the matmul read and only one layer's weights ever materialize in bf16.
 
@@ -32,11 +33,13 @@ DEFAULT_TARGETS = frozenset(
 
 
 def _group_size(in_dim: int, requested: int, num_bits: int) -> int:
-    """Largest divisor of ``in_dim`` that is <= requested (and even for int4)."""
-    step = 2 if num_bits == 4 else 1
+    """Largest divisor of ``in_dim`` that is <= requested (and compatible with
+    the packing unit: 2 codes/byte for int4, 4 codes/3 bytes for int6)."""
+    step = {4: 2, 6: 4, 8: 1}[num_bits]
     if in_dim % step:
         raise ValueError(
-            f"int4 packing needs an even contraction dim, got {in_dim}")
+            f"int{num_bits} packing needs a contraction dim divisible by "
+            f"{step}, got {in_dim}")
     g = min(requested, in_dim)
     while in_dim % g or g % step:
         g -= 1
@@ -58,7 +61,27 @@ def quantize_leaf(w, num_bits: int = 8, group_size: int = 128
         pairs = codes.reshape(*lead, ng, g // 2, 2, out)
         lo, hi = pairs[..., 0, :], pairs[..., 1, :]
         codes = ((lo & 0x0F) | (hi << 4)).astype(np.int8)
+    elif num_bits == 6:
+        # FP6-class density (reference inference/v2 cuda_linear TC-FPx): four
+        # 6-bit codes pack into three bytes — 0.75 B/code, 62% of int8's
+        # weight stream and 37.5% of bf16's
+        quads = codes.reshape(*lead, ng, g // 4, 4, out).astype(np.uint8)
+        c0, c1, c2, c3 = (quads[..., j, :] for j in range(4))
+        b0 = (c0 & 0x3F) | ((c1 & 0x03) << 6)
+        b1 = ((c1 >> 2) & 0x0F) | ((c2 & 0x0F) << 4)
+        b2 = ((c2 >> 4) & 0x03) | ((c3 & 0x3F) << 2)
+        codes = np.stack([b0, b1, b2], axis=-2)  # (..., ng, g//4, 3, out)
+        codes = codes.reshape(*lead, ng, (g // 4) * 3, out).astype(np.int8)
     return jnp.asarray(codes), jnp.asarray(scale.astype(np.float32))
+
+
+def unpack6(u0, u1, u2):
+    """Unpack three byte planes (int32, 0..255) into four signed 6-bit codes."""
+    c0 = u0 & 0x3F
+    c1 = ((u0 >> 6) & 0x03) | ((u1 & 0x0F) << 2)
+    c2 = ((u1 >> 4) & 0x0F) | ((u2 & 0x03) << 4)
+    c3 = (u2 >> 2) & 0x3F
+    return tuple((c ^ 32) - 32 for c in (c0, c1, c2, c3))  # sign-extend
 
 
 def _dequant_leaf(codes, scale, num_bits: int, dtype):
@@ -67,6 +90,11 @@ def _dequant_leaf(codes, scale, num_bits: int, dtype):
         lo = ((codes.astype(jnp.int8) << 4) >> 4).astype(jnp.float32)
         hi = (codes.astype(jnp.int8) >> 4).astype(jnp.float32)
         x = jnp.stack([lo, hi], axis=-2).reshape(*lead, ng, gc * 2, out)
+    elif num_bits == 6:
+        q = codes.reshape(*lead, ng, gc // 3, 3, out).astype(jnp.int32) & 0xFF
+        cs = unpack6(q[..., 0, :], q[..., 1, :], q[..., 2, :])
+        x = jnp.stack(cs, axis=-2).astype(jnp.float32)
+        x = x.reshape(*lead, ng, (gc // 3) * 4, out)
     else:
         x = codes.astype(jnp.float32)
     w = (x * scale).reshape(*lead, ng * x.shape[-2], out)
@@ -82,9 +110,9 @@ def dequant_params(d: Dict, dtype) -> Dict:
     for k, v in d.items():
         if k.endswith("::scale"):
             continue
-        if k.endswith("::q8") or k.endswith("::q4"):
-            base = k.rsplit("::", 1)[0]
-            bits = 4 if k.endswith("::q4") else 8
+        if k.endswith(("::q8", "::q6", "::q4")):
+            base, suffix = k.rsplit("::", 1)
+            bits = int(suffix[1:])
             out[base] = _dequant_leaf(v, d[base + "::scale"], bits, dtype)
         else:
             out[k] = v
@@ -98,8 +126,8 @@ def quantize_param_tree(params: Dict, num_bits: int = 8, group_size: int = 128,
     Only ``blocks`` leaves named in ``targets`` (>=2-D, floating) are
     converted; everything else passes through unchanged.
     """
-    if num_bits not in (4, 8):
-        raise ValueError(f"num_bits must be 4 or 8, got {num_bits}")
+    if num_bits not in (4, 6, 8):
+        raise ValueError(f"num_bits must be 4, 6 or 8, got {num_bits}")
     out = dict(params)
     blocks = params.get("blocks")
     if blocks is None:

@@ -39,7 +39,8 @@ class AsyncCheckpointEngine(NativeCheckpointEngine):
         self._enq_seq = 0    # items handed to the queue
         self._done_seq = 0   # items fully executed (FIFO ⇒ monotone)
         self._inflight = {}  # path -> newest enqueued seq for that path
-        self._errors = []    # exceptions, surfaced at wait()
+        self._errors = []    # (seq, path, exception), surfaced at wait()
+        self._prev_task_seq = 0  # seq of the last executed ordered task
         self._thread = threading.Thread(
             target=self._drain, name="dstpu-async-ckpt", daemon=True)
         self._thread.start()
@@ -62,22 +63,28 @@ class AsyncCheckpointEngine(NativeCheckpointEngine):
                 return
             seq, fn, path = item
             try:
-                with self._cv:
-                    poisoned = bool(self._errors) and path is None
+                poisoned = False
+                if path is None:
+                    # ordered side-effect (the `latest` pointer write): skip
+                    # it iff a save IN ITS OWN WINDOW — enqueued after the
+                    # previous task, before this one — failed, or `latest`
+                    # would advance onto a tag with missing files. Earlier
+                    # windows' errors must NOT freeze later, successful tags.
+                    with self._cv:
+                        lo = self._prev_task_seq
+                        poisoned = any(lo < es < seq
+                                       for es, _p, _e in self._errors)
+                        self._prev_task_seq = seq
                 if poisoned:
-                    # a queued SAVE failed earlier: ordered side-effects (the
-                    # `latest` pointer write) must not run, or `latest` would
-                    # advance onto a tag with missing files — saves for later
-                    # tags still proceed; the error surfaces at wait()/load()
                     logger.error(
-                        "[AsyncCheckpointEngine] skipping queued task after "
-                        "earlier save failure")
+                        "[AsyncCheckpointEngine] skipping queued task: a save "
+                        "in its batch failed (error surfaces at wait())")
                 else:
                     fn()
             except Exception as e:
                 logger.error(f"[AsyncCheckpointEngine] write failed: {e}")
                 with self._cv:
-                    self._errors.append(e)
+                    self._errors.append((seq, path, e))
             finally:
                 with self._cv:
                     self._done_seq = seq
@@ -108,19 +115,29 @@ class AsyncCheckpointEngine(NativeCheckpointEngine):
         used for ordered side-effects like the ``latest`` pointer write."""
         self._enqueue(fn)
 
-    def wait(self, path=None):
+    def wait(self, path=None, raise_errors=True):
         """Block until the newest save for ``path`` (or the whole queue) has
-        fully hit disk; re-raise the first writer error."""
+        fully hit disk. With ``raise_errors``, re-raise the first stored
+        writer error — scoped to ``path`` when one is given, so a load of an
+        intact checkpoint is not failed by an earlier unrelated save error."""
         with self._cv:
             target = self._inflight.get(path, 0) if path is not None \
                 else self._enq_seq
             self._cv.wait_for(lambda: self._done_seq >= target)
-            if self._errors:
-                raise RuntimeError("async checkpoint save failed") \
-                    from self._errors.pop(0)
+            if not raise_errors:
+                for _s, p, e in self._errors:
+                    logger.error(
+                        f"[AsyncCheckpointEngine] pending save error for "
+                        f"{p}: {e}")
+                return
+            for i, (_s, p, e) in enumerate(self._errors):
+                if path is None or p == path:
+                    del self._errors[i]
+                    raise RuntimeError(
+                        f"async checkpoint save of {p} failed") from e
 
     def load(self, path, map_location=None):
-        self.wait(path)
+        self.wait(path)  # read-your-writes; raises only THIS path's error
         return super().load(path, map_location)
 
     def commit(self, tag) -> bool:

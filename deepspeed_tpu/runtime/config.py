@@ -214,6 +214,10 @@ class DeepSpeedConfig:
 
         # --- gradients ---
         self.gradient_clipping = float(pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
+        if self.gradient_clipping < 0:
+            raise ValueError(
+                f"gradient_clipping must be >= 0 (0 disables), got "
+                f"{self.gradient_clipping}")
         self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
         self.gradient_predivide_factor = pd.get(
             C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT

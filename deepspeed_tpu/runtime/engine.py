@@ -1579,8 +1579,13 @@ class DeepSpeedEngine:
         self.checkpoint_engine.commit(tag)
         if save_latest and jax.process_index() == 0:
             def _write_latest():
-                with open(os.path.join(save_dir, "latest"), "w") as f:
+                # tmp → os.replace: a crash mid-write must never leave a
+                # truncated `latest` shadowing the previous complete pointer
+                final = os.path.join(save_dir, "latest")
+                tmp = final + ".tmp"
+                with open(tmp, "w") as f:
                     f.write(str(tag))
+                os.replace(tmp, final)
 
             if hasattr(self.checkpoint_engine, "enqueue_task"):
                 # async engine: the pointer write rides the FIFO queue, so
@@ -1597,8 +1602,10 @@ class DeepSpeedEngine:
                         load_module_only=False):
         if hasattr(self.checkpoint_engine, "wait"):
             # async engine: completion barrier — `latest` and all tag files
-            # must be on disk before we read them back
-            self.checkpoint_engine.wait()
+            # must be on disk before we read them back. Errors from earlier
+            # unrelated saves are logged, not raised: they must not fail a
+            # load of a checkpoint that IS complete on disk.
+            self.checkpoint_engine.wait(raise_errors=False)
         if self.config.load_universal_checkpoint and os.path.exists(
                 os.path.join(load_dir, "universal_meta.pkl")):
             from ..checkpoint.universal import load_universal_into_engine

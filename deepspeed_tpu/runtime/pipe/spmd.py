@@ -88,7 +88,12 @@ def spmd_pipeline(
         def feed_at(i):
             return jax.tree.map(lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), feed)
 
-        # state template from the first microbatch (cheap: traced shapes only)
+        # ingestion states for ALL microbatches, computed ONCE (vectorized)
+        # before the scan: the per-tick body previously ran first_fn (embed /
+        # prefix layers) on EVERY stage EVERY tick — (T·P - M) wasted
+        # applications that sat on the critical path (r3 pipe row at 0.748 of
+        # ideal 1F1B). Same for last_fn below.
+        states0 = jax.vmap(lambda f: first_fn(params, f))(feed)
         state_shape = jax.eval_shape(lambda: first_fn(params, feed_at(0)))
         zsrc = stages_local if stages_local is not None else params
         zvar = sum(jnp.sum(x) * 0.0 for x in jax.tree.leaves(zsrc)
@@ -101,12 +106,13 @@ def spmd_pipeline(
                               state_shape)
 
         def tick(carry, t):
-            state, loss_sum, denom, aux_sum = carry
+            state, aux_sum = carry
             in_idx = jnp.clip(t, 0, M - 1)
             # stage s holds microbatch t - s (ingested s ticks ago at stage 0)
             here_idx = jnp.clip(t - sid, 0, M - 1)
-            out_idx = jnp.clip(t - (P_ - 1), 0, M - 1)
-            x0 = first_fn(params, feed_at(in_idx))
+            x0 = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, in_idx, 0, keepdims=False),
+                states0)
             is_first = (sid == 0)
             x_in = jax.tree.map(
                 lambda a, b: jnp.where(is_first, a, b), x0, state
@@ -123,20 +129,28 @@ def spmd_pipeline(
             # validity of the microbatch currently at this stage: mb = t - sid
             valid_here = (t - sid >= 0) & (t - sid < M)
             aux_sum = aux_sum + jnp.where(valid_here, aux, 0.0)
-            l, d = last_fn(params, y, feed_at(out_idx))
-            is_last = (sid == P_ - 1)
-            valid_out = (t - (P_ - 1) >= 0) & is_last
-            loss_sum = loss_sum + jnp.where(valid_out, l, 0.0)
-            denom = denom + jnp.where(valid_out, d, 0.0)
             state = lax.ppermute(y, axis, [(i, (i + 1) % P_) for i in range(P_)])
-            return (state, loss_sum, denom, aux_sum), None
+            return (state, aux_sum), y
 
         tick_fn = jax.checkpoint(tick) if remat else tick
         zf = zvar.astype(jnp.float32)
-        init = (state0, zf, zf, zf)
-        (state, loss_sum, denom, aux_sum), _ = lax.scan(tick_fn, init, jnp.arange(T))
-        loss_sum = lax.psum(loss_sum, axis)
-        denom = lax.psum(denom, axis)
+        init = (state0, zf)
+        (state, aux_sum), ys = lax.scan(tick_fn, init, jnp.arange(T))
+        # microbatch m exits the LAST stage at tick m + P - 1, so on that
+        # stage the final M tick outputs are the completed activations; the
+        # head + loss run after the scan — M applications instead of T·P
+        # per-tick ones across the stages. lax.map (sequential), NOT vmap:
+        # the vocab-logits buffer materializes for ONE microbatch at a time,
+        # exactly like the dp path's per-microbatch head, instead of an
+        # (M·tokens, vocab) peak on every stage. Other stages run it on their
+        # own (masked-out) ys — same wall time as last-stage-only, they would
+        # otherwise idle at the psum barrier.
+        ys_m = jax.tree.map(lambda a: a[P_ - 1:], ys)
+        losses, denoms = lax.map(lambda yf: last_fn(params, yf[0], yf[1]),
+                                 (ys_m, feed))
+        is_last = (sid == P_ - 1)
+        loss_sum = lax.psum(jnp.where(is_last, jnp.sum(losses), 0.0), axis)
+        denom = lax.psum(jnp.where(is_last, jnp.sum(denoms), 0.0), axis)
         aux_sum = lax.psum(aux_sum, axis)
         loss = loss_sum / jnp.maximum(denom, 1.0)
         # each microbatch visits every stage once, so Σ aux over (stage, tick)

@@ -1,0 +1,136 @@
+"""TP-sharded inference checkpoint sets: save at degree N, serve at degree M.
+
+Reference: ``deepspeed/runtime/state_dict_factory.py`` (``SDLoaderBase`` and
+the Megatron loader: N per-rank ``mp_rank_XX_model_states.pt`` files holding
+each rank's shard of the TP-partitioned weights; on load the factory merges
+or splits them to the serving MP degree, ``:1-427``).
+
+TPU design: the split axes come from the model's ``tp_specs`` — a leaf whose
+PartitionSpec names the ``model`` axis is stored shard-by-shard along that
+dim; everything else (norms, biases, replicated embeddings) lives once, in
+the rank-0 file. Loading MERGES to the full global tree; re-serving at any
+degree M is then just ``init_inference(..., tp_size=M)`` — GSPMD re-splits
+on device placement, so N→M needs no explicit resharding code path and the
+result is logits-exact by construction (values are unchanged, only the
+device layout differs).
+"""
+
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+from .checkpoint_engine.native_checkpoint_engine import NativeCheckpointEngine
+
+_FILE_RE = re.compile(r"mp_rank_(\d+)_model_states\.ckpt$")
+
+
+def _rank_path(d: str, rank: int) -> str:
+    return os.path.join(d, f"mp_rank_{rank:02d}_model_states.ckpt")
+
+
+def _split_dim_of(spec, ndim: int, axis_name: str = "model") -> int:
+    """Dim index the ``model`` axis shards, or -1 if the leaf is replicated."""
+    if spec is None:
+        return -1
+    for i, entry in enumerate(tuple(spec)):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis_name in [n for n in names if n]:
+            return i
+    return -1
+
+
+def _flatten_with_specs(params: Dict, tp_specs: Optional[Dict]):
+    """Yield (dotted_path, leaf, split_dim) for every array leaf."""
+    flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs_by_path = {}
+    if tp_specs is not None:
+        from jax.sharding import PartitionSpec
+
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+                tp_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))[0]:
+            specs_by_path[jax.tree_util.keystr(path)] = spec
+    for path, leaf in flat_p:
+        key = jax.tree_util.keystr(path)
+        arr = np.asarray(jax.device_get(leaf))
+        yield key, path, arr, _split_dim_of(specs_by_path.get(key), arr.ndim)
+
+
+def save_mp_sharded(params: Dict, tp_specs: Optional[Dict], mp_degree: int,
+                    save_dir: str, engine=None) -> None:
+    """Write an ``mp_rank_XX_model_states.ckpt`` set at TP degree ``mp_degree``.
+
+    Leaves whose tp_spec names the ``model`` axis are split along that dim
+    (one shard per rank file); replicated leaves are stored once, in rank 0.
+    """
+    engine = engine or NativeCheckpointEngine()
+    os.makedirs(save_dir, exist_ok=True)
+    per_rank = [{"tp_degree": mp_degree, "shards": {}, "axes": {}}
+                for _ in range(mp_degree)]
+    for key, _path, arr, dim in _flatten_with_specs(params, tp_specs):
+        if dim >= 0 and arr.ndim > dim and arr.shape[dim] % mp_degree == 0:
+            for r, piece in enumerate(np.split(arr, mp_degree, axis=dim)):
+                per_rank[r]["shards"][key] = np.ascontiguousarray(piece)
+                per_rank[r]["axes"][key] = dim
+        else:
+            per_rank[0]["shards"][key] = arr
+            per_rank[0]["axes"][key] = -1
+    for r in range(mp_degree):
+        engine.save(per_rank[r], _rank_path(save_dir, r))
+    logger.info(f"saved mp-sharded checkpoint set (degree {mp_degree}) "
+                f"to {save_dir}")
+
+
+def detect_mp_degree(load_dir: str) -> int:
+    ranks = sorted(int(m.group(1)) for f in os.listdir(load_dir)
+                   if (m := _FILE_RE.search(f)))
+    if not ranks or ranks != list(range(len(ranks))):
+        raise FileNotFoundError(
+            f"no contiguous mp_rank_XX_model_states.ckpt set in {load_dir} "
+            f"(found ranks {ranks})")
+    return len(ranks)
+
+
+def load_mp_merged(load_dir: str, params_template: Dict, engine=None) -> Dict:
+    """Read an N-rank set and reassemble the FULL global param tree in the
+    structure of ``params_template`` (reference SDLoader merge path). Serving
+    at any other degree M is then ``init_inference(..., tp_size=M)``."""
+    engine = engine or NativeCheckpointEngine()
+    n = detect_mp_degree(load_dir)
+    rank_sds = [engine.load(_rank_path(load_dir, r)) for r in range(n)]
+    merged = {}
+    for key, axis in rank_sds[0]["axes"].items():
+        if axis < 0:
+            merged[key] = rank_sds[0]["shards"][key]
+    # sharded leaves: every rank holds a piece under the same key
+    for key in {k for sd in rank_sds for k in sd["axes"] if sd["axes"][k] >= 0}:
+        axis = next(sd["axes"][key] for sd in rank_sds if key in sd["axes"])
+        merged[key] = np.concatenate(
+            [sd["shards"][key] for sd in rank_sds], axis=axis)
+
+    flat_template = jax.tree_util.tree_flatten_with_path(params_template)
+    leaves = []
+    for path, leaf in flat_template[0]:
+        key = jax.tree_util.keystr(path)
+        if key not in merged:
+            raise KeyError(f"checkpoint set missing leaf {key}")
+        arr = merged[key]
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"leaf {key}: checkpoint shape {arr.shape} != model "
+                f"{tuple(leaf.shape)} — wrong model config for this set?")
+        leaves.append(arr.astype(np.asarray(leaf).dtype)
+                      if hasattr(leaf, "dtype") else arr)
+    return jax.tree_util.tree_unflatten(flat_template[1], leaves)
+
+
+def reshard_mp_checkpoint(load_dir: str, save_dir: str, params_template: Dict,
+                          tp_specs: Optional[Dict], new_degree: int,
+                          engine=None) -> None:
+    """Offline N→M resharding of a checkpoint set (reference SDLoader
+    merge/split): merge to global, re-split at ``new_degree``."""
+    merged = load_mp_merged(load_dir, params_template, engine=engine)
+    save_mp_sharded(merged, tp_specs, new_degree, save_dir, engine=engine)

@@ -3,6 +3,21 @@ benches, and tests (everything that self-provisions a virtual CPU device mesh).
 """
 
 
+#: stability flags for the virtual CPU mesh on oversubscribed hosts:
+#: - the concurrency-optimized thunk scheduler reorders independent
+#:   collectives differently per device → cyclic rendezvous deadlocks
+#:   (observed round 3/4); the sequential scheduler is deterministic AND
+#:   faster on few-core hosts
+#: - the 40 s default rendezvous termination fires spuriously when 8 device
+#:   threads timeshare one vCPU under heavy programs — raise to 300 s
+VIRTUAL_MESH_STABILITY_FLAGS = (
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=300",
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=60",
+    "--xla_cpu_collective_timeout_seconds=300",
+)
+
+
 def force_device_count_flags(flags: str, n: int) -> str:
     """Return ``flags`` with any existing host-platform device-count flag
     replaced by ``--xla_force_host_platform_device_count=n``."""
@@ -10,3 +25,13 @@ def force_device_count_flags(flags: str, n: int) -> str:
         f for f in flags.split() if "xla_force_host_platform_device_count" not in f
     )
     return (kept + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def virtual_mesh_flags(flags: str, n: int) -> str:
+    """Device-count flag plus the stability flags (deduplicated) — the one
+    call every virtual-mesh entry point (conftest, gate, benches) should use."""
+    out = force_device_count_flags(flags, n)
+    for f in VIRTUAL_MESH_STABILITY_FLAGS:
+        if f.split("=")[0] not in out:
+            out += " " + f
+    return out

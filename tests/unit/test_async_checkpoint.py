@@ -65,7 +65,7 @@ class TestAsyncEngineUnit:
         blocker = tmp_path / "not-a-dir"
         blocker.write_text("file, not dir")  # makedirs under a file must fail
         eng.save({"x": np.zeros(2)}, str(blocker / "sub" / "x.ckpt"))
-        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        with pytest.raises(RuntimeError, match="async checkpoint save of"):
             eng.wait()
         eng.close()
 
@@ -227,3 +227,39 @@ def test_normal_exit_drains_queue(tmp_path):
     assert proc.returncode == 0, f"child: {proc.stderr[-2000:]}"
     assert (tmp_path / "latest").read_text().strip() == "final"
     assert os.path.exists(tmp_path / "final" / "model_states.ckpt")
+
+
+def test_later_tags_recover_after_one_failed_save(tmp_path):
+    """A transient save failure must not freeze `latest` forever: the next
+    save_checkpoint batch (its own window) succeeds and its ordered task runs
+    (review r4 round 2)."""
+    eng = AsyncCheckpointEngine()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    ran = []
+    # window 1: failing save + its task → task skipped
+    eng.save({"x": np.zeros(2)}, str(blocker / "t5" / "model.ckpt"))
+    eng.enqueue_task(lambda: ran.append("t5"))
+    # window 2: healthy save + its task → task RUNS despite the old error
+    eng.save({"x": np.ones(2)}, str(tmp_path / "t6.ckpt"))
+    eng.enqueue_task(lambda: ran.append("t6"))
+    with pytest.raises(RuntimeError):
+        eng.wait()
+    assert ran == ["t6"]
+    assert os.path.exists(tmp_path / "t6.ckpt")
+    eng.close()
+
+
+def test_load_unaffected_by_unrelated_save_error(tmp_path):
+    """wait(path)/load(path) must not raise another path's stored error."""
+    eng = AsyncCheckpointEngine()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    good = str(tmp_path / "good.ckpt")
+    eng.save({"x": np.zeros(2)}, str(blocker / "bad" / "x.ckpt"))
+    eng.save({"x": np.arange(3)}, good)
+    out = eng.load(good)  # must succeed despite the bad save's error
+    np.testing.assert_array_equal(out["x"], np.arange(3))
+    with pytest.raises(RuntimeError):
+        eng.wait()  # the unscoped barrier still surfaces it
+    eng.close()

