@@ -25,10 +25,10 @@ def _run_cpu_subprocess(name: str) -> dict:
     be pinned in-Python before first backend use (sitecustomize force-loads a
     hardware plugin), which the __main__ hook of this file does for
     CPU/AUX configs — this helper only prepares env + parses the JSON line."""
-    from deepspeed_tpu.utils.xla_env import force_device_count_flags
+    from deepspeed_tpu.utils.xla_env import virtual_mesh_flags
 
     env = dict(os.environ)
-    env["XLA_FLAGS"] = force_device_count_flags(env.get("XLA_FLAGS", ""), 8)
+    env["XLA_FLAGS"] = virtual_mesh_flags(env.get("XLA_FLAGS", ""), 8)
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__), name],
