@@ -78,3 +78,58 @@ class TestInferenceEngine:
                                params=params)
         out_1 = eng1.generate(ids, max_new_tokens=4, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(out_tp), np.asarray(out_1))
+
+
+class TestSamplingControls:
+    """_sample_logits semantics (reference engine sampling paths: greedy /
+    temperature / top-k / top-p)."""
+
+    def _logits(self):
+        # deliberately shaped distribution: token 3 dominant, 1 and 0 next
+        base = np.full((1, 8), -10.0, np.float32)
+        base[0, 3], base[0, 1], base[0, 0] = 5.0, 3.0, 2.0
+        return jnp.asarray(base)
+
+    def test_greedy_ignores_rng(self):
+        from deepspeed_tpu.inference.engine import _sample_logits
+
+        lg = self._logits()
+        a = _sample_logits(lg, jax.random.PRNGKey(0), 0.0, 0, 1.0)
+        b = _sample_logits(lg, jax.random.PRNGKey(7), 0.0, 0, 1.0)
+        assert int(a[0]) == int(b[0]) == 3
+
+    def test_top_k_restricts_support(self):
+        from deepspeed_tpu.inference.engine import _sample_logits
+
+        lg = self._logits()
+        seen = {int(_sample_logits(lg, jax.random.PRNGKey(s), 5.0, 2, 1.0)[0])
+                for s in range(64)}
+        assert seen <= {3, 1}  # k=2 keeps only the two best tokens
+        assert 3 in seen
+
+    def test_top_p_restricts_support(self):
+        from deepspeed_tpu.inference.engine import _sample_logits
+
+        lg = self._logits()
+        # p small enough that only the dominant token's mass is needed
+        seen = {int(_sample_logits(lg, jax.random.PRNGKey(s), 1.0, 0, 0.5)[0])
+                for s in range(32)}
+        assert seen == {3}
+
+    def test_high_temperature_spreads_support(self):
+        from deepspeed_tpu.inference.engine import _sample_logits
+
+        lg = self._logits()
+        seen = {int(_sample_logits(lg, jax.random.PRNGKey(s), 100.0, 0, 1.0)[0])
+                for s in range(128)}
+        assert len(seen) > 3  # near-uniform at huge temperature
+
+    def test_generate_trace_cache_keyed_by_options(self, tiny_model):
+        topo_mod.reset_topology()
+        eng = deepspeed_tpu.init_inference(tiny_model, dtype="fp32")
+        ids = jnp.asarray(np.random.default_rng(0).integers(0, 100, (1, 8)),
+                          jnp.int32)
+        eng.generate(ids, max_new_tokens=4, temperature=0.0)
+        eng.generate(ids, max_new_tokens=4, temperature=0.8, top_k=5)
+        eng.generate(ids, max_new_tokens=4, temperature=0.8, top_k=5)  # cached
+        assert len(eng._decode_fns) == 2

@@ -1,0 +1,136 @@
+"""Direct LR-schedule behavior tests (reference
+tests/unit/runtime/test_lr_schedulers.py — shape-of-curve assertions for all
+five schedules, plus state_dict resume)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    SCHEDULE_CLASSES,
+    LRRangeTest,
+    OneCycle,
+    WarmupCosineLR,
+    WarmupDecayLR,
+    WarmupLR,
+    build_lr_scheduler,
+)
+
+
+class _Opt:
+    lr = 0.1
+
+
+def _curve(sched, n):
+    out = []
+    for _ in range(n):
+        sched.step()
+        out.append(sched.get_last_lr()[0])
+    return out
+
+
+class TestLRRangeTest:
+    def test_continuous_ramp(self):
+        s = LRRangeTest(_Opt(), lr_range_test_min_lr=1e-3,
+                        lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+        lrs = _curve(s, 25)
+        assert lrs[0] == pytest.approx(1e-3)
+        assert all(b > a for a, b in zip(lrs, lrs[1:]))  # monotone ramp
+        assert lrs[9] == pytest.approx(1e-3 * (1 + 9 / 10))
+
+    def test_staircase_holds_within_interval(self):
+        s = LRRangeTest(_Opt(), lr_range_test_min_lr=1e-3,
+                        lr_range_test_step_size=5,
+                        lr_range_test_staircase=True)
+        lrs = _curve(s, 12)
+        assert len(set(np.round(lrs[:5], 12))) == 1   # flat first stair
+        assert lrs[5] > lrs[4]                        # jumps at the boundary
+
+
+class TestOneCycle:
+    def test_triangle_then_decay(self):
+        s = OneCycle(_Opt(), cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, decay_lr_rate=0.5)
+        lrs = _curve(s, 30)  # lrs[i] is the LR at iteration i
+        peak = int(np.argmax(lrs))
+        assert peak == 10  # top of the first ramp (pct=1 at it=first_size)
+        assert lrs[peak] == pytest.approx(0.1, rel=1e-6)
+        # down-ramp returns to min at the end of the cycle (it=total_size)
+        assert lrs[20] == pytest.approx(0.01, rel=1e-6)
+        # decay phase shrinks below the cycle min
+        assert lrs[-1] < 0.01
+
+    def test_asymmetric_cycle(self):
+        s = OneCycle(_Opt(), cycle_min_lr=0.0, cycle_max_lr=1.0,
+                     cycle_first_step_size=4, cycle_second_step_size=8)
+        lrs = _curve(s, 12)
+        assert int(np.argmax(lrs)) == 4
+        # second leg takes twice as long to come down: halfway at it=4+4
+        assert lrs[8] == pytest.approx(0.5, abs=1e-6)
+
+
+class TestWarmup:
+    def test_linear_warmup_then_hold(self):
+        s = WarmupLR(_Opt(), warmup_min_lr=0.0, warmup_max_lr=0.1,
+                     warmup_num_steps=10, warmup_type="linear")
+        lrs = _curve(s, 20)
+        assert lrs[4] == pytest.approx(0.1 * 4 / 10)  # gamma = it/steps
+        assert lrs[-1] == pytest.approx(0.1)
+        assert all(abs(x - 0.1) < 1e-12 for x in lrs[10:])
+
+    def test_log_warmup_faster_than_linear_early(self):
+        log = WarmupLR(_Opt(), warmup_max_lr=0.1, warmup_num_steps=100,
+                       warmup_type="log")
+        lin = WarmupLR(_Opt(), warmup_max_lr=0.1, warmup_num_steps=100,
+                       warmup_type="linear")
+        llog, llin = _curve(log, 10), _curve(lin, 10)
+        assert all(a > b for a, b in zip(llog[1:], llin[1:]))
+
+    def test_invalid_warmup_type(self):
+        with pytest.raises(ValueError, match="warmup_type"):
+            WarmupLR(_Opt(), warmup_type="exponential")
+
+    def test_decay_reaches_zero(self):
+        s = WarmupDecayLR(_Opt(), total_num_steps=20, warmup_max_lr=0.1,
+                          warmup_num_steps=5, warmup_type="linear")
+        lrs = _curve(s, 25)
+        assert max(lrs) == pytest.approx(0.1, rel=1e-6)
+        assert lrs[20] == pytest.approx(0.0, abs=1e-9)  # it=total_num_steps
+        assert all(x == 0.0 for x in lrs[20:])
+
+    def test_cosine_endpoints(self):
+        s = WarmupCosineLR(_Opt(), total_num_steps=100, warmup_num_steps=10,
+                           cos_min_ratio=0.01)
+        lrs = _curve(s, 100)
+        assert max(lrs) == pytest.approx(0.1, rel=1e-2)  # peak ≈ base lr
+        # last measured it=99 sits one step above the exact floor (it=100)
+        assert lrs[-1] == pytest.approx(0.1 * 0.01, rel=5e-2)
+        # monotone decreasing after warmup
+        post = lrs[11:]
+        assert all(b <= a + 1e-12 for a, b in zip(post, post[1:]))
+
+
+class TestResume:
+    @pytest.mark.parametrize("name", sorted(SCHEDULE_CLASSES))
+    def test_state_dict_resume_continues_curve(self, name):
+        params = {
+            "LRRangeTest": {},
+            "OneCycle": {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1},
+            "WarmupLR": {},
+            "WarmupDecayLR": {"total_num_steps": 50},
+            "WarmupCosineLR": {"total_num_steps": 50},
+        }[name]
+        a = build_lr_scheduler(name, _Opt(), dict(params))
+        full = _curve(a, 30)
+        b = build_lr_scheduler(name, _Opt(), dict(params))
+        _curve(b, 12)
+        c = build_lr_scheduler(name, _Opt(), dict(params))
+        c.load_state_dict(b.state_dict())
+        resumed = _curve(c, 18)
+        np.testing.assert_allclose(resumed, full[12:], rtol=1e-12)
+
+    def test_build_unknown_raises(self):
+        with pytest.raises((KeyError, ValueError)):
+            build_lr_scheduler("cyclic_sawtooth", _Opt(), {})
