@@ -107,7 +107,22 @@ def load_universal_into_engine(engine, universal_dir: str):
     have_moments = True
     for i, name in enumerate(names):
         pdir = os.path.join(zdir, name.replace("/", "."))
-        w = np.load(os.path.join(pdir, "fp32.npy"))
+        tmpl_shape = tuple(flat[i][1].shape)
+
+        def fit(w, name=name, tmpl_shape=tmpl_shape):
+            # same values, different stacking: e.g. a dp checkpoint's (L, ...)
+            # blocks reload into a pipeline engine's (P, L/P, ...) layout (and
+            # back) — the layer order is identical, only the leading dims split
+            if tuple(w.shape) != tmpl_shape:
+                if w.size != int(np.prod(tmpl_shape)):
+                    raise ValueError(
+                        f"universal leaf {name}: stored shape {w.shape} has "
+                        f"{w.size} elements but the engine expects "
+                        f"{tmpl_shape}")
+                w = w.reshape(tmpl_shape)
+            return w
+
+        w = fit(np.load(os.path.join(pdir, "fp32.npy")))
         new_params.append(jax.device_put(
             jnp.asarray(w, engine.compute_dtype), shard_flat[i]))
         if engine._mixed:
@@ -115,9 +130,10 @@ def load_universal_into_engine(engine, universal_dir: str):
                                              opt_shard_flat[i]))
         m_path = os.path.join(pdir, "exp_avg.npy")
         if os.path.exists(m_path):
-            new_m.append(jax.device_put(jnp.asarray(np.load(m_path)), opt_shard_flat[i]))
+            new_m.append(jax.device_put(
+                jnp.asarray(fit(np.load(m_path))), opt_shard_flat[i]))
             new_v.append(jax.device_put(
-                jnp.asarray(np.load(os.path.join(pdir, "exp_avg_sq.npy"))),
+                jnp.asarray(fit(np.load(os.path.join(pdir, "exp_avg_sq.npy")))),
                 opt_shard_flat[i]))
         else:
             have_moments = False
