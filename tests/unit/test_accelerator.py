@@ -1,0 +1,67 @@
+"""Accelerator seam tests (reference tests/unit/accelerator/ +
+``real_accelerator.py`` selection/override behavior)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.accelerator.cpu_accelerator import CPU_Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import (
+    _validate_accelerator_name,
+    is_current_accelerator_supported,
+    set_accelerator,
+)
+
+
+class TestSelection:
+    def test_ds_accelerator_env_selects_cpu(self):
+        # conftest sets DS_ACCELERATOR=cpu; the singleton honored it
+        acc = get_accelerator()
+        assert acc.name == "cpu"
+        assert is_current_accelerator_supported()
+
+    def test_invalid_name_rejected(self):
+        with pytest.raises(ValueError, match="not in supported list"):
+            _validate_accelerator_name("cuda")
+
+    def test_set_accelerator_overrides_singleton(self):
+        prev = get_accelerator()
+        try:
+            override = CPU_Accelerator()
+            set_accelerator(override)
+            assert get_accelerator() is override
+        finally:
+            set_accelerator(prev)
+
+
+class TestCpuAccelerator:
+    def test_device_surface(self):
+        acc = CPU_Accelerator()
+        assert acc.device_count() >= 1
+        assert acc.is_synchronized_device() in (True, False)
+        assert "cpu" in acc.device_name(0)
+        assert acc.communication_backend_name()
+
+    def test_precision_support_flags(self):
+        acc = CPU_Accelerator()
+        assert acc.is_bf16_supported() is True  # XLA CPU emulates bf16
+
+    def test_memory_stats_are_sane(self):
+        acc = CPU_Accelerator()
+        total = acc.total_memory(0)
+        # CPU backend: host memory or 0 (unknown) — never negative
+        assert total >= 0
+        assert acc.memory_allocated(0) >= 0
+
+    def test_rng_is_deterministic(self):
+        acc = CPU_Accelerator()
+        a = acc.default_rng(7)
+        b = acc.default_rng(7)
+        # jax PRNG keys (arrays) or numpy generators — both must agree
+        if hasattr(a, "standard_normal"):
+            assert a.standard_normal() == b.standard_normal()
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_synchronize_is_callable(self):
+        CPU_Accelerator().synchronize()

@@ -1,0 +1,105 @@
+"""Timer utility tests (reference tests/unit/utils/ timer coverage:
+SynchronizedWallClockTimer semantics, ThroughputTimer counters, trim_mean)."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.utils.timer import (
+    NoopTimer,
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+    trim_mean,
+)
+
+
+class TestWallClockTimer:
+    def test_elapsed_accumulates_and_resets(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("fwd")
+        t.start()
+        time.sleep(0.02)
+        t.stop()
+        e1 = t.elapsed(reset=False)
+        assert e1 >= 0.015
+        t.start()
+        time.sleep(0.01)
+        t.stop()
+        assert t.elapsed(reset=True) > e1  # accumulated
+        assert t.elapsed(reset=False) == 0.0  # reset cleared it
+
+    def test_named_timers_are_singletons(self):
+        timers = SynchronizedWallClockTimer()
+        assert timers("a") is timers("a")
+        assert timers("a") is not timers("b")
+        assert timers.has_timer("a") and not timers.has_timer("zz")
+
+    def test_mean_over_records(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("step")
+        for _ in range(3):
+            t.start()
+            time.sleep(0.005)
+            t.stop()
+        assert t.mean() > 0
+
+    def test_double_start_raises_or_guards(self):
+        timers = SynchronizedWallClockTimer()
+        t = timers("x")
+        t.start()
+        with pytest.raises(AssertionError):
+            t.start()
+
+    def test_noop_timer_is_inert(self):
+        nt = NoopTimer()
+        t = nt("anything")
+        t.start()
+        t.stop()
+        t.reset()
+        nt.log(["anything"])
+
+
+class TestThroughputTimer:
+    def test_counts_micro_and_global_steps(self):
+        tt = ThroughputTimer(batch_size=4, start_step=0, steps_per_output=0,
+                             logging_fn=lambda *a, **k: None)
+        for i in range(4):
+            tt.start()
+            time.sleep(0.002)
+            tt.stop(global_step=(i % 2 == 1))
+        assert tt.micro_step_count == 4
+        assert tt.global_step_count == 2
+        assert tt.total_elapsed_time > 0
+
+    def test_warmup_steps_not_timed(self):
+        tt = ThroughputTimer(batch_size=4, start_step=3, steps_per_output=0,
+                             logging_fn=lambda *a, **k: None)
+        tt.start()
+        time.sleep(0.002)
+        tt.stop(global_step=True)
+        assert tt.total_elapsed_time == 0  # still in warmup
+
+    def test_epoch_resets_micro_count(self):
+        tt = ThroughputTimer(batch_size=4, start_step=0,
+                             logging_fn=lambda *a, **k: None)
+        tt.start()
+        tt.stop(global_step=True)
+        tt.update_epoch_count()
+        assert tt.epoch_count == 1 and tt.micro_step_count == 0
+
+
+class TestTrimMean:
+    def test_plain_mean_at_zero_trim(self):
+        assert trim_mean([1, 2, 3, 4], 0.0) == 2.5
+
+    def test_tails_dropped(self):
+        data = [100.0] + [1.0] * 8 + [-50.0]
+        assert trim_mean(data, 0.1) == 1.0
+
+    def test_empty_and_overtrim(self):
+        assert trim_mean([], 0.5) == 0.0
+        assert trim_mean([7.0], 0.9) == 7.0  # falls back to full data
+
+    def test_invalid_percent_asserts(self):
+        with pytest.raises(AssertionError):
+            trim_mean([1.0], 1.5)
