@@ -1,0 +1,104 @@
+"""Elasticity algorithm tests (reference tests/unit/elasticity/test_elastic.py
+— candidate generation, valid-chip-count math, v0.1 vs v0.2 semantics)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    _get_compatible_gpus_v01,
+    _get_compatible_gpus_v02,
+    compute_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)
+
+
+class TestCandidates:
+    def test_power_of_two_gas_ladder(self):
+        # mb=3: 3,6,12,24,48 ≤ 50; mb=4: 4,8,16,32
+        out = get_candidate_batch_sizes([3, 4], 50)
+        assert out == sorted({3, 6, 12, 24, 48, 4, 8, 16, 32})
+
+    def test_dedup_across_micro_batches(self):
+        out = get_candidate_batch_sizes([2, 4], 8)
+        assert out == [2, 4, 8]  # 4 and 8 reachable from both
+
+    def test_max_boundary_inclusive(self):
+        assert 16 in get_candidate_batch_sizes([2], 16)
+        assert 32 not in get_candidate_batch_sizes([2], 16)
+
+
+class TestValidGpus:
+    def test_divisor_structure(self):
+        # bs=12, mb=3 → max_g 4 → g ∈ {1,2,4}; mb=4 → max_g 3 → {1,3}
+        assert get_valid_gpus(12, [3, 4], 1, 100) == [1, 2, 3, 4]
+
+    def test_min_max_window(self):
+        assert get_valid_gpus(12, [3, 4], 2, 3) == [2, 3]
+
+    def test_non_dividing_micro_batch_skipped(self):
+        assert get_valid_gpus(12, [5], 1, 100) == []
+
+
+class TestV01V02:
+    def test_v01_picks_most_elastic_batch(self):
+        gpus, bs = _get_compatible_gpus_v01([2, 4, 6], 48)
+        # the winner admits the largest set of chip counts
+        assert bs in get_candidate_batch_sizes([2, 4, 6], 48)
+        assert len(gpus) >= len(get_valid_gpus(8, [2, 4, 6], 1, 48))
+
+    def test_v02_micro_batch_prefers_larger(self):
+        gpus, bs, mb = _get_compatible_gpus_v02([2, 4, 6], 48,
+                                                current_num_gpus=4)
+        assert 4 in gpus
+        assert mb == max(m for m in [2, 4, 6] if bs % (m * 4) == 0)
+
+    def test_v02_prefer_smaller(self):
+        _, bs, mb = _get_compatible_gpus_v02([2, 4, 6], 48, current_num_gpus=4,
+                                             prefer_larger=False)
+        assert mb == min(m for m in [2, 4, 6] if bs % (m * 4) == 0)
+
+    def test_v02_rejects_incompatible_world(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            _get_compatible_gpus_v02([2], 4, current_num_gpus=3)
+
+
+class TestComputeElasticConfig:
+    def _cfg(self, **over):
+        base = {"enabled": True, "micro_batch_sizes": [2, 4, 6],
+                "max_acceptable_batch_size": 48, "version": 0.2}
+        base.update(over)
+        return {"elasticity": base}
+
+    def test_disabled_block_raises(self):
+        with pytest.raises(ElasticityConfigError, match="disabled"):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+
+    def test_constant_global_batch_across_world_sizes(self):
+        """The defining elastic property: nodes join/leave, batch stays."""
+        batches = set()
+        final0, valid = compute_elastic_config(self._cfg(), world_size=2)
+        for w in valid:
+            if w > 8:
+                continue
+            fb, _, mb = compute_elastic_config(self._cfg(), world_size=w,
+                                               return_microbatch=True)
+            batches.add(fb)
+            assert fb % (mb * w) == 0  # integral GAS at every size
+        assert batches == {final0}
+
+    def test_v01_path_without_world_size(self):
+        fb, valid = compute_elastic_config(self._cfg(version=0.1))
+        assert fb > 0 and valid
+
+    def test_v01_with_incompatible_world_raises(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(
+                self._cfg(version=0.1, micro_batch_sizes=[2],
+                          max_acceptable_batch_size=4), world_size=3)
+
+    def test_return_microbatch_requires_v02(self):
+        with pytest.raises(ElasticityConfigError, match="version"):
+            compute_elastic_config(self._cfg(version=0.1),
+                                   return_microbatch=True)
