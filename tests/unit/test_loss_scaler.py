@@ -1,0 +1,106 @@
+"""Loss-scaler + env-report + xla_env helper tests (reference
+tests/unit/runtime/half_precision loss-scale semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    LossScaler,
+    has_overflow,
+)
+
+
+def _run(scaler, state, overflows):
+    scales = []
+    for ov in overflows:
+        state = scaler.update(state, jnp.asarray(bool(ov)))
+        scales.append(float(state.cur_scale))
+    return state, scales
+
+
+class TestDynamicLossScaler:
+    def test_overflow_halves_scale(self):
+        s = DynamicLossScaler(init_scale=2**16, scale_factor=2.0,
+                              scale_window=1000)
+        state, scales = _run(s, s.init_state(), [True, True])
+        assert scales == [2**15, 2**14]
+
+    def test_growth_after_clean_window(self):
+        s = DynamicLossScaler(init_scale=2**8, scale_factor=2.0, scale_window=4)
+        _, scales = _run(s, s.init_state(), [False] * 9)
+        assert max(scales) > 2**8  # doubled within the window
+        assert scales[-1] >= 2 * 2**8
+
+    def test_min_scale_floor(self):
+        s = DynamicLossScaler(init_scale=4.0, scale_factor=2.0, min_scale=1.0)
+        _, scales = _run(s, s.init_state(), [True] * 5)
+        assert scales[-1] == 1.0  # floored, never below
+
+    def test_hysteresis_delays_shrink(self):
+        s = DynamicLossScaler(init_scale=2**10, delayed_shift=3)
+        _, scales = _run(s, s.init_state(), [True, True, True])
+        # two overflows consume hysteresis; only the third halves
+        assert scales == [2**10, 2**10, 2**9]
+
+    def test_hysteresis_resets_on_clean_step(self):
+        s = DynamicLossScaler(init_scale=2**10, delayed_shift=2,
+                              consecutive_hysteresis=False)
+        state = s.init_state()
+        state, _ = _run(s, state, [True])        # hysteresis 2 -> 1
+        state, _ = _run(s, state, [False])       # reset back to 2
+        _, scales = _run(s, state, [True, True])
+        assert scales == [2**10, 2**9]           # needs two overflows again
+
+    def test_consecutive_hysteresis_not_reset(self):
+        s = DynamicLossScaler(init_scale=2**10, delayed_shift=2,
+                              consecutive_hysteresis=True)
+        state = s.init_state()
+        state, _ = _run(s, state, [True])        # 2 -> 1
+        state, _ = _run(s, state, [False])       # stays 1
+        _, scales = _run(s, state, [True])
+        assert scales == [2**9]                  # next overflow halves
+
+
+class TestStaticScalerAndOverflow:
+    def test_static_scale_never_moves(self):
+        s = LossScaler(scale=128.0)
+        _, scales = _run(s, s.init_state(), [True, False, True])
+        assert scales == [128.0, 128.0, 128.0]
+
+    def test_has_overflow_detects_inf_and_nan(self):
+        clean = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+        assert not bool(has_overflow(clean))
+        assert bool(has_overflow({"a": jnp.asarray([1.0, np.inf])}))
+        assert bool(has_overflow({"a": jnp.asarray([np.nan])}))
+
+
+class TestEnvReport:
+    def test_op_and_debug_report_render(self, capsys):
+        from deepspeed_tpu.env_report import debug_report, op_report
+
+        op_report()
+        debug_report()
+        out = capsys.readouterr().out
+        assert "jax" in out.lower()
+        assert "version" in out.lower() or "platform" in out.lower()
+
+
+class TestXlaEnvHelpers:
+    def test_force_device_count_replaces_existing(self):
+        from deepspeed_tpu.utils.xla_env import force_device_count_flags
+
+        out = force_device_count_flags(
+            "--xla_force_host_platform_device_count=4 --other=1", 8)
+        assert "--xla_force_host_platform_device_count=8" in out
+        assert "count=4" not in out and "--other=1" in out
+
+    def test_virtual_mesh_flags_idempotent(self):
+        from deepspeed_tpu.utils.xla_env import virtual_mesh_flags
+
+        once = virtual_mesh_flags("", 8)
+        twice = virtual_mesh_flags(once, 8)
+        assert once.split().count(
+            "--xla_cpu_enable_concurrency_optimized_scheduler=false") == 1
+        assert twice.split().count(
+            "--xla_cpu_enable_concurrency_optimized_scheduler=false") == 1
