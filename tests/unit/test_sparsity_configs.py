@@ -1,0 +1,91 @@
+"""Sparsity-config layout tests (reference
+tests/unit/ops/sparse_attention/test_sparsity_config-style structural
+assertions for every config family)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+)
+
+H, BLOCK, SEQ = 2, 16, 128  # 8x8 block grid
+N = SEQ // BLOCK
+
+
+class TestStructure:
+    def test_dense_is_all_true(self):
+        lo = DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(SEQ)
+        assert lo.shape == (H, N, N) and lo.all()
+
+    def test_indivisible_seq_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(SEQ + 3)
+
+    def test_fixed_local_windows_and_globals(self):
+        cfg = FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                  num_local_blocks=4, num_global_blocks=1)
+        lo = cfg.make_layout(SEQ)
+        # local: blocks within the same window see each other
+        assert lo[0, 0, 3] and lo[0, 3, 0]
+        # across windows, non-global pairs stay masked
+        assert not lo[0, 0, 5]
+        # the global column (last block of each window) is visible to all rows
+        assert lo[0, :, 3].all() and lo[0, :, 7].all()
+
+    def test_fixed_unidirectional_is_lower_triangular(self):
+        cfg = FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                  attention="unidirectional")
+        lo = cfg.make_layout(SEQ)
+        assert not np.triu(lo[0], 1).any()
+
+    def test_bigbird_window_random_global(self):
+        cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK,
+                                    num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        lo = cfg.make_layout(SEQ)
+        di = np.arange(N)
+        assert lo[0, di, di].all()                       # diagonal window
+        assert lo[0, di[:-1], di[:-1] + 1].all()         # +1 off-diagonal
+        assert lo[0, 0, :].all() and lo[0, :, 0].all()   # global first block
+        # every row has at least window + something (random adds >= 0)
+        assert (lo[0].sum(-1) >= 2).all()
+
+    def test_bigbird_seeded_layouts_reproducible(self):
+        a = BigBirdSparsityConfig(num_heads=H, block=BLOCK, seed=3).make_layout(SEQ)
+        b = BigBirdSparsityConfig(num_heads=H, block=BLOCK, seed=3).make_layout(SEQ)
+        np.testing.assert_array_equal(a, b)
+
+    def test_longformer_chosen_globals(self):
+        cfg = BSLongformerSparsityConfig(
+            num_heads=H, block=BLOCK, num_sliding_window_blocks=3,
+            global_block_indices=[2], global_block_end_indices=[4])
+        lo = cfg.make_layout(SEQ)
+        assert lo[0, 2:4, :].all() and lo[0, :, 2:4].all()
+        assert not lo[0, 0, 6]  # outside window + outside globals
+
+    def test_variable_window_sequence(self):
+        cfg = VariableSparsityConfig(num_heads=H, block=BLOCK,
+                                     local_window_blocks=[2, 3],
+                                     global_block_indices=[0])
+        lo = cfg.make_layout(SEQ)
+        assert lo[0, 0, 1] and lo[0, 1, 0]     # first window (2 blocks)
+        assert lo[0, 2, 4] and lo[0, 4, 2]     # second window (3 blocks)
+        assert not lo[0, 1, 2]                 # window boundary respected
+        assert lo[0, :, 0].all() and lo[0, 0, :].all()
+
+    @pytest.mark.parametrize("cfg_cls,kw", [
+        (FixedSparsityConfig, {}),
+        (BigBirdSparsityConfig, {}),
+        (BSLongformerSparsityConfig, {}),
+        (VariableSparsityConfig, {}),
+    ])
+    def test_all_rows_attend_something(self, cfg_cls, kw):
+        """No query block may be fully masked (softmax over empty support)."""
+        lo = cfg_cls(num_heads=H, block=BLOCK, **kw).make_layout(SEQ)
+        assert (lo.sum(-1) > 0).all()
