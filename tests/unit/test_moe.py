@@ -95,3 +95,72 @@ class TestMoEModel:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
         assert np.isfinite(losses).all()
+
+
+class TestGatingEdges:
+    """Capacity/drop-policy edges (reference sharded_moe top1/top2 gating)."""
+
+    def test_capacity_formula(self):
+        from deepspeed_tpu.moe.sharded_moe import compute_capacity
+
+        assert compute_capacity(64, 8, 1.0) == 8
+        assert compute_capacity(64, 8, 1.25) == 10
+        assert compute_capacity(64, 8, 1.0, k=2) == 16
+        assert compute_capacity(4, 8, 1.0, min_capacity=4) == 4  # floor
+
+    def test_overloaded_expert_drops_exactly_overflow(self):
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        # all 16 tokens prefer expert 0; capacity 4 → 12 dropped
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
+        combine, dispatch, l_aux, meta = topk_gating(
+            logits, k=1, capacity_factor=1.0, min_capacity=4)
+        assert meta["capacity"] == 8  # ceil(16/2 * 1.0)
+        kept = int(dispatch.sum())
+        assert kept == 8  # expert 0 filled to capacity, rest dropped
+        assert float(meta["dropped_fraction"]) == pytest.approx(0.5)
+
+    def test_no_drop_mode_keeps_everything(self):
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
+        _, dispatch, _, meta = topk_gating(logits, k=1, drop_tokens=False)
+        assert int(dispatch.sum()) == 16
+        assert float(meta["dropped_fraction"]) == 0.0
+
+    def test_first_choice_priority_over_second(self):
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        # expert 0 is everyone's first choice; with k=2 the second choices
+        # (expert 1) must not displace first-choice slots of expert 0
+        T = 8
+        logits = jnp.tile(jnp.asarray([[5.0, 4.0, -5.0]]), (T, 1))
+        combine, dispatch, _, meta = topk_gating(
+            logits, k=2, capacity_factor=1.0, min_capacity=2)
+        C = meta["capacity"]
+        # expert 0 gets exactly C tokens — all first choices
+        assert int(dispatch[:, 0, :].sum()) == min(T, C)
+        # combine weights normalized over the kept top-k pair
+        row = np.asarray(combine[0].sum(-1))
+        assert row[0] + row[1] == pytest.approx(1.0, abs=1e-5)
+
+    def test_combine_zero_for_dropped_tokens(self):
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        logits = jnp.tile(jnp.asarray([[10.0, 0.0]]), (16, 1))
+        combine, dispatch, _, meta = topk_gating(
+            logits, k=1, capacity_factor=0.5, min_capacity=2)
+        # a dropped token's combine row is exactly zero (no phantom output)
+        per_token = np.asarray(combine.sum((1, 2)))
+        dropped = per_token == 0.0
+        assert dropped.sum() == 16 - int(dispatch.sum())
+
+    def test_balanced_router_fills_all_experts(self):
+        from deepspeed_tpu.moe.sharded_moe import topk_gating
+
+        rngs = np.random.default_rng(0)
+        logits = jnp.asarray(rngs.standard_normal((64, 8)), jnp.float32)
+        _, dispatch, l_aux, meta = topk_gating(logits, k=2,
+                                               capacity_factor=2.0)
+        assert (np.asarray(meta["tokens_per_expert"]) > 0).all()
+        assert float(l_aux) > 0
