@@ -1,0 +1,110 @@
+"""Config-model plumbing tests (reference tests/unit/runtime/test_ds_config_model.py
+— from_dict aliasing, deprecation warnings, unknown-key tolerance, to_dict
+round-trip)."""
+
+import dataclasses
+
+
+import pytest
+
+from deepspeed_tpu.runtime.config_utils import (
+    DeepSpeedConfigModel,
+    get_dict_param,
+    get_list_param,
+    get_scalar_param,
+)
+
+
+@dataclasses.dataclass
+class _Sub(DeepSpeedConfigModel):
+    enabled: bool = False
+    depth: int = 1
+
+
+@dataclasses.dataclass
+class _Cfg(DeepSpeedConfigModel):
+    rate: float = 0.5
+    old_name: int = dataclasses.field(
+        default=0, metadata={"deprecated": True, "new_param": "rate"})
+    aka: str = dataclasses.field(default="x", metadata={"aliases": ("a.k.a.",)})
+    sub: _Sub = dataclasses.field(
+        default_factory=_Sub, metadata={"submodel": _Sub})
+
+    def _validate(self):
+        if self.rate < 0:
+            raise ValueError("rate must be >= 0")
+
+
+class TestFromDict:
+    def test_defaults_and_override(self):
+        c = _Cfg.from_dict({"rate": 0.9})
+        assert c.rate == 0.9 and c.aka == "x" and c.sub.depth == 1
+
+    def test_none_means_empty(self):
+        assert _Cfg.from_dict(None).rate == 0.5
+
+    def test_non_dict_rejected(self):
+        with pytest.raises(TypeError, match="expects a dict"):
+            _Cfg.from_dict([1, 2])
+
+    @staticmethod
+    def _capture_warnings():
+        import logging as _logging
+
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+
+        records = []
+
+        class _H(_logging.Handler):
+            def emit(self, r):
+                records.append(r.getMessage())
+
+        h = _H(level=_logging.WARNING)
+        ds_logger.addHandler(h)
+        return records, lambda: ds_logger.removeHandler(h)
+
+    def test_unknown_key_warns_not_raises(self):
+        records, detach = self._capture_warnings()
+        try:
+            c = _Cfg.from_dict({"rate": 0.1, "mystery_knob": 7})
+        finally:
+            detach()
+        assert c.rate == 0.1
+        assert any("unknown key 'mystery_knob'" in m for m in records)
+
+    def test_alias_maps_to_field(self):
+        assert _Cfg.from_dict({"a.k.a.": "y"}).aka == "y"
+
+    def test_deprecated_field_warns(self):
+        records, detach = self._capture_warnings()
+        try:
+            _Cfg.from_dict({"old_name": 3})
+        finally:
+            detach()
+        assert any("deprecated" in m for m in records)
+
+    def test_nested_submodel_built(self):
+        c = _Cfg.from_dict({"sub": {"enabled": True, "depth": 4}})
+        assert isinstance(c.sub, _Sub) and c.sub.depth == 4
+
+    def test_validate_hook_fires(self):
+        with pytest.raises(ValueError, match="rate"):
+            _Cfg.from_dict({"rate": -1.0})
+
+    def test_to_dict_round_trip(self):
+        c = _Cfg.from_dict({"rate": 0.25, "sub": {"enabled": True}})
+        d = c.to_dict()
+        assert d["rate"] == 0.25 and d["sub"]["enabled"] is True
+        c2 = _Cfg.from_dict({k: v for k, v in d.items()})
+        assert c2.to_dict() == d
+
+
+class TestParamGetters:
+    def test_scalar_list_dict_defaults(self):
+        pd = {"a": 1, "l": [1, 2], "d": {"k": 1}}
+        assert get_scalar_param(pd, "a", 9) == 1
+        assert get_scalar_param(pd, "zz", 9) == 9
+        assert get_list_param(pd, "l", []) == [1, 2]
+        assert get_list_param(pd, "zz", [3]) == [3]
+        assert get_dict_param(pd, "d", {}) == {"k": 1}
+        assert get_dict_param(pd, "zz", {"d": 1}) == {"d": 1}
