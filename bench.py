@@ -7,12 +7,56 @@ at ≥90% of Megatron-TPU — which we can't run here; the comparable in-tree cl
 DeepSpeed-Ulysses' sustained >54% of hardware peak on attention-dense training
 (`blogs/deepspeed-ulysses/README.md:79-83`). We therefore report tokens/sec/chip
 and normalize vs_baseline = achieved_MFU / 0.54.
+Degraded mode (VERDICT r4 item 1c): if the device backend cannot initialize
+— e.g. the axon relay is wedged, which hangs every jax startup on this host —
+the bench must still hand the driver ONE parseable JSON line.  A watchdog
+child probes backend init with a hard budget before this process commits to
+importing jax; on hang/failure we print {"degraded": true, "cause": ...} and
+exit 0 instead of leaving rc=1 and parsed:null (the r4 artifact failure).
 """
 
 import json
 import time
 
 import numpy as np
+
+#: backend-init probe budget — healthy tunnel startup measures well under this
+PROBE_TIMEOUT_S = 180
+
+HEADLINE_METRIC = "gpt2_350m_train_tokens_per_sec_per_chip"
+
+
+def _degraded(cause: str):
+    print(json.dumps({
+        "metric": HEADLINE_METRIC,
+        "value": None,
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "degraded": True,
+        "cause": cause,
+    }))
+
+
+def _backend_probe():
+    """Probe live-backend init in a child under a hard timeout.
+
+    Returns (ok, cause_or_kind).  Runs BEFORE this process touches jax: once
+    a wedged relay hangs backend init there is no recovery in-process."""
+    import subprocess
+    import sys
+
+    code = "import jax; print(jax.devices()[0].device_kind)"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              timeout=PROBE_TIMEOUT_S, capture_output=True,
+                              text=True)
+    except subprocess.TimeoutExpired:
+        return False, (f"backend init hung >{PROBE_TIMEOUT_S}s "
+                       "(device relay wedged or unreachable)")
+    if proc.returncode != 0:
+        return False, ("backend init failed: "
+                       + (proc.stderr or "")[-400:].strip())
+    return True, proc.stdout.strip()
 
 
 PEAK_BF16_FLOPS = {
@@ -57,6 +101,11 @@ def main():
     import jax.numpy as jnp
 
     import deepspeed_tpu
+    from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+    # SIGTERM → bounded drain of in-flight device work, never a mid-transfer
+    # kill (the r4 relay-wedge cause; see utils/transfer.py)
+    install_transfer_guard()
 
     # keep stdout clean: the driver parses the single JSON line
     logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
@@ -164,8 +213,21 @@ def main():
 
 if __name__ == "__main__":
     import sys
+    import traceback
 
-    main()
+    if "--tune-select" not in sys.argv:
+        _ok, _info = _backend_probe()
+        if not _ok:
+            _degraded(_info)
+            sys.exit(0)
+    try:
+        main()
+    except Exception:
+        # whatever went wrong mid-bench, the driver still gets one JSON line
+        tb = traceback.format_exc()
+        sys.stderr.write(tb)
+        _degraded("bench raised: " + tb.strip().splitlines()[-1][:400])
+        sys.exit(0)
     if "--all" in sys.argv:
         # the other four BASELINE.json tracked configs (one JSON line each;
         # the headline line above stays first for the driver)

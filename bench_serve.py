@@ -27,6 +27,13 @@ from typing import Dict, List
 import numpy as np
 
 
+
+# transfer discipline: SIGTERM drains in-flight device work instead of dying
+# mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
+from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+install_transfer_guard()
+
 def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False):
     """Drive the engine with Poisson arrivals until all requests finish."""

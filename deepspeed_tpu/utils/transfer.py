@@ -1,0 +1,189 @@
+"""Host↔device transfer discipline for tunnel-backed TPU runtimes.
+
+Round-4 postmortem (BENCH_NOTE_r04.md): a profiling script queued ~1 GB of
+host↔device traffic, was killed by a shell timeout mid-flight, and the device
+relay then refused all new connections for 8+ hours — taking every jax
+backend init on the host down with it.  Two disciplines prevent a repeat, and
+every bench/profiling tool in this repo must use them:
+
+1. **Chunking** (``chunked_device_put`` / ``chunked_device_get``): never let
+   more than ``MAX_INFLIGHT_BYTES`` (32 MB) of transfer be outstanding — each
+   chunk is blocked on before the next is issued, so an interrupt at any
+   point leaves at most one small transfer in flight.
+2. **Drain-on-signal** (``install_transfer_guard``): ``timeout(1)`` and
+   orchestrators send SIGTERM before SIGKILL; the guard turns SIGTERM/SIGINT
+   into "drain outstanding device work (bounded), then exit" instead of
+   dying with transfers queued.
+
+Reference analogue: the AIO swapper's bounded double-buffering
+(``deepspeed/runtime/swap_tensor/pipelined_optimizer_swapper.py``) applies the
+same cap-in-flight principle to NVMe traffic.
+"""
+
+import signal
+import sys
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+#: hard cap on outstanding host↔device bytes for tooling transfers
+MAX_INFLIGHT_BYTES = 32 * 1024 * 1024
+
+#: how long the signal guard waits for in-flight device work before exiting
+DRAIN_TIMEOUT_S = 120.0
+
+
+def _leaf_nbytes(leaf) -> int:
+    try:
+        return int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def chunked_device_put(tree: Any, sharding=None, *,
+                       limit_bytes: int = MAX_INFLIGHT_BYTES) -> Any:
+    """``jax.device_put`` a pytree with bounded in-flight bytes.
+
+    ``sharding``: None, a single Sharding applied to every leaf, or a pytree
+    of Shardings matching ``tree`` (e.g. an engine's param shardings).
+
+    Host leaves are transferred in order; whenever the running total of
+    unacknowledged bytes would exceed ``limit_bytes`` the pending transfers
+    are blocked on first, and leaves larger than the limit are split along
+    axis 0 so no single flight exceeds the cap.  Leaves that are already
+    ``jax.Array``s are resharded directly (device-side, not a tunnel
+    transfer) without chunking.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    shard_leaves = None
+    if sharding is not None and not isinstance(sharding, jax.sharding.Sharding):
+        shard_leaves = jax.tree.flatten(
+            sharding,
+            is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))[0]
+        if len(shard_leaves) != len(leaves):
+            raise ValueError(
+                f"sharding pytree has {len(shard_leaves)} leaves for a "
+                f"{len(leaves)}-leaf tree")
+    out = []
+    pending: list = []
+    inflight = 0
+
+    def _drain():
+        nonlocal inflight
+        for p in pending:
+            jax.block_until_ready(p)
+        pending.clear()
+        inflight = 0
+
+    for i, leaf in enumerate(leaves):
+        sh = shard_leaves[i] if shard_leaves is not None else sharding
+        if isinstance(leaf, jax.Array):
+            out.append(jax.device_put(leaf, sh))
+            continue
+        nb = _leaf_nbytes(leaf)
+        arr = np.asarray(leaf)
+        # chunk-split only when the leaf lands on ONE device (the tunnel
+        # case): assembling a full unsharded copy on the default device
+        # would defeat a multi-device sharding and OOM the chip that
+        # sharding exists to protect — there, device_put(arr, sh) already
+        # transfers per-device shard slices, each a fraction of the leaf
+        single_dev = sh is None or len(sh.device_set) == 1
+        if single_dev and nb > limit_bytes and arr.ndim >= 1 and arr.shape[0] > 1:
+            # split along axis 0 into <=limit chunks, then reassemble on device
+            rows = max(1, int(arr.shape[0] * limit_bytes / nb))
+            parts = []
+            for s in range(0, arr.shape[0], rows):
+                _drain()
+                # chunks ride unsharded (a chunk's row count need not divide
+                # the mesh axis); the assembled leaf reshards device-side
+                p = jax.device_put(arr[s:s + rows])
+                pending.append(p)
+                inflight += _leaf_nbytes(p)
+                parts.append(p)
+            _drain()
+            import jax.numpy as jnp
+
+            chunked = jnp.concatenate(parts, axis=0)
+            out.append(jax.device_put(chunked, sh) if sh is not None else chunked)
+            continue
+        if inflight + nb > limit_bytes:
+            _drain()
+        p = jax.device_put(arr, sh)
+        pending.append(p)
+        inflight += nb
+        out.append(p)
+    _drain()
+    return jax.tree.unflatten(treedef, out)
+
+
+def chunked_device_get(tree: Any, *,
+                       limit_bytes: int = MAX_INFLIGHT_BYTES) -> Any:
+    """Fetch a pytree to host numpy with bounded in-flight bytes.
+
+    Leaves larger than ``limit_bytes`` are fetched in axis-0 slices so no
+    single transfer exceeds the cap (a 1 GB embedding table otherwise rides
+    the tunnel as one flight — the exact r4 wedge hazard)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    for leaf in leaves:
+        # block per leaf first: device_get of an unready array queues the
+        # full transfer; readiness keeps the tunnel queue to one chunk
+        jax.block_until_ready(leaf)
+        nb = _leaf_nbytes(leaf)
+        shape = getattr(leaf, "shape", ())
+        if nb > limit_bytes and len(shape) >= 1 and shape[0] > 1:
+            rows = max(1, int(shape[0] * limit_bytes / nb))
+            parts = []
+            for s in range(0, shape[0], rows):
+                parts.append(np.asarray(jax.device_get(leaf[s:s + rows])))
+            out.append(np.concatenate(parts, axis=0))
+        else:
+            out.append(np.asarray(jax.device_get(leaf)))
+    return jax.tree.unflatten(treedef, out)
+
+
+_guard_installed = False
+
+
+def install_transfer_guard(drain_timeout_s: float = DRAIN_TIMEOUT_S) -> None:
+    """Install SIGTERM/SIGINT handlers that drain device work before exit.
+
+    ``timeout(1)`` sends SIGTERM first; without a handler the process dies
+    with its transfer queue mid-flight, which can wedge a tunnel-backed
+    device relay (r4 outage).  The handler blocks on outstanding async work
+    in a watchdog thread (bounded by ``drain_timeout_s``), then exits 143/130
+    as the signal would have.
+    """
+    global _guard_installed
+    if _guard_installed:
+        return
+    _guard_installed = True
+
+    def _handler(signum, frame):
+        import threading
+
+        print(f"[transfer-guard] signal {signum}: draining in-flight device "
+              f"work (<= {drain_timeout_s:.0f}s) before exit", file=sys.stderr,
+              flush=True)
+        done = threading.Event()
+
+        def _drain():
+            try:
+                jax.effects_barrier()
+            except Exception:
+                pass
+            done.set()
+
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
+        done.wait(drain_timeout_s)
+        print(f"[transfer-guard] drain {'complete' if done.is_set() else 'TIMED OUT'}"
+              "; exiting", file=sys.stderr, flush=True)
+        sys.exit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _handler)
+        except (ValueError, OSError):  # non-main thread / unsupported
+            pass

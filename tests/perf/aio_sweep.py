@@ -14,6 +14,13 @@ import time
 import numpy as np
 
 
+
+# transfer discipline: SIGTERM drains in-flight device work instead of dying
+# mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
+from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+install_transfer_guard()
+
 def sweep(path_dir: str, size_mb: int = 256):
     from deepspeed_tpu.ops.aio.py_aio import AsyncIOHandle
 

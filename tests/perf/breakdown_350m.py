@@ -6,6 +6,13 @@ import time
 import numpy as np
 
 
+
+# transfer discipline: SIGTERM drains in-flight device work instead of dying
+# mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
+from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+install_transfer_guard()
+
 def timeit(fn, argsets, iters=20):
     """fn takes (step_idx, *args); a fresh step_idx per call defeats the axon
     runtime's elision of identical replayed executions. One host sync at the

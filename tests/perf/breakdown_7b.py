@@ -10,6 +10,13 @@ import time
 import numpy as np
 
 
+
+# transfer discipline: SIGTERM drains in-flight device work instead of dying
+# mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
+from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+install_transfer_guard()
+
 def timeit(fn, argsets, iters=10):
     """Fresh step-index per call defeats replay elision; one host sync at the
     end (per-call syncs serialize on tunnel round-trips). NOTE: wall numbers

@@ -9,6 +9,13 @@ import time
 import numpy as np
 
 
+
+# transfer discipline: SIGTERM drains in-flight device work instead of dying
+# mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
+from deepspeed_tpu.utils.transfer import install_transfer_guard
+
+install_transfer_guard()
+
 def run_config(micro_bs, remat, remat_policy="dots", iters=12, seq=1024,
                scan_layers=True):
     import jax
