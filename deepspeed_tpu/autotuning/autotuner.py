@@ -36,6 +36,7 @@ class TuneResult:
     throughput: float  # samples/sec (0 = failed)
     step_ms: float = 0.0
     error: Optional[str] = None
+    wall_s: float = 0.0  # this trial's wall time (compile + profiled steps)
 
 
 def estimate_memory_per_chip(n_params: int, zero_stage: int, dp: int, mp: int,
@@ -130,6 +131,7 @@ class Autotuner:
 
         topo_mod.reset_topology()
         engine = None
+        t_trial = time.perf_counter()
         try:
             engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_fn(), config=cfg)
             b = batch_fn(engine.train_micro_batch_size_per_gpu *
@@ -147,9 +149,11 @@ class Autotuner:
             jax.block_until_ready(engine.params)
             dt = (time.perf_counter() - t0) / steps
             tput = engine.train_batch_size / dt
-            return TuneResult(cfg, tput, step_ms=dt * 1000)
+            return TuneResult(cfg, tput, step_ms=dt * 1000,
+                              wall_s=round(time.perf_counter() - t_trial, 2))
         except Exception as e:
-            return TuneResult(cfg, 0.0, error=str(e)[:200])
+            return TuneResult(cfg, 0.0, error=str(e)[:200],
+                              wall_s=round(time.perf_counter() - t_trial, 2))
         finally:
             # release the candidate's HBM before the next compile (a sweep
             # otherwise accumulates param/optimizer buffers until the real

@@ -173,8 +173,11 @@ def resolve_auto_config(
     max_trials: int = 16,
     steps: int = 3,
     results_dir: Optional[str] = None,
-) -> Tuple[Dict[str, Any], TuneResult]:
+) -> Tuple[Dict[str, Any], Optional[TuneResult]]:
     """Profile the ``"auto"`` space and return ``(merged_config, best)``.
+
+    ``best`` is ``None`` when the config has no ``"auto"`` keys (nothing was
+    profiled) — callers must not read ``best.throughput`` unconditionally.
 
     ``merged_config`` is the user's config with every ``"auto"`` replaced by
     the winning value (reference merge-back, ``autotuner.py:1075``). Each
@@ -226,7 +229,8 @@ def resolve_auto_config(
                 "throughput_samples_per_s": r.throughput,
                 "step_ms": r.step_ms,
                 "error": r.error,
-                "wall_s": round(time.time() - t0, 2),
+                "wall_s": r.wall_s,  # per-trial (compile + steps), not cumulative
+                "sweep_wall_s": round(time.time() - t0, 2),
             }) + "\n")
 
     merged = copy.deepcopy(ds_config)

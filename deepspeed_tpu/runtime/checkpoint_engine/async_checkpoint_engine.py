@@ -24,8 +24,16 @@ Durability contract:
 """
 
 import atexit
+import os
 import queue
 import threading
+
+
+def _key(path):
+    """Canonical key for read-your-writes tracking: an equivalent spelling
+    (relative vs absolute, redundant separators) must hit the same in-flight
+    entry, else a load can race a queued save of the same file."""
+    return os.path.abspath(os.path.normpath(path)) if path is not None else None
 
 from ...utils.logging import logger
 from .native_checkpoint_engine import NativeCheckpointEngine
@@ -93,6 +101,7 @@ class AsyncCheckpointEngine(NativeCheckpointEngine):
                     self._cv.notify_all()
 
     def _enqueue(self, fn, path=None):
+        path = _key(path)
         with self._cv:
             self._enq_seq += 1
             seq = self._enq_seq
@@ -120,6 +129,7 @@ class AsyncCheckpointEngine(NativeCheckpointEngine):
         fully hit disk. With ``raise_errors``, re-raise the first stored
         writer error — scoped to ``path`` when one is given, so a load of an
         intact checkpoint is not failed by an earlier unrelated save error."""
+        path = _key(path)
         with self._cv:
             target = self._inflight.get(path, 0) if path is not None \
                 else self._enq_seq

@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...comm.topology import get_topology
+from ...utils.logging import log_dist
 from .spmd import spmd_pipeline
 
 
@@ -429,6 +430,8 @@ class PipelinedLM:
     one compiled program (see ``spmd.py``).
     """
 
+    _remat_note_logged = False
+
     def __init__(self, model, num_stages: Optional[int] = None, topology=None):
         from ...models.transformer import TransformerLM
 
@@ -556,7 +559,16 @@ class PipelinedLM:
         # above); wrapping the tick as well nests remats, and the backward
         # then recomputes every forward twice — measured bwd/fwd 4.8 vs the
         # per-layer scheme's 4.0, the whole gap to ideal 1F1B efficiency
-        # (r3 pipe row 0.75 → ~0.97 without the double wrap)
+        # (r3 pipe row 0.75 → ~0.97 without the double wrap). cfg.remat on
+        # the pipe path therefore means PER-LAYER checkpointing only;
+        # tick-level remat is intentionally unavailable (logged once below).
+        if cfg.remat and not PipelinedLM._remat_note_logged:
+            PipelinedLM._remat_note_logged = True
+            log_dist(
+                "PipelinedLM: remat applies per-layer inside each stage "
+                "(tick-level remat would nest and double backward recompute); "
+                "activation memory per stage is O(microbatches) — see "
+                "runtime/pipe/spmd.py docstring for the tradeoff", ranks=[0])
         loss, aux = spmd_pipeline(
             first_fn, stage_fn, last_fn, pipeline_params, (ids_mb, lbl_mb, pos_mb),
             mesh=self.topology.mesh, num_micro=M, remat=False,
