@@ -83,6 +83,10 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
     moe_drop_tokens: bool = True  # False = capacity C=T, no drops (Mixtral parity)
+    # Residual/PR-MoE (arXiv:2201.05596; reference moe/layer.py:29,47
+    # use_residual): dense MLP alongside the experts, learned 2-way softmax
+    # coefficient blends the two outputs per token
+    moe_use_residual: bool = False
     # progressive layer drop (PLD): stochastic depth driven by a per-step theta
     # injected as batch["pld_theta"] (reference progressive_layer_drop.py)
     progressive_layer_drop: bool = False
@@ -140,7 +144,10 @@ class TransformerConfig:
         attn = H * qd + 2 * H * kvd + qd * H  # q, k, v, o
         mlp = (3 if self.activation in ("swiglu", "geglu") else 2) * H * I
         if self.num_experts > 0:
+            dense_mlp = mlp
             mlp = mlp * self.num_experts + H * self.num_experts  # experts + router
+            if self.moe_use_residual:
+                mlp += dense_mlp + 2 * H + 2  # residual MLP + coefficient
         n_ln = 1 if (self.parallel_block and self.parallel_shared_ln) else 2
         norms = n_ln * (1 if self.norm == "rmsnorm" else 2) * H
         per_layer = attn + mlp + norms
@@ -339,6 +346,18 @@ class TransformerLM:
             blocks["w_down"] = stacked(k[6], (E, I, H), resid_init)
             if cfg.activation == "swiglu":
                 blocks["w_gate"] = stacked(k[7], (E, H, I))
+            if cfg.moe_use_residual:
+                # PR-MoE (reference moe/layer.py:80-84): per-layer dense MLP
+                # + Linear(H,2) coefficient
+                blocks["res_wi"] = stacked(jax.random.fold_in(k[5], 1), (H, I))
+                blocks["res_wo"] = stacked(
+                    jax.random.fold_in(k[6], 1), (I, H), resid_init)
+                blocks["res_coef_w"] = stacked(
+                    jax.random.fold_in(k[10], 1), (H, 2))
+                blocks["res_coef_b"] = jnp.zeros((L, 2), dt)
+                if cfg.activation == "swiglu":
+                    blocks["res_wgate"] = stacked(
+                        jax.random.fold_in(k[7], 1), (H, I))
         else:
             blocks["w_down"] = stacked(k[6], (I, H), resid_init)
             if cfg.activation in ("swiglu", "geglu"):
@@ -417,6 +436,13 @@ class TransformerLM:
             blocks["w_down"] = P(None, e, m, None)
             if cfg.activation == "swiglu":
                 blocks["w_gate"] = P(None, e, None, m)
+            if cfg.moe_use_residual:
+                blocks["res_wi"] = P(None, None, m)
+                blocks["res_wo"] = P(None, m, None)
+                blocks["res_coef_w"] = P(None, None, None)
+                blocks["res_coef_b"] = P(None, None)
+                if cfg.activation == "swiglu":
+                    blocks["res_wgate"] = P(None, None, m)
         else:
             blocks["w_down"] = P(None, m, None)
             blocks["w_up"] = P(None, None, m)
@@ -502,6 +528,12 @@ class TransformerLM:
         h = x if post_ln else checkpoint_name(_norm(
             x, blk["ln1_scale"], blk.get("ln1_bias"), cfg.norm, cfg.norm_eps,
             cfg.norm_weight_offset), "ln_out")
+        # activation quantization hook (reference basic_layer.py:17 QuantAct —
+        # each compressed linear quantizes its input): set by
+        # compression.init_compression; None costs nothing
+        act_q = getattr(self, "_act_quant_fn", None)
+        if act_q is not None:
+            h = act_q(h)
         q = h @ blk["wq"].astype(h.dtype)
         kk = h @ blk["wk"].astype(h.dtype)
         v = h @ blk["wv"].astype(h.dtype)
@@ -618,6 +650,8 @@ class TransformerLM:
             h2 = checkpoint_name(
                 _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg.norm,
                       cfg.norm_eps, cfg.norm_weight_offset), "ln_out")
+        if act_q is not None:
+            h2 = act_q(h2)
         aux = jnp.zeros((), jnp.float32)
         if cfg.num_experts > 0:
             mlp_out, aux = self._moe_ffn(h2, blk, train)
@@ -659,7 +693,7 @@ class TransformerLM:
         from ..moe.layer import routed_ffn
 
         cfg = self.config
-        return routed_ffn(
+        y, l_aux = routed_ffn(
             h, blk["moe_wg"], blk["wi"], blk["w_down"], blk.get("w_gate"),
             k=cfg.moe_top_k,
             drop_tokens=cfg.moe_drop_tokens,
@@ -669,6 +703,15 @@ class TransformerLM:
             # computation the expert axis moves to the expert dim (the all-to-all)
             data_axes=("data", "hpz"),
         )
+        if cfg.moe_use_residual:
+            from ..moe.layer import residual_mix
+
+            y = residual_mix(
+                h, y, blk["res_wi"], blk["res_wo"],
+                blk["res_coef_w"], blk["res_coef_b"],
+                activation="swiglu" if cfg.activation == "swiglu" else "gelu",
+                mlp_wgate=blk.get("res_wgate"))
+        return y, l_aux
 
     # ------------------------------------------------------------------
     def _embed(self, params, input_ids, positions, dtype, token_type_ids=None):

@@ -69,6 +69,26 @@ def routed_ffn(x, wg, wi, wo, wgate=None, *, k: int = 1,
     return y.astype(x.dtype), jnp.mean(l_aux).astype(jnp.float32)
 
 
+def residual_mix(x, moe_out, mlp_wi, mlp_wo, coef_w, coef_b, *,
+                 activation: str = "gelu", mlp_wgate=None):
+    """Residual-MoE combine (PR-MoE, arXiv:2201.05596; reference
+    ``moe/layer.py:125-132``): run a dense MLP on the same input and blend
+    ``coef[...,0]·moe_out + coef[...,1]·mlp_out`` with
+    ``coef = softmax(x @ coef_w + coef_b)`` learned per token."""
+    h = x @ mlp_wi.astype(x.dtype)
+    if activation == "swiglu" and mlp_wgate is not None:
+        h = jax.nn.silu(x @ mlp_wgate.astype(x.dtype)) * h
+    elif activation == "silu":
+        h = jax.nn.silu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=True)
+    mlp_out = h @ mlp_wo.astype(x.dtype)
+    coef = jax.nn.softmax(
+        x.astype(jnp.float32) @ coef_w.astype(jnp.float32)
+        + coef_b.astype(jnp.float32), axis=-1).astype(x.dtype)
+    return moe_out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+
+
 class MoE:
     """Functional MoE FFN: router + E experts (2-layer MLP, gelu/silu/swiglu).
 
@@ -81,8 +101,10 @@ class MoE:
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
                  drop_tokens: bool = True, activation: str = "gelu",
                  noisy_gate_policy: Optional[str] = None,
+                 use_residual: bool = False,
                  expert_axis: str = "expert", model_axis: str = "model",
                  data_axes=("data", "hpz")):
+        self.use_residual = use_residual
         self.hidden_size = hidden_size
         self.num_experts = num_experts
         self.inter = expert_intermediate_size
@@ -100,7 +122,10 @@ class MoE:
     # ------------------------------------------------------------------
     def init_params(self, rng) -> Dict[str, Any]:
         H, E, I = self.hidden_size, self.num_experts, self.inter
+        # split stays at 4 — widening it would silently shift k1-k4 and change
+        # every existing seeded MoE init; residual keys derive via fold_in
         k1, k2, k3, k4 = jax.random.split(rng, 4)
+        k5, k6, k7 = (jax.random.fold_in(k4, i) for i in (1, 2, 3))
         init = jax.nn.initializers.normal(0.02)
         p = {
             "wg": init(k1, (H, E), jnp.float32),  # router
@@ -109,6 +134,17 @@ class MoE:
         }
         if self.activation == "swiglu":
             p["wgate"] = init(k4, (E, H, I), jnp.float32)
+        if self.use_residual:
+            # Residual/PR-MoE (arXiv:2201.05596; reference moe/layer.py:80-84):
+            # a dense MLP runs alongside the routed experts and a learned
+            # 2-way coefficient (Linear(H,2) + softmax) mixes the two outputs
+            p["mlp_wi"] = init(k5, (H, I), jnp.float32)
+            p["mlp_wo"] = init(k6, (I, H), jnp.float32)
+            p["coef_w"] = init(k7, (H, 2), jnp.float32)
+            p["coef_b"] = jnp.zeros((2,), jnp.float32)
+            if self.activation == "swiglu":
+                p["mlp_wgate"] = init(
+                    jax.random.fold_in(k5, 1), (H, I), jnp.float32)
         return p
 
     @property
@@ -121,6 +157,13 @@ class MoE:
         }
         if self.activation == "swiglu":
             specs["wgate"] = P(e, None, m)
+        if self.use_residual:
+            specs["mlp_wi"] = P(None, m)
+            specs["mlp_wo"] = P(m, None)
+            specs["coef_w"] = P(None, None)
+            specs["coef_b"] = P(None)
+            if self.activation == "swiglu":
+                specs["mlp_wgate"] = P(None, m)
         return specs
 
     # ------------------------------------------------------------------
@@ -140,7 +183,14 @@ class MoE:
             rng=rng if (train and self.noisy_gate_policy) else None,
             noise_eps=1e-2 if self.noisy_gate_policy else 0.0,
         )
-        return y.reshape(orig_shape), l_aux
+        y = y.reshape(orig_shape)
+        if self.use_residual:
+            y = residual_mix(
+                x, y, params["mlp_wi"], params["mlp_wo"],
+                params["coef_w"], params["coef_b"],
+                activation=self.activation,
+                mlp_wgate=params.get("mlp_wgate"))
+        return y, l_aux
 
     def __call__(self, params, x, train=True, rng=None):
         return self.apply(params, x, train=train, rng=rng)
