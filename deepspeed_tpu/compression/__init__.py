@@ -1,3 +1,10 @@
 """Compression (reference deepspeed/compression/)."""
 
-from .compress import CompressionScheduler, compress_params, init_compression, redundancy_clean  # noqa: F401
+from .compress import (  # noqa: F401
+    CompressionScheduler,
+    calibrate_activation_ranges,
+    compress_params,
+    init_compression,
+    redundancy_clean,
+    student_initialization,
+)

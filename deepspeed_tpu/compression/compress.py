@@ -43,9 +43,62 @@ class PruningConfig:
     modules: List[str] = field(default_factory=lambda: ["*"])
 
 
+@dataclass
+class ActQuantizeConfig:
+    """Activation quantization (reference ``basic_layer.py:17 QuantAct`` +
+    config ``activation_quantization``): symmetric/asymmetric, dynamic
+    (per-call in-graph range) or static (momentum-calibrated frozen range)."""
+    enabled: bool = False
+    bits: int = 8
+    symmetric: bool = True
+    dynamic: bool = True  # range_calibration: dynamic|static
+    momentum: float = 0.95  # static-range EMA (reference act_range_momentum)
+    schedule_offset: int = 0
+    modules: List[str] = field(default_factory=lambda: ["*"])
+
+
+@dataclass
+class RowPruningConfig:
+    """Structured output-unit pruning (reference ``basic_layer.py:166
+    enable_row_pruning``): mask whole output features by L1 importance."""
+    enabled: bool = False
+    method: str = "l1"
+    ratio: float = 0.0  # fraction of output units zeroed (1 - dense_ratio)
+    schedule_offset: int = 0
+    modules: List[str] = field(default_factory=lambda: ["w_up", "wi"])
+
+
+@dataclass
+class HeadPruningConfig:
+    """Structured attention-head pruning (reference ``basic_layer.py:187
+    enable_head_pruning``, applied to the O projection): mask whole heads.
+    The reference learns topk scores as parameters; in the functional design
+    both ``l1`` and ``topk`` select heads by L1 importance of each head's
+    slice of the output projection (norm-based scores)."""
+    enabled: bool = False
+    method: str = "topk"
+    ratio: float = 0.0
+    num_heads: int = 0
+    schedule_offset: int = 0
+    modules: List[str] = field(default_factory=lambda: ["wo"])
+
+
 def _match(path: str, patterns: List[str]) -> bool:
+    """Match a pytree path ("blocks/wo") against config module patterns.
+
+    A bare pattern matches whole path COMPONENTS ("wo" matches "blocks/wo"
+    but NOT "blocks/res_wo" — substring matching silently captured the
+    residual-MoE dense projections); a pattern containing "/" matches as a
+    component-boundary substring; "*" matches everything."""
+    parts = path.split("/")
+    padded = "/" + path + "/"
     for p in patterns:
-        if p == "*" or p in path:
+        if p == "*":
+            return True
+        if "/" in p:
+            if "/" + p.strip("/") + "/" in padded:
+                return True
+        elif p in parts:
             return True
     return False
 
@@ -55,6 +108,127 @@ def _leaf_paths(tree):
     paths = ["/".join(str(getattr(k, "key", k)) for k in path) for path, _ in flat]
     leaves = [l for _, l in flat]
     return paths, leaves, treedef
+
+
+def quantize_activation(x, bits: int = 8, symmetric: bool = True,
+                        x_min=None, x_max=None):
+    """STE fake-quantization of an activation tensor (reference
+    ``compression/utils.py SymQuantizer/AsymQuantizer`` applied by
+    ``QuantAct``). With ``x_min``/``x_max`` None the range is computed from
+    ``x`` in-graph (dynamic calibration) — jit-safe, no state."""
+    xf = x.astype(jnp.float32)
+    if symmetric:
+        amax = jnp.maximum(jnp.abs(x_min), jnp.abs(x_max)) \
+            if x_min is not None else jnp.max(jnp.abs(xf))
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.maximum(amax, 1e-8) / qmax
+        q = jnp.clip(jnp.round(xf / scale), -qmax - 1, qmax) * scale
+    else:
+        lo = jnp.asarray(x_min, jnp.float32) if x_min is not None else jnp.min(xf)
+        hi = jnp.asarray(x_max, jnp.float32) if x_max is not None else jnp.max(xf)
+        levels = 2.0 ** bits - 1
+        scale = jnp.maximum(hi - lo, 1e-8) / levels
+        zp = jnp.round(-lo / scale)
+        q = (jnp.clip(jnp.round(xf / scale) + zp, 0, levels) - zp) * scale
+    # straight-through estimator: forward sees q, backward sees identity
+    return (xf + jax.lax.stop_gradient(q - xf)).astype(x.dtype)
+
+
+class QuantAct:
+    """Static-range activation quantizer state (reference ``basic_layer.py:17
+    QuantAct``): a momentum EMA of the observed (min, max) calibrated during
+    training, then frozen for inference so every token shares one range.
+
+    ``observe`` runs host-side (outside jit) on calibration batches;
+    ``freeze`` fixes the range (it then enters compiled programs as a
+    constant via ``CompressionScheduler.jit_key``); ``__call__`` quantizes
+    with the current range (or dynamically if never calibrated)."""
+
+    def __init__(self, momentum: float = 0.95, symmetric: bool = True,
+                 bits: int = 8):
+        self.momentum = momentum
+        self.symmetric = symmetric
+        self.bits = bits
+        self.x_min = 0.0
+        self.x_max = 0.0
+        self.frozen = False
+
+    @property
+    def range(self):
+        return (float(self.x_min), float(self.x_max))
+
+    def observe(self, x) -> None:
+        if self.frozen:
+            return
+        lo, hi = jnp.min(x), jnp.max(x)
+        if isinstance(x, jax.core.Tracer):
+            # inside a traced region (the model's layer scan traces even in
+            # eager calls): route the concrete min/max to the host EMA at
+            # runtime via debug.callback
+            jax.debug.callback(self._update_range, lo, hi)
+        else:
+            self._update_range(lo, hi)
+
+    def _update_range(self, lo, hi) -> None:
+        lo, hi = float(lo), float(hi)
+        if self.x_min == self.x_max == 0.0:  # first observation initializes
+            self.x_min, self.x_max = lo, hi
+            return
+        m = self.momentum
+        self.x_min = self.x_min * m + lo * (1 - m)
+        self.x_max = self.x_max * m + hi * (1 - m)
+
+    def freeze(self) -> None:
+        self.frozen = True
+
+    def __call__(self, x):
+        if self.x_min == self.x_max == 0.0:
+            return quantize_activation(x, self.bits, self.symmetric)
+        return quantize_activation(x, self.bits, self.symmetric,
+                                   x_min=self.x_min, x_max=self.x_max)
+
+
+def prune_rows(w, ratio: float):
+    """Structured output-unit pruning (reference row pruning,
+    ``basic_layer.py:166``): L1 importance of each output feature (our
+    weights are (..., in, out) — output units are the LAST axis, the
+    transpose of torch's (out, in) rows), bottom ``ratio`` fraction zeroed.
+    Leading (layer-stack) axes prune independently."""
+    if ratio <= 0 or w.ndim < 2:
+        return w
+    norms = jnp.sum(jnp.abs(w.astype(jnp.float32)), axis=-2)  # (..., out)
+    n_out = w.shape[-1]
+    k = int(n_out * ratio)
+    if k <= 0:
+        return w
+    # exact-k by rank, not a threshold compare: tied scores under `> thresh`
+    # would prune every tied unit (all-equal weights → whole tensor zeroed)
+    rank = jnp.argsort(jnp.argsort(norms, axis=-1), axis=-1)
+    mask = (rank >= k)[..., None, :]  # broadcast over the in axis
+    return w * mask.astype(w.dtype)
+
+
+def prune_heads(w, num_heads: int, ratio: float):
+    """Structured head pruning on the attention output projection (reference
+    ``basic_layer.py:187 enable_head_pruning`` — "we apply the pruning to O
+    matrix"): the (..., nh·hd, H) input axis groups by head; heads are scored
+    by the L1 norm of their slice and the bottom ``ratio`` fraction masked."""
+    if ratio <= 0 or num_heads <= 1 or w.ndim < 2:
+        return w
+    d_in = w.shape[-2]
+    if d_in % num_heads:
+        return w
+    hd = d_in // num_heads
+    lead = w.shape[:-2]
+    grouped = w.reshape(*lead, num_heads, hd, w.shape[-1])
+    scores = jnp.sum(jnp.abs(grouped.astype(jnp.float32)), axis=(-2, -1))
+    k = int(num_heads * ratio)
+    if k <= 0:
+        return w
+    # exact-k by rank (see prune_rows): tied head scores must not over-prune
+    rank = jnp.argsort(jnp.argsort(scores, axis=-1), axis=-1)
+    mask = (rank >= k)[..., None, None]
+    return (grouped * mask.astype(w.dtype)).reshape(w.shape)
 
 
 class CompressionScheduler:
@@ -90,6 +264,56 @@ class CompressionScheduler:
             self.pruning.ratio = 1.0 - self.pruning.ratio
             self.pruning.modules = list(g.get("modules", ["*"]))
             break
+
+        aq = (config.get("activation_quantization", {}) or {}).get(
+            "shared_parameters", {})
+        self.act_quantize = ActQuantizeConfig(
+            enabled=bool(aq.get("enabled", False)),
+            symmetric="asym" not in str(aq.get("quantization_type", "symmetric")),
+            dynamic=str(aq.get("range_calibration", "dynamic")) != "static",
+            momentum=float(aq.get("act_range_momentum", 0.95)),
+            schedule_offset=int(aq.get("schedule_offset", 0)),
+        )
+        for g in (config.get("activation_quantization", {}) or {}).get(
+                "different_groups", {}).values():
+            self.act_quantize.bits = int(g.get("params", {}).get("bits", 8))
+            self.act_quantize.modules = list(g.get("modules", ["*"]))
+            break
+        # static-range calibration state (reference QuantAct.x_min_max buffer)
+        self.quant_act = QuantAct(
+            momentum=self.act_quantize.momentum,
+            symmetric=self.act_quantize.symmetric,
+            bits=self.act_quantize.bits,
+        ) if self.act_quantize.enabled else None
+
+        rp = (config.get("row_pruning", {}) or {}).get("shared_parameters", {})
+        self.row_pruning = RowPruningConfig(
+            enabled=bool(rp.get("enabled", False)),
+            method=rp.get("method", "l1"),
+            schedule_offset=int(rp.get("schedule_offset", 0)),
+        )
+        for g in (config.get("row_pruning", {}) or {}).get(
+                "different_groups", {}).values():
+            self.row_pruning.ratio = 1.0 - float(
+                g.get("params", {}).get("dense_ratio", 1.0))
+            self.row_pruning.modules = list(
+                g.get("modules", self.row_pruning.modules))
+            break
+
+        hp = (config.get("head_pruning", {}) or {}).get("shared_parameters", {})
+        self.head_pruning = HeadPruningConfig(
+            enabled=bool(hp.get("enabled", False)),
+            method=hp.get("method", "topk"),
+            num_heads=int(hp.get("num_heads", 0)),
+            schedule_offset=int(hp.get("schedule_offset", 0)),
+        )
+        for g in (config.get("head_pruning", {}) or {}).get(
+                "different_groups", {}).values():
+            self.head_pruning.ratio = 1.0 - float(
+                g.get("params", {}).get("dense_ratio", 1.0))
+            self.head_pruning.modules = list(
+                g.get("modules", self.head_pruning.modules))
+            break
         self.step_count = 0
 
     def step(self):
@@ -101,10 +325,51 @@ class CompressionScheduler:
             return wq.start_bits
         return wq.target_bits
 
+    def row_pruning_active(self) -> bool:
+        return (self.row_pruning.enabled
+                and self.step_count >= self.row_pruning.schedule_offset)
+
+    def head_pruning_active(self) -> bool:
+        return (self.head_pruning.enabled
+                and self.step_count >= self.head_pruning.schedule_offset)
+
+    def act_quant_active(self) -> bool:
+        return (self.act_quantize.enabled
+                and self.step_count >= self.act_quantize.schedule_offset)
+
     def active(self) -> bool:
         return (self.weight_quantize.enabled and
                 self.step_count >= self.weight_quantize.schedule_offset) or (
-            self.pruning.enabled and self.step_count >= self.pruning.schedule_offset)
+            self.pruning.enabled and self.step_count >= self.pruning.schedule_offset
+        ) or self.row_pruning_active() or self.head_pruning_active()
+
+    def weight_quant_active(self) -> bool:
+        return (self.weight_quantize.enabled
+                and self.step_count >= self.weight_quantize.schedule_offset)
+
+    def sparse_pruning_active(self) -> bool:
+        return (self.pruning.enabled
+                and self.step_count >= self.pruning.schedule_offset)
+
+    def jit_key(self):
+        """Hashable full schedule state — one compiled variant per distinct
+        value, so every schedule phase takes effect under jit. EVERY
+        technique's active bit is in the key: two steps where different
+        technique subsets are live must not share a trace. Static-range
+        activation quant contributes its FROZEN range (float pair), which
+        changes only at freeze time."""
+        act = None
+        if self.act_quant_active():
+            aq = self.act_quantize
+            rng = None
+            if not aq.dynamic and self.quant_act is not None \
+                    and self.quant_act.frozen:
+                rng = self.quant_act.range
+            act = (aq.bits, aq.symmetric, aq.dynamic, rng)
+        return (self.active(), self.weight_bits(),
+                (self.weight_quant_active(), self.sparse_pruning_active(),
+                 self.row_pruning_active(), self.head_pruning_active()),
+                act)
 
 
 def compress_params(params, scheduler: CompressionScheduler, num_bits: Optional[int] = None,
@@ -126,18 +391,32 @@ def compress_params(params, scheduler: CompressionScheduler, num_bits: Optional[
     bits = num_bits if num_bits is not None else scheduler.weight_bits()
     for i, (path, leaf) in enumerate(zip(paths, leaves)):
         x = leaf
-        if (wq.enabled and leaf.ndim >= 2 and _match(path, wq.modules)
+        # each technique gates on ITS OWN schedule offset — active() going
+        # true for one technique must not switch the others on early
+        if (scheduler.weight_quant_active() and leaf.ndim >= 2
+                and _match(path, wq.modules)
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
             groups = wq.quantize_groups if leaf.size % wq.quantize_groups == 0 else 1
             if spec_flat is not None and i < len(spec_flat):
                 groups = tp_aware_quantize_groups(leaf, spec_flat[i], topo, groups)
             x = fake_quantize(x, bits, groups, wq.symmetric)
-        if (pr.enabled and pr.ratio > 0 and leaf.ndim >= 2 and _match(path, pr.modules)
+        if (scheduler.sparse_pruning_active() and pr.ratio > 0
+                and leaf.ndim >= 2 and _match(path, pr.modules)
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
             k = int(x.size * pr.ratio)
             if k > 0:
                 thresh = jnp.sort(jnp.abs(x).ravel())[k - 1]
                 x = x * (jnp.abs(x) > thresh)
+        rp = scheduler.row_pruning
+        if (scheduler.row_pruning_active() and rp.ratio > 0 and leaf.ndim >= 2
+                and _match(path, rp.modules)
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            x = prune_rows(x, rp.ratio)
+        hp = scheduler.head_pruning
+        if (scheduler.head_pruning_active() and hp.ratio > 0 and leaf.ndim >= 2
+                and _match(path, hp.modules)
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            x = prune_heads(x, hp.num_heads, hp.ratio)
         out.append(x)
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -155,9 +434,20 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     if hasattr(cfg, "compression_config"):
         cfg = cfg.compression_config
     scheduler = CompressionScheduler(cfg or {})
-    if not (scheduler.weight_quantize.enabled or scheduler.pruning.enabled):
+    if not (scheduler.weight_quantize.enabled or scheduler.pruning.enabled
+            or scheduler.row_pruning.enabled or scheduler.head_pruning.enabled
+            or scheduler.act_quantize.enabled):
         logger.info("compression config inactive; model unchanged")
         return model, scheduler
+    if scheduler.head_pruning.enabled and scheduler.head_pruning.num_heads <= 0:
+        # the reference requires num_heads for head pruning; infer from the
+        # model config when the block omits it
+        scheduler.head_pruning.num_heads = int(
+            getattr(getattr(model, "config", None), "num_heads", 0))
+        if scheduler.head_pruning.num_heads <= 0:
+            raise ValueError(
+                "head_pruning requires shared_parameters.num_heads (or a "
+                "model exposing config.num_heads)")
 
     orig_apply = model.apply
 
@@ -165,6 +455,24 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
         if scheduler.active():
             params = compress_params(params, scheduler)
         return orig_apply(params, batch, train=train, rng=rng)
+
+    if scheduler.act_quantize.enabled:
+        aq = scheduler.act_quantize
+
+        def act_quant_fn(x):
+            # trace-time schedule gate: the engine keys jit variants on
+            # CompressionScheduler.jit_key(), which includes this state
+            if not scheduler.act_quant_active():
+                return x
+            if not aq.dynamic and scheduler.quant_act is not None \
+                    and scheduler.quant_act.frozen:
+                lo, hi = scheduler.quant_act.range
+                return quantize_activation(x, aq.bits, aq.symmetric,
+                                           x_min=lo, x_max=hi)
+            # dynamic calibration (or static not yet frozen): in-graph range
+            return quantize_activation(x, aq.bits, aq.symmetric)
+
+        model._act_quant_fn = act_quant_fn
 
     # the engine uses these to build schedule-keyed jit variants over the
     # ORIGINAL apply instead of baking the wrapper's trace-time state
@@ -174,7 +482,10 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     log_dist(
         f"compression: weight_quant={scheduler.weight_quantize.enabled} "
         f"(bits={scheduler.weight_quantize.target_bits}) "
-        f"pruning={scheduler.pruning.enabled} (ratio={scheduler.pruning.ratio})",
+        f"pruning={scheduler.pruning.enabled} (ratio={scheduler.pruning.ratio}) "
+        f"act_quant={scheduler.act_quantize.enabled} "
+        f"row_pruning={scheduler.row_pruning.enabled} "
+        f"head_pruning={scheduler.head_pruning.enabled}",
         ranks=[0],
     )
     return model, scheduler
@@ -266,6 +577,43 @@ def tp_aware_quantize_groups(leaf, spec, topo, requested_groups: int) -> int:
     return nbase * m
 
 
+def calibrate_activation_ranges(model, params, batches, scheduler,
+                                freeze: bool = True) -> None:
+    """Static-range calibration pass (reference QuantAct's training-time
+    momentum tracking, ``basic_layer.py:47-58``): run eager forwards with an
+    OBSERVING hook at the activation-quant sites, EMA-updating the scheduler's
+    ``quant_act`` range, then freeze it so compiled programs bake the range
+    as a constant (one recompile via ``jit_key``).
+
+    Static mode does nothing until this runs — under jit the library cannot
+    read activations back per step, so calibration is an explicit eager pass
+    over representative ``batches`` (the usual post-training-quantization
+    workflow). Without it, static configs fall back to dynamic in-graph
+    ranges.
+    """
+    if scheduler.quant_act is None:
+        raise ValueError("activation_quantization is not enabled")
+    qa = scheduler.quant_act
+    orig = getattr(model, "_act_quant_fn", None)
+
+    def observer(x):
+        qa.observe(x)  # eager: x is concrete here
+        return x
+
+    model._act_quant_fn = observer
+    apply_fn = getattr(model, "_uncompressed_apply", model.apply)
+    try:
+        for b in batches:
+            apply_fn(params, b, train=False)
+    finally:
+        model._act_quant_fn = orig
+    if freeze:
+        qa.freeze()
+    log_dist(
+        f"activation-range calibration: range={qa.range} frozen={qa.frozen}",
+        ranks=[0])
+
+
 def redundancy_clean(model, deepspeed_config, mpu=None):
     """reference ``redundancy_clean``: materialize compression permanently —
     here: return a params-transform users apply once post-training."""
@@ -273,5 +621,20 @@ def redundancy_clean(model, deepspeed_config, mpu=None):
         deepspeed_config.compression_config
         if hasattr(deepspeed_config, "compression_config") else deepspeed_config or {}
     )
+    if scheduler.head_pruning.enabled and scheduler.head_pruning.ratio > 0 \
+            and scheduler.head_pruning.num_heads <= 0:
+        # no model here to infer num_heads from (init_compression can);
+        # silently skipping the configured head pruning would be worse
+        raise ValueError(
+            "redundancy_clean: head_pruning requires "
+            "shared_parameters.num_heads in the compression config")
+    # post-training materialization applies every configured technique
+    # regardless of schedule position — advance past all offsets
+    scheduler.step_count = max(
+        scheduler.weight_quantize.schedule_offset,
+        scheduler.pruning.schedule_offset,
+        scheduler.row_pruning.schedule_offset,
+        scheduler.head_pruning.schedule_offset,
+        scheduler.act_quantize.schedule_offset)
     return lambda params: compress_params(params, scheduler,
                                           num_bits=scheduler.weight_quantize.target_bits)

@@ -61,7 +61,14 @@ from .zero.partition import (
 
 def _gather_to_host(tree):
     """Materialize every jax.Array as a host numpy array, collectively gathering
-    shards that are not fully addressable from this process (multi-host save)."""
+    shards that are not fully addressable from this process (multi-host save).
+
+    Device→host pulls go through ``chunked_device_get`` so checkpoint gathers
+    never queue more than ~32 MB per flight on a tunnel-backed device — a
+    SIGKILL mid-gather with ~1 GB queued wedges the relay (utils/transfer.py,
+    r4 postmortem)."""
+    from ..utils.transfer import chunked_device_get
+
     def to_np(x):
         if isinstance(x, jax.Array):
             if not x.is_fully_addressable:
@@ -70,7 +77,7 @@ def _gather_to_host(tree):
                 # tiled=True: reassemble the GLOBAL value from the per-process
                 # shards (required for non-fully-addressable global arrays)
                 return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-            return np.asarray(jax.device_get(x))
+            return chunked_device_get(x)
         return x
 
     return jax.tree.map(to_np, tree)
@@ -106,6 +113,12 @@ class LazyLoss:
     extra compute). Interops with python/numpy via ``float()``/``__array__``;
     for jnp ops use ``.value`` (jax 0.9 removed the ``__jax_array__``
     abstractification hook, so jnp cannot consume the wrapper directly).
+
+    ``__eq__``/``__hash__`` are both VALUE-based (hash forces the device
+    value) so the hash/eq contract holds for dict/set membership; every
+    comparison or hash on the wrapper synchronizes with the device — code
+    that wants the raw jnp scalar without wrapper semantics should read
+    ``.value`` once and use that (see docs/MIGRATING.md).
     """
 
     __slots__ = ("_fused_fn", "_loss_fn", "_args", "_loss", "_forced_early")
@@ -231,7 +244,12 @@ class LazyLoss:
             return False
         return self._force() != o
 
-    __hash__ = object.__hash__  # identity hash: eq forces, hash must not
+    def __hash__(self):
+        # value-based, matching __eq__ (hash/eq contract): two losses that
+        # compare equal must hash equal for dict/set membership to behave.
+        # Forces the device value — same cost class as any comparison on the
+        # wrapper; use `.value` where a jnp array (no host sync) is wanted.
+        return hash(float(self._force()))
 
 
 class DeepSpeedEngine:
@@ -1124,7 +1142,9 @@ class DeepSpeedEngine:
         train_loss = self._train_loss
         comp_key = None
         if self._compression is not None:
-            comp_key = (self._compression.active(), self._compression.weight_bits())
+            # full schedule state (weight bits, prune phases, act-quant mode/
+            # frozen range) — one compiled variant per distinct value
+            comp_key = self._compression.jit_key()
         ltd_keep = self._ltd_keep_now()
         if ltd_keep is not None and not isinstance(batch, dict):
             raise ValueError(
@@ -1624,15 +1644,24 @@ class DeepSpeedEngine:
         model_sd = self.checkpoint_engine.load(model_path)
 
         module = model_sd["module"]
+        # chunked host→device pushes: a checkpoint's full param tree can be
+        # GBs; bounding each flight at ~32 MB keeps a kill mid-load from
+        # wedging a tunnel-backed relay (utils/transfer.py, r4 postmortem).
+        # Casts happen host-side so the tunnel moves target-dtype bytes.
+        from ..utils.transfer import chunked_device_put
+
+        np_f32 = np.dtype(np.float32)
+        # ml_dtypes (a jax dependency) registers bfloat16 with numpy
+        np_compute = np.dtype(jnp.dtype(self.compute_dtype).name)
         if self._mixed and self._offload_mgr is None:
-            self.master_params = jax.device_put(
-                jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), module),
+            self.master_params = chunked_device_put(
+                jax.tree.map(lambda p: np.asarray(p).astype(np_f32), module),
                 self._opt_shardings,
             )
         # under offload the fp32 master lives host/NVMe-side (restored below);
         # materializing a device copy would defeat the offload
-        self.params = jax.device_put(
-            jax.tree.map(lambda p: jnp.asarray(p, self.compute_dtype), module),
+        self.params = chunked_device_put(
+            jax.tree.map(lambda p: np.asarray(p).astype(np_compute), module),
             self._param_shardings,
         )
         self.global_steps = int(model_sd.get("global_steps", 0))
