@@ -20,6 +20,17 @@ import numpy as np
 from .curriculum_scheduler import CurriculumScheduler
 
 
+def _sample_ids(sample):
+    """Token ids from a sample in any supported shape: a dict with
+    ``input_ids`` (HF-style), an (ids, ...) tuple, or a bare token array —
+    the layout an ``MMapIndexedDataset`` row serves (indexed_dataset.py)."""
+    if isinstance(sample, dict):
+        return sample["input_ids"]
+    if isinstance(sample, np.ndarray):
+        return sample
+    return sample[0]
+
+
 class DataAnalyzer:
     """Offline per-sample difficulty metrics (reference ``data_analyzer.py``)."""
 
@@ -30,11 +41,11 @@ class DataAnalyzer:
         self.metric_fns = dict(metric_fns or {})
 
     def _seqlen(self, sample) -> float:
-        ids = sample["input_ids"] if isinstance(sample, dict) else sample[0]
+        ids = _sample_ids(sample)
         return float(np.asarray(ids).shape[-1] if np.asarray(ids).ndim else 1)
 
     def _vocab_rarity(self, sample, freq: np.ndarray) -> float:
-        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict) else sample[0])
+        ids = np.asarray(_sample_ids(sample))
         return float(-np.log(freq[ids.reshape(-1)] + 1e-12).mean())
 
     def run(self, metrics: Sequence[str] = ("seqlen",)) -> Dict[str, np.ndarray]:
@@ -44,7 +55,7 @@ class DataAnalyzer:
         needs_freq = "vocab_rarity" in metrics and "vocab_rarity" not in self.metric_fns
         if needs_freq and len(self.dataset):
             all_ids = np.concatenate([
-                np.asarray(s["input_ids"] if isinstance(s, dict) else s[0]).reshape(-1)
+                np.asarray(_sample_ids(s)).reshape(-1)
                 for s in self.dataset
             ])
             counts = np.bincount(all_ids)
@@ -199,8 +210,7 @@ class DataAnalyzer:
 
     @staticmethod
     def _ids(sample):
-        return np.asarray(
-            sample["input_ids"] if isinstance(sample, dict) else sample[0])
+        return np.asarray(_sample_ids(sample))
 
     @staticmethod
     def load_index(output_dir: str, metrics: Sequence[str],

@@ -1,0 +1,210 @@
+"""Megatron ``.idx``/``.bin`` MMapIndexedDataset — binary-compatible reader
+and builder.
+
+Reference: ``deepspeed/runtime/data_pipeline/data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset:369``, ``MMapIndexedDatasetBuilder:575``, index header
+``_HDR_MAGIC = b'MMIDIDX\\x00\\x00'`` + version + dtype code, then
+``<Q len><Q doc_count>`` followed by int32 sizes, int64 byte pointers and the
+int64 document index).  The data-efficiency stack (analyzer → curriculum
+sampler) consumes corpora in exactly this layout, so parity means reading and
+writing the same bytes — NOT a lookalike format.  Files produced by
+Megatron-LM / Megatron-DeepSpeed preprocessing load here unchanged, and files
+built here load in the reference.
+
+numpy-only (no torch): samples are ``np.ndarray`` token rows served from one
+memory map, which is also what the analyzer's chunked map-reduce and the
+``DeepSpeedDataSampler`` difficulty indexing expect.
+"""
+
+import os
+import struct
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+_HDR_MAGIC = b"MMIDIDX\x00\x00"
+
+#: dtype codes, exactly the reference table (indexed_dataset.py:102 dtypes)
+DTYPES = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.uint16,
+    7: np.uint32,
+    8: np.uint64,
+}
+_CODES = {np.dtype(v): k for k, v in DTYPES.items()}
+
+
+def code(dtype) -> int:
+    dt = np.dtype(dtype)
+    if dt not in _CODES:
+        raise ValueError(
+            f"{dtype} not supported (supported: {sorted(set(DTYPES.values()), key=str)})")
+    return _CODES[dt]
+
+
+def index_file_path(prefix_path: str) -> str:
+    return prefix_path + ".idx"
+
+
+def data_file_path(prefix_path: str) -> str:
+    return prefix_path + ".bin"
+
+
+class _Index:
+    """Parsed ``.idx`` file (reference ``MMapIndexedDataset.Index``)."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as stream:
+            magic = stream.read(9)
+            if magic != _HDR_MAGIC:
+                raise ValueError(
+                    f"{path}: bad magic {magic!r} — not an MMIDIDX index")
+            version, = struct.unpack("<Q", stream.read(8))
+            if version != 1:
+                raise ValueError(f"{path}: unsupported index version {version}")
+            dtype_code, = struct.unpack("<B", stream.read(1))
+            if dtype_code not in DTYPES:
+                raise ValueError(f"{path}: unknown dtype code {dtype_code}")
+            self.dtype = DTYPES[dtype_code]
+            self._len, = struct.unpack("<Q", stream.read(8))
+            self._doc_count, = struct.unpack("<Q", stream.read(8))
+            offset = stream.tell()
+        buf = memoryview(np.memmap(path, mode="r", order="C"))
+        self.sizes = np.frombuffer(buf, dtype=np.int32, count=self._len,
+                                   offset=offset)
+        self.pointers = np.frombuffer(buf, dtype=np.int64, count=self._len,
+                                      offset=offset + self.sizes.nbytes)
+        self.doc_idx = np.frombuffer(
+            buf, dtype=np.int64, count=self._doc_count,
+            offset=offset + self.sizes.nbytes + self.pointers.nbytes)
+
+    def __len__(self) -> int:
+        return self._len
+
+    @staticmethod
+    def write(path: str, sizes: Sequence[int], doc_idx: Sequence[int], dtype):
+        """Write the reference's exact byte layout (Index.writer.write)."""
+        itemsize = np.dtype(dtype).itemsize
+        with open(path, "wb") as f:
+            f.write(_HDR_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", code(dtype)))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(doc_idx)))
+            f.write(np.asarray(sizes, dtype=np.int32).tobytes(order="C"))
+            # exclusive scan of byte sizes -> per-sequence byte offsets
+            pointers = np.asarray(sizes, dtype=np.int64) * itemsize
+            pointers = np.concatenate([[0], np.cumsum(pointers)[:-1]]) \
+                if len(sizes) else np.zeros(0, np.int64)
+            f.write(pointers.astype(np.int64).tobytes(order="C"))
+            f.write(np.asarray(doc_idx, dtype=np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Read-only mmap view of a Megatron ``.idx``/``.bin`` corpus.
+
+    ``ds[i]`` → the i-th sequence as a 1-D numpy array (a zero-copy slice of
+    the data mmap); ``ds.get(i, offset, length)`` mirrors the reference's
+    partial read."""
+
+    def __init__(self, path_prefix: str):
+        self.path_prefix = path_prefix
+        self._index = _Index(index_file_path(path_prefix))
+        self._bin = np.memmap(data_file_path(path_prefix), mode="r", order="C")
+        self._buf = memoryview(self._bin)
+
+    # -- container protocol -------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __getitem__(self, idx: Union[int, slice]):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(idx)
+        ptr = int(self._index.pointers[idx])
+        size = int(self._index.sizes[idx])
+        return np.frombuffer(self._buf, dtype=self._index.dtype, count=size,
+                             offset=ptr)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None):
+        ptr = int(self._index.pointers[idx])
+        size = int(self._index.sizes[idx])
+        if length is None:
+            length = size - offset
+        ptr += offset * np.dtype(self._index.dtype).itemsize
+        return np.frombuffer(self._buf, dtype=self._index.dtype, count=length,
+                             offset=ptr)
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._index.sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return self._index.doc_idx
+
+    @property
+    def dtype(self):
+        return self._index.dtype
+
+    @staticmethod
+    def exists(path_prefix: str) -> bool:
+        return (os.path.exists(index_file_path(path_prefix))
+                and os.path.exists(data_file_path(path_prefix)))
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer for the ``.bin`` + ``.idx`` pair (reference
+    ``MMapIndexedDatasetBuilder:575``)."""
+
+    def __init__(self, out_file: str, dtype=np.int64):
+        self._path = out_file
+        self._data_file = open(out_file, "wb")
+        self._dtype = np.dtype(dtype).type
+        self._sizes: List[int] = []
+        self._doc_idx: List[int] = [0]
+
+    def add_item(self, tokens) -> None:
+        arr = np.asarray(tokens)
+        if arr.dtype != self._dtype:
+            arr = arr.astype(self._dtype)
+        self._data_file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def add_items(self, token_list) -> None:
+        for t in token_list:
+            self.add_item(t)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def merge_file_(self, another_prefix: str) -> None:
+        """Concatenate another ``.idx``/``.bin`` pair (distributed builds
+        merge worker shards this way — reference merge_file_)."""
+        index = _Index(index_file_path(another_prefix))
+        if index.dtype != self._dtype:
+            raise ValueError(
+                f"dtype mismatch merging {another_prefix}: "
+                f"{index.dtype} vs {self._dtype}")
+        offset = len(self._sizes)
+        self._sizes.extend(index.sizes.tolist())
+        self._doc_idx.extend((offset + index.doc_idx[1:]).tolist())
+        with open(data_file_path(another_prefix), "rb") as f:
+            import shutil
+
+            shutil.copyfileobj(f, self._data_file)
+
+    def finalize(self, index_file: Optional[str] = None) -> None:
+        self._data_file.close()
+        if index_file is None:
+            index_file = index_file_path(
+                self._path[:-4] if self._path.endswith(".bin") else self._path)
+        _Index.write(index_file, self._sizes, self._doc_idx, self._dtype)

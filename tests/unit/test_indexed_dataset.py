@@ -1,0 +1,143 @@
+"""Megatron ``.idx``/``.bin`` MMapIndexedDataset tests (reference
+``data_sampling/indexed_dataset.py:369,575``): byte-exact header layout,
+builder↔reader round trip, shard merging, and the data-efficiency pipeline
+(analyzer → curriculum sampler) driven off a real ``.bin`` fixture."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    DTYPES,
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    code,
+    data_file_path,
+    index_file_path,
+)
+
+
+def _build(tmp_path, docs, dtype=np.uint16, name="corpus"):
+    prefix = str(tmp_path / name)
+    b = MMapIndexedDatasetBuilder(data_file_path(prefix), dtype=dtype)
+    for doc in docs:
+        for seq in doc:
+            b.add_item(np.asarray(seq))
+        b.end_document()
+    b.finalize(index_file_path(prefix))
+    return prefix
+
+
+class TestRoundTrip:
+    def test_sequences_exact(self, tmp_path):
+        rng = np.random.default_rng(0)
+        docs = [[rng.integers(0, 50000, (n,)).astype(np.uint16)
+                 for n in (5, 17, 1)],
+                [rng.integers(0, 50000, (23,)).astype(np.uint16)]]
+        prefix = _build(tmp_path, docs)
+        ds = MMapIndexedDataset(prefix)
+        flat = [s for d in docs for s in d]
+        assert len(ds) == len(flat)
+        for i, want in enumerate(flat):
+            np.testing.assert_array_equal(ds[i], want)
+        assert ds.dtype == np.uint16
+        np.testing.assert_array_equal(ds.sizes, [5, 17, 1, 23])
+        np.testing.assert_array_equal(ds.doc_idx, [0, 3, 4])
+
+    def test_partial_get_and_negative_index(self, tmp_path):
+        prefix = _build(tmp_path, [[np.arange(10)]], dtype=np.int64)
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.get(0, offset=3, length=4),
+                                      [3, 4, 5, 6])
+        np.testing.assert_array_equal(ds[-1], np.arange(10))
+        with pytest.raises(IndexError):
+            ds[1]
+
+    def test_header_bytes_are_reference_layout(self, tmp_path):
+        """Parse the .idx with raw struct reads against the reference's
+        documented layout (indexed_dataset.py:382-417): magic, <Q version=1,
+        <B dtype code, <Q len, <Q doc_count, int32 sizes, int64 exclusive-scan
+        byte pointers, int64 doc_idx."""
+        prefix = _build(tmp_path, [[np.zeros(4), np.zeros(6)]], dtype=np.int32)
+        raw = open(index_file_path(prefix), "rb").read()
+        assert raw[:9] == b"MMIDIDX\x00\x00"
+        version, = struct.unpack("<Q", raw[9:17])
+        dcode, = struct.unpack("<B", raw[17:18])
+        n, docs = struct.unpack("<QQ", raw[18:34])
+        assert (version, DTYPES[dcode], n, docs) == (1, np.int32, 2, 2)
+        sizes = np.frombuffer(raw, np.int32, count=2, offset=34)
+        ptrs = np.frombuffer(raw, np.int64, count=2, offset=34 + 8)
+        np.testing.assert_array_equal(sizes, [4, 6])
+        np.testing.assert_array_equal(ptrs, [0, 16])  # 4 * int32 = 16 bytes
+        assert len(raw) == 34 + 8 + 16 + 16  # sizes + pointers + doc_idx
+
+    def test_dtype_codes_match_reference_table(self):
+        # indexed_dataset.py:102 dtypes — same code → numpy type mapping
+        assert DTYPES == {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+                          5: np.int64, 6: np.uint16, 7: np.uint32, 8: np.uint64}
+        assert code(np.uint16) == 6 and code("int64") == 5
+        with pytest.raises(ValueError):
+            code(np.float32)
+
+    def test_merge_shards(self, tmp_path):
+        a = _build(tmp_path, [[np.arange(3)]], dtype=np.int32, name="a")
+        b = _build(tmp_path, [[np.arange(4, 9)], [np.arange(2)]],
+                   dtype=np.int32, name="b")
+        merged = str(tmp_path / "merged")
+        bld = MMapIndexedDatasetBuilder(data_file_path(merged), dtype=np.int32)
+        bld.merge_file_(a)
+        bld.merge_file_(b)
+        bld.finalize(index_file_path(merged))
+        ds = MMapIndexedDataset(merged)
+        assert len(ds) == 3
+        np.testing.assert_array_equal(ds[0], np.arange(3))
+        np.testing.assert_array_equal(ds[1], np.arange(4, 9))
+        np.testing.assert_array_equal(ds[2], np.arange(2))
+        np.testing.assert_array_equal(ds.doc_idx, [0, 1, 2, 3])
+
+    def test_exists(self, tmp_path):
+        prefix = _build(tmp_path, [[np.arange(2)]])
+        assert MMapIndexedDataset.exists(prefix)
+        assert not MMapIndexedDataset.exists(str(tmp_path / "nope"))
+
+
+class TestDataEfficiencyIntegration:
+    def test_curriculum_sampler_from_bin_fixture(self, tmp_path):
+        """The reference pipeline end-to-end on a real .bin: analyzer scores
+        difficulty (seqlen) over the mmap corpus, the curriculum sampler
+        yields only easy sequences early and everything once saturated."""
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+            CurriculumScheduler,
+        )
+        from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+            DataAnalyzer,
+            DeepSpeedDataSampler,
+        )
+
+        rng = np.random.default_rng(1)
+        lens = [4, 8, 16, 32, 64, 128]
+        prefix = _build(
+            tmp_path,
+            [[rng.integers(0, 1000, (n,)).astype(np.uint16)] for n in lens])
+        ds = MMapIndexedDataset(prefix)
+
+        metrics = DataAnalyzer(ds).run(metrics=("seqlen",))
+        np.testing.assert_array_equal(metrics["seqlen"], lens)
+
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen",
+            "min_difficulty": 8,
+            "max_difficulty": 128,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8},
+        })
+        sampler = DeepSpeedDataSampler(
+            difficulties=metrics["seqlen"], scheduler=sched, batch_size=2,
+            drop_last=False, seed=0)
+        sampler.set_step(0)
+        early = sampler.eligible_indices()
+        assert set(np.asarray(metrics["seqlen"])[early]) <= {4, 8}
+        sampler.set_step(10)  # past total_curriculum_step → all eligible
+        assert len(sampler.eligible_indices()) == len(lens)
