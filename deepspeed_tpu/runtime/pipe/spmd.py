@@ -17,6 +17,19 @@ reverse ppermutes, i.e. the same bidirectional pipeline the reference schedules
 by hand, with none of the schedule code. Microbatch-level rematerialisation
 (``jax.checkpoint`` on the tick body) bounds activation memory exactly like the
 reference's per-microbatch activation stashing.
+
+Memory/throughput tradeoff (read before raising ``num_micro`` at long seq):
+the scan stacks every tick's stage output (``ys``: M+P-1 activations per
+stage) so the post-scan head can consume the last stage's completed
+microbatches without a second pipeline pass, and the vmapped ``first_fn``
+holds all M embed outputs. Peak activation memory per stage therefore grows
+O(M) in microbatch count — the price of the single-program design (the
+reference's instruction stream streams them at O(1) but pays per-microbatch
+dispatch). With ``remat`` the per-layer recompute keeps the per-tick term
+small, so the O(M)·(B/M)·S·H ys stash dominates at large M·S; size
+microbatches so that stash fits HBM (it equals one full batch's residual
+stream per stage). Masking ys down to the last stage only would not help:
+shard_map keeps the same buffer shape on every pipe rank.
 """
 
 from functools import partial
@@ -62,6 +75,49 @@ def spmd_pipeline(
     P_ = mesh.shape[axis]
     M = num_micro
     T = M + P_ - 1
+
+    if P_ == 1:
+        # degenerate pipeline: no manual pipe axis (a size-1 shard_map axis
+        # trips XLA's SPMD partitioner RET_CHECK on the CPU backend, and a
+        # self-ppermute buys nothing). Same structure — vectorized ingestion,
+        # per-microbatch stage_fn with identical remat, sequential head via
+        # lax.map — which is exactly the pp1 baseline the pipe bench row
+        # normalizes against.
+        stages_local = (jax.tree.map(lambda a: a[0], params["stages"])
+                        if "stages" in params else None)
+        seg_params = stages_local if stages_local is not None else params
+        if pass_full_params:
+            seg_params = (stages_local, params)
+        states0 = jax.vmap(lambda f: first_fn(params, f))(feed)
+
+        def micro_body(m):
+            feed_t = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                feed)
+            x0 = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                states0)
+            rng_t = None
+            if rng is not None:
+                rng_t = jax.random.fold_in(jax.random.fold_in(rng, m), 0)
+            y, aux = stage_fn(seg_params, x0, feed_t, rng_t)
+            loss_sum, denom = last_fn(params, y, feed_t)
+            return loss_sum, denom, aux
+
+        # honor `remat` exactly like the multi-stage tick: each microbatch's
+        # body rematerializes so only M small residuals stay live
+        body_fn = jax.checkpoint(micro_body) if remat else micro_body
+
+        def one(m, carry):
+            loss_sum, denom, aux = body_fn(m)
+            l, d, a = carry
+            return l + loss_sum, d + denom, a + aux
+
+        loss_sum, denom, aux_sum = jnp.zeros((), jnp.float32), \
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+        loss_sum, denom, aux_sum = lax.fori_loop(
+            0, M, lambda m, c: one(m, c), (loss_sum, denom, aux_sum))
+        return loss_sum / jnp.maximum(denom, 1.0), aux_sum / M
 
     from jax.sharding import PartitionSpec
 
