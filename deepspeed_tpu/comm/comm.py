@@ -46,6 +46,145 @@ def is_initialized() -> bool:
     return _initialized
 
 
+# ---------------------------------------------------------------------------
+# Environment discovery shims (reference comm/comm.py:673 mpi_discovery,
+# :714-760 in_aml/in_aws_sm/in_dlts + env patch helpers).  The reference maps
+# cluster launchers onto torch rendezvous vars (MASTER_ADDR/RANK/...); here
+# they map onto the coordinator rendezvous this runtime uses
+# (COORDINATOR_ADDRESS / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID), which
+# jax.distributed.initialize consumes in init_distributed below.
+# ---------------------------------------------------------------------------
+
+DEFAULT_COORDINATOR_PORT = 29500
+
+
+def in_aml() -> bool:
+    """Inside an Azure Machine Learning job?"""
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def in_aws_sm() -> bool:
+    """Inside an AWS SageMaker training job?"""
+    return "SM_TRAINING_ENV" in os.environ
+
+
+def in_dlts() -> bool:
+    """On a DLTS cluster?"""
+    return "DLTS_JOB_ID" in os.environ
+
+
+def mpi_discovery(distributed_port: int = DEFAULT_COORDINATOR_PORT,
+                  verbose: bool = True) -> None:
+    """Discover an MPI launch and map it onto the coordinator rendezvous env.
+
+    Prefers mpi4py (true hostname bcast, like the reference); without it,
+    falls back to the OpenMPI / PMI environment variables the launcher
+    exports.  Sets RANK / WORLD_SIZE / LOCAL_RANK for reference-env parity
+    plus DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID / COORDINATOR_ADDRESS for
+    ``init_distributed``.
+    """
+    rank = world_size = local_rank = None
+    master_addr = None
+    try:
+        from mpi4py import MPI  # optional — not in the baked image
+
+        comm = MPI.COMM_WORLD
+        rank, world_size = comm.Get_rank(), comm.Get_size()
+        if rank == 0:
+            import socket
+
+            master_addr = socket.gethostbyname(socket.gethostname())
+        master_addr = comm.bcast(master_addr, root=0)
+        proc = MPI.Get_processor_name()
+        all_procs = comm.allgather(proc)
+        local_rank = sum(p == proc for p in all_procs[:rank])
+    except ImportError:
+        for rv, wv, lv in (("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+                            "OMPI_COMM_WORLD_LOCAL_RANK"),
+                           ("PMI_RANK", "PMI_SIZE", None)):
+            if rv in os.environ and wv in os.environ:
+                rank = int(os.environ[rv])
+                world_size = int(os.environ[wv])
+                local_rank = int(os.environ[lv]) if lv and lv in os.environ else 0
+                break
+        if rank is None:
+            raise RuntimeError(
+                "mpi_discovery: no mpi4py and no OMPI_*/PMI_* environment — "
+                "not an MPI launch")
+        master_addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    os.environ["LOCAL_RANK"] = str(local_rank)
+    os.environ.setdefault("MASTER_ADDR", master_addr)
+    os.environ.setdefault("MASTER_PORT", str(distributed_port))
+    os.environ["DSTPU_NUM_PROCESSES"] = str(world_size)
+    os.environ["DSTPU_PROCESS_ID"] = str(rank)
+    os.environ.setdefault("COORDINATOR_ADDRESS",
+                          f"{master_addr}:{distributed_port}")
+    if verbose:
+        logger.info(
+            f"mpi_discovery: rank={rank} local_rank={local_rank} "
+            f"world={world_size} coordinator={os.environ['COORDINATOR_ADDRESS']}")
+
+
+def patch_aml_env(master_port: int = DEFAULT_COORDINATOR_PORT,
+                  verbose: bool = True) -> None:
+    """AzureML OpenMPI launch → coordinator rendezvous (reference
+    ``patch_aml_env_for_torch_nccl_backend:728``)."""
+    rank = os.environ["OMPI_COMM_WORLD_RANK"]
+    world = os.environ["OMPI_COMM_WORLD_SIZE"]
+    single_node = int(os.environ["OMPI_COMM_WORLD_LOCAL_SIZE"]) == int(world)
+    if not single_node:
+        addr = os.environ["AZ_BATCH_MASTER_NODE"].split(":")[0]
+    else:
+        addr = os.environ["AZ_BATCHAI_MPI_MASTER_NODE"]
+    os.environ["RANK"] = rank
+    os.environ["WORLD_SIZE"] = world
+    os.environ["LOCAL_RANK"] = os.environ["OMPI_COMM_WORLD_LOCAL_RANK"]
+    os.environ.setdefault("MASTER_ADDR", addr)
+    os.environ.setdefault("MASTER_PORT", str(master_port))
+    os.environ["DSTPU_NUM_PROCESSES"] = world
+    os.environ["DSTPU_PROCESS_ID"] = rank
+    os.environ.setdefault("COORDINATOR_ADDRESS", f"{addr}:{master_port}")
+    if verbose:
+        logger.info(
+            f"AzureML env: rank={rank} world={world} "
+            f"coordinator={os.environ['COORDINATOR_ADDRESS']}")
+
+
+def patch_aws_sm_env(verbose: bool = True) -> None:
+    """SageMaker OpenMPI launch → rank env (reference
+    ``patch_aws_sm_env_for_torch_nccl_backend:760``; SageMaker already
+    provides MASTER_ADDR/PORT)."""
+    rank = os.environ["OMPI_COMM_WORLD_RANK"]
+    world = os.environ["OMPI_COMM_WORLD_SIZE"]
+    os.environ["RANK"] = rank
+    os.environ["LOCAL_RANK"] = os.environ["OMPI_COMM_WORLD_LOCAL_RANK"]
+    os.environ["WORLD_SIZE"] = world
+    os.environ["DSTPU_NUM_PROCESSES"] = world
+    os.environ["DSTPU_PROCESS_ID"] = rank
+    if "MASTER_ADDR" in os.environ:
+        os.environ.setdefault(
+            "COORDINATOR_ADDRESS",
+            f"{os.environ['MASTER_ADDR']}:"
+            f"{os.environ.get('MASTER_PORT', DEFAULT_COORDINATOR_PORT)}")
+    if verbose:
+        logger.info(f"SageMaker env: rank={rank} world={world}")
+
+
+def _auto_discover_environment(verbose: bool = True) -> None:
+    """Called by init_distributed when no coordinator env is present: map
+    whichever cluster environment we're in onto the rendezvous vars."""
+    has_ompi = "OMPI_COMM_WORLD_RANK" in os.environ
+    if in_aml() and has_ompi:
+        patch_aml_env(verbose=verbose)
+    elif in_aws_sm() and has_ompi:
+        patch_aws_sm_env(verbose=verbose)
+    elif (int(os.environ.get("OMPI_COMM_WORLD_SIZE", "1")) > 1
+          or int(os.environ.get("PMI_SIZE", "1")) > 1):
+        mpi_discovery(verbose=verbose)
+
+
 def init_distributed(
     dist_backend: str = "xla",
     auto_mpi_discovery: bool = True,
@@ -72,6 +211,12 @@ def init_distributed(
         if mesh_config is not None:
             initialize_topology(mesh_config=mesh_config)
         return
+    if auto_mpi_discovery and "COORDINATOR_ADDRESS" not in os.environ \
+            and "DSTPU_NUM_PROCESSES" not in os.environ:
+        # cluster-environment shims (reference comm.py:604 auto discovery):
+        # AzureML / SageMaker / bare MPI launches export their own rank vars;
+        # map them onto the coordinator rendezvous before reading the world
+        _auto_discover_environment(verbose=verbose)
     n_expected = int(os.environ.get("DSTPU_NUM_PROCESSES", os.environ.get("WORLD_SIZE", "1")))
     if n_expected > 1:
         # NOTE: initialize() must run BEFORE anything touches the XLA backend
@@ -90,6 +235,14 @@ def init_distributed(
                 num_processes=n_expected,
                 process_id=int(os.environ[rank_var]),
             )
+        if timeout is not None:
+            # bound the rendezvous: a missing peer must FAIL with a clear
+            # error inside the budget, never hang the job (reference
+            # init_distributed timeout contract, comm.py:604; seconds or
+            # datetime.timedelta accepted)
+            secs = timeout.total_seconds() if hasattr(
+                timeout, "total_seconds") else float(timeout)
+            kw["initialization_timeout"] = int(secs)
         try:
             jax.distributed.initialize(**kw)
         except RuntimeError as e:

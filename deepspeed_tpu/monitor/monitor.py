@@ -22,6 +22,28 @@ class Monitor:
     def write_events(self, events: List[Event]):
         raise NotImplementedError
 
+    # -- optional richer surfaces (reference TB/WandB depth) ---------------
+    def write_scalars(self, scalars, step: int):
+        """Dict of label -> value at one step (wandb-style scalars dict)."""
+        self.write_events([(k, float(v), step) for k, v in scalars.items()])
+
+    def write_histogram(self, label: str, values, step: int):
+        """Distribution logging; sinks without native histograms record
+        summary statistics."""
+        import numpy as _np
+
+        v = _np.asarray(values, dtype=_np.float64).reshape(-1)
+        if v.size == 0:
+            return
+        stats = {
+            f"{label}/min": float(v.min()),
+            f"{label}/max": float(v.max()),
+            f"{label}/mean": float(v.mean()),
+            f"{label}/p50": float(_np.percentile(v, 50)),
+            f"{label}/p99": float(_np.percentile(v, 99)),
+        }
+        self.write_scalars(stats, step)
+
 
 class csvMonitor(Monitor):
     """CSV file per metric label (reference ``csv_monitor.py``)."""
@@ -72,6 +94,14 @@ class TensorBoardMonitor(Monitor):
             self.writer.add_scalar(label, float(value), step)
         self.writer.flush()
 
+    def write_histogram(self, label: str, values, step: int):
+        if not self.enabled or self.writer is None:
+            return
+        import numpy as _np
+
+        self.writer.add_histogram(label, _np.asarray(values), step)
+        self.writer.flush()
+
 
 class WandbMonitor(Monitor):
     def __init__(self, config):
@@ -94,6 +124,19 @@ class WandbMonitor(Monitor):
         for label, value, step in events:
             self._wandb.log({label: float(value)}, step=step)
 
+    def write_scalars(self, scalars, step: int):
+        if not self.enabled:
+            return
+        self._wandb.log({k: float(v) for k, v in scalars.items()}, step=step)
+
+    def write_histogram(self, label: str, values, step: int):
+        if not self.enabled:
+            return
+        import numpy as _np
+
+        self._wandb.log({label: self._wandb.Histogram(_np.asarray(values))},
+                        step=step)
+
 
 class MonitorMaster(Monitor):
     """Fan-out to all enabled sinks, lead-process only (reference ``monitor.py:29``)."""
@@ -101,18 +144,44 @@ class MonitorMaster(Monitor):
     def __init__(self, monitor_config):
         cfg = monitor_config or {}
         get = (lambda k: cfg.get(k)) if isinstance(cfg, dict) else (lambda k: getattr(cfg, k, None))
-        self.csv_monitor = csvMonitor(get("csv_monitor") or _Empty())
-        self.tb_monitor = TensorBoardMonitor(get("tensorboard") or _Empty())
-        self.wandb_monitor = WandbMonitor(get("wandb") or _Empty())
+        def sink(name):
+            c = get(name)
+            if c is None:
+                return _Empty()
+            if isinstance(c, dict):
+                # raw-dict configs (standalone MonitorMaster use) go through
+                # the SAME typed model the engine builds (runtime/config.py
+                # MonitorSinkConfig): typed defaults + unknown-key warnings
+                from ..runtime.config import MonitorSinkConfig
+
+                c = MonitorSinkConfig.from_dict(c)
+            en = getattr(c, "enabled", False)
+            if en not in (True, False, None):
+                raise ValueError(
+                    f"monitor.{name}.enabled must be a bool, got {en!r}")
+            return c
+
+        self.csv_monitor = csvMonitor(sink("csv_monitor"))
+        self.tb_monitor = TensorBoardMonitor(sink("tensorboard"))
+        self.wandb_monitor = WandbMonitor(sink("wandb"))
         self.enabled = any(m.enabled for m in
                            (self.csv_monitor, self.tb_monitor, self.wandb_monitor))
 
-    def write_events(self, events: List[Event]):
+    def _fan_out(self, method: str, *args):
         if jax.process_index() != 0 or not self.enabled:
             return
         for m in (self.csv_monitor, self.tb_monitor, self.wandb_monitor):
             if m.enabled:
-                m.write_events(events)
+                getattr(m, method)(*args)
+
+    def write_events(self, events: List[Event]):
+        self._fan_out("write_events", events)
+
+    def write_scalars(self, scalars, step: int):
+        self._fan_out("write_scalars", scalars, step)
+
+    def write_histogram(self, label: str, values, step: int):
+        self._fan_out("write_histogram", label, values, step)
 
 
 class _Empty:
