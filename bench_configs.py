@@ -402,6 +402,9 @@ def bench_pipe_zero1():
     for _ in range(steps):
         loss = engine.train_batch(g)
     loss = float(loss)
+    # block on params, not just the loss: the numerator must include the
+    # final step's pending optimizer update exactly like the denominator
+    jax.block_until_ready(engine.params)
     dt = time.perf_counter() - t0
     tokens = mb * 2 * seq * gas * steps
     pipe_tok_s = tokens / dt
@@ -459,7 +462,9 @@ def bench_pipe_zero1():
                               "throughput number",
                    "normalization": "vs_baseline = (pp4xdp2 tokens/s ÷ pp1 of "
                                     "the SAME stage-sharded scan program, "
-                                    "identical remat/embed/head/gas) ÷ ideal "
+                                    "identical per-layer remat + embed/head "
+                                    "placement; pp1 runs gas=1 at dp8 for "
+                                    "equal 16-row per-step FLOPs) ÷ ideal "
                                     f"1F1B bubble M/(M+P-1)={bubble:.3f}; on "
                                     "the serialized 1-vCPU host the tick-"
                                     "count ratio's ideal IS the bubble, so "
@@ -488,10 +493,6 @@ def run_one(name):
 
 def run_all():
     results = []
-    # CPU-backend configs run in subprocesses so the forced platform and the
-    # virtual device mesh exist before JAX initializes
-    from deepspeed_tpu.utils.xla_env import force_device_count_flags
-
     from deepspeed_tpu.utils.transfer import install_transfer_guard
 
     install_transfer_guard()  # SIGTERM drains in-flight transfers (r4 wedge)

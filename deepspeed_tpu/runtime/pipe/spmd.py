@@ -76,18 +76,18 @@ def spmd_pipeline(
     M = num_micro
     T = M + P_ - 1
 
-    if P_ == 1:
-        # degenerate pipeline: no manual pipe axis (a size-1 shard_map axis
-        # trips XLA's SPMD partitioner RET_CHECK on the CPU backend, and a
-        # self-ppermute buys nothing). Same structure — vectorized ingestion,
-        # per-microbatch stage_fn with identical remat, sequential head via
-        # lax.map — which is exactly the pp1 baseline the pipe bench row
-        # normalizes against.
+    if P_ == 1 and not pass_full_params:
+        # degenerate homogeneous pipeline: no manual pipe axis (a size-1
+        # shard_map axis trips XLA's SPMD partitioner RET_CHECK on the CPU
+        # backend, and a self-ppermute buys nothing). Same structure —
+        # vectorized ingestion, per-microbatch stage_fn with identical remat
+        # — which is exactly the pp1 baseline the pipe bench row normalizes
+        # against. Heterogeneous pipelines (pass_full_params) keep the
+        # shard_map path: their stage_fn reads lax.axis_index("pipe") and
+        # needs the axis bound even at size 1.
         stages_local = (jax.tree.map(lambda a: a[0], params["stages"])
                         if "stages" in params else None)
         seg_params = stages_local if stages_local is not None else params
-        if pass_full_params:
-            seg_params = (stages_local, params)
         states0 = jax.vmap(lambda f: first_fn(params, f))(feed)
 
         def micro_body(m):
