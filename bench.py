@@ -219,6 +219,18 @@ if __name__ == "__main__":
         _ok, _info = _backend_probe()
         if not _ok:
             _degraded(_info)
+            if "--all" in sys.argv:
+                # the CPU-mesh tracked configs don't need the device: refresh
+                # their BENCH_ALL.json rows (read-modify-write, stripped-env
+                # subprocesses) so a relay outage leaves only the
+                # TPU-dependent rows stale
+                try:
+                    import bench_configs
+
+                    for _row in bench_configs.refresh_cpu_rows():
+                        print(json.dumps(_row))
+                except Exception as _e:  # still exit 0 with the headline line
+                    sys.stderr.write(f"degraded --all sweep failed: {_e}\n")
             sys.exit(0)
     try:
         main()

@@ -491,6 +491,33 @@ def run_one(name):
     print(json.dumps(fn()))
 
 
+BENCH_ALL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_ALL.json")
+
+
+def refresh_cpu_rows():
+    """Run only the CPU-mesh configs and merge their rows into
+    BENCH_ALL.json (read-modify-write, other rows untouched).  The bench's
+    degraded mode uses this so a device outage leaves only the
+    TPU-dependent rows stale."""
+    rows = [_run_cpu_subprocess(name) for name in CPU_CONFIGS]
+    try:
+        with open(BENCH_ALL_PATH) as f:
+            existing = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        existing = []
+    by_metric = {r.get("metric"): i for i, r in enumerate(existing)}
+    for row in rows:
+        i = by_metric.get(row.get("metric"))
+        if i is None:
+            existing.append(row)
+        else:
+            existing[i] = row
+    with open(BENCH_ALL_PATH, "w") as f:
+        json.dump(existing, f, indent=1)
+    return rows
+
+
 def run_all():
     results = []
     from deepspeed_tpu.utils.transfer import install_transfer_guard

@@ -417,7 +417,7 @@ class PipelineModule:
         loss, _ = spmd_pipeline(
             first_fn, stage_fn, last_fn, params, (inputs, labels),
             mesh=self.topology.mesh, num_micro=self.num_micro, remat=False,
-            pass_full_params=bool(plan),
+            pass_full_params=bool(plan), hetero=True,
         )
         return loss
 

@@ -53,6 +53,7 @@ def spmd_pipeline(
     remat: bool = True,
     rng=None,
     pass_full_params: bool = False,
+    hetero: bool = False,
 ):
     """Run a pipelined forward over ``num_micro`` microbatches.
 
@@ -76,15 +77,15 @@ def spmd_pipeline(
     M = num_micro
     T = M + P_ - 1
 
-    if P_ == 1 and not pass_full_params:
+    if P_ == 1 and not hetero:
         # degenerate homogeneous pipeline: no manual pipe axis (a size-1
         # shard_map axis trips XLA's SPMD partitioner RET_CHECK on the CPU
         # backend, and a self-ppermute buys nothing). Same structure —
         # vectorized ingestion, per-microbatch stage_fn with identical remat
         # — which is exactly the pp1 baseline the pipe bench row normalizes
-        # against. Heterogeneous pipelines (pass_full_params) keep the
-        # shard_map path: their stage_fn reads lax.axis_index("pipe") and
-        # needs the axis bound even at size 1.
+        # against. Heterogeneous pipelines (``hetero=True``, with OR without
+        # a flat-pack plan) keep the shard_map path: their stage_fn reads
+        # lax.axis_index("pipe") and needs the axis bound even at size 1.
         stages_local = (jax.tree.map(lambda a: a[0], params["stages"])
                         if "stages" in params else None)
         seg_params = stages_local if stages_local is not None else params
