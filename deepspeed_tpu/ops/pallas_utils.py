@@ -1,0 +1,23 @@
+"""Shared Pallas-kernel runtime knobs."""
+
+import os
+
+import jax
+
+
+def pallas_interpret() -> bool:
+    """Should Pallas kernels run under the interpreter?
+
+    Default: interpret everywhere except a real TPU backend.
+    ``DSTPU_PALLAS_INTERPRET`` overrides (case-insensitive): ``0/false/no``
+    forces the real Mosaic kernel — used by the TPU-lowering export tests on
+    CPU hosts — and ``1/true/yes`` forces the interpreter on TPU (debugging).
+    Empty or unrecognized values mean "unset" (the backend heuristic), so
+    ``DSTPU_PALLAS_INTERPRET= python ...`` behaves like clearing the var.
+    """
+    ov = os.environ.get("DSTPU_PALLAS_INTERPRET", "").strip().lower()
+    if ov in ("0", "false", "no"):
+        return False
+    if ov in ("1", "true", "yes"):
+        return True
+    return jax.default_backend() != "tpu"

@@ -31,7 +31,9 @@ from .woq import unpack6
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..pallas_utils import pallas_interpret
+
+    return pallas_interpret()
 
 
 def _kernel(x_ref, codes_ref, scale_ref, o_ref, acc_ref, *, num_bits, group):

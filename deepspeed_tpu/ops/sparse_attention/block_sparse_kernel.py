@@ -24,7 +24,9 @@ NEG_INF = -1e30
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..pallas_utils import pallas_interpret
+
+    return pallas_interpret()
 
 
 def layout_to_lists(layout: np.ndarray, causal: bool):

@@ -31,7 +31,9 @@ NEG_INF = -1e30
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..pallas_utils import pallas_interpret
+
+    return pallas_interpret()
 
 
 # ----------------------------------------------------------------------------

@@ -33,7 +33,9 @@ from jax.experimental import pallas as pl
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..pallas_utils import pallas_interpret
+
+    return pallas_interpret()
 
 
 # ----------------------------------------------------------------------------

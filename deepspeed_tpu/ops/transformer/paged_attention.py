@@ -25,7 +25,9 @@ NEG_INF = -1e30
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from ..pallas_utils import pallas_interpret
+
+    return pallas_interpret()
 
 
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
