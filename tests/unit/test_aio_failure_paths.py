@@ -1,0 +1,90 @@
+"""AIO / NVMe-tier failure paths (reference ``csrc/aio`` error returns +
+swap_tensor assertions): I/O errors must surface as loud Python failures at
+the swap layer, never as silently corrupt parameters."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio.py_aio import AsyncIOHandle
+
+
+class TestAioErrorReturns:
+    def test_read_missing_file_nonzero(self, tmp_path):
+        h = AsyncIOHandle(num_threads=1)
+        buf = np.empty(128, np.uint8)
+        rid = h.pread(str(tmp_path / "does_not_exist.bin"), buf)
+        assert h.wait(rid) != 0
+        h.close()
+
+    def test_write_into_missing_directory_nonzero(self, tmp_path):
+        h = AsyncIOHandle(num_threads=1)
+        buf = np.zeros(128, np.uint8)
+        rid = h.pwrite(str(tmp_path / "no" / "such" / "dir" / "f.bin"), buf)
+        assert h.wait(rid) != 0
+        h.close()
+
+    def test_short_read_of_truncated_file_nonzero(self, tmp_path):
+        p = tmp_path / "short.bin"
+        p.write_bytes(b"x" * 64)  # 64 bytes on disk
+        h = AsyncIOHandle(num_threads=1)
+        buf = np.empty(4096, np.uint8)  # caller expects 4096
+        rid = h.pread(str(p), buf)
+        assert h.wait(rid) != 0, \
+            "short read must not report success (torn checkpoint/param file)"
+        h.close()
+
+
+class TestSwapLayerSurfacesErrors:
+    def _groups(self):
+        rng = np.random.default_rng(0)
+        return [{"w": rng.standard_normal((64, 64)).astype(np.float32)}]
+
+    def test_nvme_read_failure_raises(self, tmp_path):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import (
+            StreamedParamStore,
+        )
+
+        store = StreamedParamStore(self._groups(), device="nvme",
+                                   nvme_path=str(tmp_path),
+                                   compute_dtype=jnp.float32)
+        # sabotage: truncate the group file after the initial writeback
+        path = tmp_path / "param_group_0.bin"
+        assert path.exists()
+        path.write_bytes(b"")  # torn file
+        with pytest.raises(AssertionError, match="read failed"):
+            store.get(0)
+
+    def test_nvme_writeback_failure_raises_on_drain(self, tmp_path):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import (
+            StreamedParamStore,
+        )
+
+        store = StreamedParamStore(self._groups(), device="nvme",
+                                   nvme_path=str(tmp_path),
+                                   compute_dtype=jnp.float32)
+        # point the group's file into a directory that no longer exists, then
+        # queue an async writeback — the failure must surface at the drain
+        # (the next read of the group), not vanish
+        store._paths[0] = str(tmp_path / "gone" / "param_group_0.bin")
+        store.writeback(0, wait=False)
+        with pytest.raises(AssertionError, match="writeback failed"):
+            store.prefetch(0)  # drains the pending write first
+
+    def test_cpu_mode_needs_no_files(self):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.runtime.swap_tensor.param_swapper import (
+            StreamedParamStore,
+        )
+
+        store = StreamedParamStore(self._groups(), device="cpu",
+                                   compute_dtype=jnp.float32)
+        out = store.get(0)
+        np.testing.assert_allclose(np.asarray(out["w"]),
+                                   self._groups()[0]["w"], rtol=1e-6)

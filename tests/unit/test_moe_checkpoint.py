@@ -1,0 +1,113 @@
+"""MoE checkpointing (reference ``engine.py:3155 _save_moe_checkpoint`` +
+``tests/unit/checkpoint/test_moe_checkpoint.py``): expert states round-trip
+exactly, reload across a changed expert-parallel degree, and the universal
+path restacks routed-FFN models like dense ones."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+
+def _moe_cfg(residual=True):
+    return gpt2_config("125m", hidden_size=32, num_layers=2, num_heads=2,
+                       vocab_size=128, max_seq_len=32, num_experts=4,
+                       moe_top_k=2, moe_use_residual=residual)
+
+
+def _engine(mesh, tmpdir=None, stage=1):
+    topo_mod.reset_topology()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=TransformerLM(_moe_cfg()), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": stage},
+            "bf16": {"enabled": True},
+            "steps_per_print": 0,
+            "mesh": mesh,
+        })
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": jnp.asarray(rng.integers(0, 128, (8, 32),
+                                                  dtype=np.int32))}
+
+
+def _train(engine, n, seed0=0):
+    for i in range(n):
+        loss = engine(_batch(seed0 + i))
+        engine.backward(loss)
+        engine.step()
+    return float(loss)
+
+
+class TestMoECheckpoint:
+    def test_expert_and_residual_state_roundtrip_exact(self, tmp_path):
+        mesh = {"data": 2, "expert": 4}
+        engine = _engine(mesh)
+        _train(engine, 3)
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        want = {k: np.asarray(jax.device_get(v), np.float32)
+                for k, v in engine.params["blocks"].items()}
+
+        engine2 = _engine(mesh)
+        engine2.load_checkpoint(str(tmp_path))
+        for k, v in engine2.params["blocks"].items():
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(v), np.float32), want[k], err_msg=k)
+        # the routed-FFN leaves specifically (experts + router + residual)
+        for k in ("wi", "w_down", "moe_wg", "res_wi", "res_coef_w"):
+            assert k in engine2.params["blocks"], k
+
+    def test_reload_across_changed_ep_degree(self, tmp_path):
+        """ep4 save → ep2×dp4 load: the named-sharding checkpoint design is
+        topology-independent, so expert states land exactly on a different
+        expert-parallel degree (the reference needs its MoE-aware ckpt
+        machinery for this; here it falls out of global arrays)."""
+        engine = _engine({"data": 2, "expert": 4})
+        _train(engine, 3)
+        ref_loss = float(engine(_batch(99)))
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        want = np.asarray(jax.device_get(engine.params["blocks"]["wi"]),
+                          np.float32)
+
+        engine2 = _engine({"data": 4, "expert": 2})
+        engine2.load_checkpoint(str(tmp_path))
+        got = np.asarray(jax.device_get(engine2.params["blocks"]["wi"]),
+                         np.float32)
+        np.testing.assert_array_equal(got, want)
+        loss2 = float(engine2(_batch(99)))
+        assert abs(loss2 - ref_loss) < 2e-2, (loss2, ref_loss)
+        # and training continues on the new topology
+        assert np.isfinite(_train(engine2, 1, seed0=50))
+
+    def test_universal_conversion_covers_experts(self, tmp_path):
+        from deepspeed_tpu.checkpoint import ds_to_universal
+
+        engine = _engine({"data": 2, "expert": 4}, stage=2)
+        _train(engine, 2)
+        ck, uni = tmp_path / "ck", tmp_path / "uni"
+        engine.save_checkpoint(str(ck), tag="t")
+        ds_to_universal(str(ck), str(uni), tag="t")
+        ref = np.asarray(jax.tree.leaves(engine.get_fp32_params())[0])
+
+        topo_mod.reset_topology()
+        engine2, *_ = deepspeed_tpu.initialize(
+            model=TransformerLM(_moe_cfg()), config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "bf16": {"enabled": True},
+                "checkpoint": {"load_universal": True},
+                "steps_per_print": 0,
+                "mesh": {"data": 8},  # expert axis retired entirely
+            })
+        engine2.load_checkpoint(str(uni))
+        after = np.asarray(jax.tree.leaves(engine2.get_fp32_params())[0])
+        np.testing.assert_allclose(ref, after, atol=1e-6)
+        assert engine2.global_steps == engine.global_steps
