@@ -1,0 +1,103 @@
+"""Transfer-discipline utility tests (``utils/transfer.py``): bounded-flight
+chunking must be value-exact across the split paths, honor sharding pytrees,
+and pass device arrays through as device-side reshards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.utils.transfer import (
+    chunked_device_get,
+    chunked_device_put,
+)
+
+
+class TestChunkedPut:
+    def test_small_tree_exact(self):
+        tree = {"a": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "b": np.float32(7.0)}
+        out = chunked_device_put(tree)
+        assert isinstance(out["a"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+        np.testing.assert_array_equal(np.asarray(out["b"]), tree["b"])
+
+    def test_large_leaf_splits_and_reassembles_exact(self):
+        rng = np.random.default_rng(0)
+        big = rng.standard_normal((4096, 128)).astype(np.float32)  # 2 MiB
+        out = chunked_device_put({"w": big}, limit_bytes=256 * 1024)
+        np.testing.assert_array_equal(np.asarray(out["w"]), big)
+
+    def test_inflight_cap_batches_small_leaves(self):
+        rng = np.random.default_rng(1)
+        tree = {f"l{i}": rng.standard_normal((64, 64)).astype(np.float32)
+                for i in range(10)}  # 16 KiB each, 8 KiB cap → per-leaf drain
+        out = chunked_device_put(tree, limit_bytes=8 * 1024)
+        for k, v in tree.items():
+            np.testing.assert_array_equal(np.asarray(out[k]), v)
+
+    def test_device_array_passthrough_reshard(self):
+        x = jnp.arange(16.0)
+        out = chunked_device_put({"x": x})
+        assert isinstance(out["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(out["x"]), np.arange(16.0))
+
+    def test_sharding_pytree_respected(self):
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=8, model=1, seq=1, pipe=1,
+                                            expert=1)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = {"a": NamedSharding(topo.mesh, P("data")),
+              "b": NamedSharding(topo.mesh, P())}
+        tree = {"a": np.arange(16, dtype=np.float32),
+                "b": np.ones((4,), np.float32)}
+        out = chunked_device_put(tree, sh)
+        assert out["a"].sharding.spec == P("data")
+        np.testing.assert_array_equal(np.asarray(out["a"]), tree["a"])
+        topo_mod.reset_topology()
+
+    def test_multi_device_sharded_leaf_not_assembled_on_one_device(self):
+        """A >limit leaf bound for a partitioned multi-device sharding must
+        go through device_put(arr, sh) (per-shard slices), never the
+        single-device chunk-assembly path."""
+        topo_mod.reset_topology()
+        topo = topo_mod.initialize_topology(data=8, model=1, seq=1, pipe=1,
+                                            expert=1)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        big = np.random.default_rng(2).standard_normal(
+            (4096, 64)).astype(np.float32)  # 1 MiB > 64 KiB limit
+        out = chunked_device_put(
+            big, NamedSharding(topo.mesh, P("data")),
+            limit_bytes=64 * 1024)
+        assert len(out.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(out), big)
+        topo_mod.reset_topology()
+
+    def test_sharding_leaf_count_mismatch_raises(self):
+        from jax.sharding import SingleDeviceSharding
+
+        sh = SingleDeviceSharding(jax.devices()[0])
+        with pytest.raises(ValueError, match="leaves"):
+            chunked_device_put({"a": np.ones(2), "b": np.ones(2)},
+                               {"a": sh})
+
+
+class TestChunkedGet:
+    def test_roundtrip_exact(self):
+        rng = np.random.default_rng(3)
+        tree = {"w": rng.standard_normal((512, 256)).astype(np.float32),
+                "s": np.float32(3.5)}
+        dev = jax.device_put(tree)
+        back = chunked_device_get(dev)
+        np.testing.assert_array_equal(back["w"], tree["w"])
+        assert isinstance(back["w"], np.ndarray)
+
+    def test_large_leaf_split_fetch_exact(self):
+        rng = np.random.default_rng(4)
+        big = rng.standard_normal((8192, 64)).astype(np.float32)  # 2 MiB
+        dev = jax.device_put(big)
+        back = chunked_device_get(dev, limit_bytes=128 * 1024)
+        np.testing.assert_array_equal(back, big)
