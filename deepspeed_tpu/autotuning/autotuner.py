@@ -39,25 +39,47 @@ class TuneResult:
     wall_s: float = 0.0  # this trial's wall time (compile + profiled steps)
 
 
+def estimate_static_state_per_chip(n_params: int, zero_stage: int,
+                                   zero_degree: int, mp: int,
+                                   dtype_bytes: int = 2,
+                                   offload_opt_fraction: float = 0.0,
+                                   weight_shard_degree: int = 0) -> float:
+    """Per-chip bytes of the STATIC training state (weights + grads + fp32
+    master + Adam moments) under the ZeRO sharding rules — THE one memory
+    model, shared by the autotuner's pruning and the engine's init-time
+    preflight so the two can never drift.
+
+    ``zero_degree``: the full ZeRO sharding degree (data × hpz × expert,
+    ``topology.ZERO_AXES``) that grads (stage ≥2) and optimizer state
+    (stage ≥1) shard over.  ``weight_shard_degree``: what stage-3 WEIGHTS
+    shard over — the hpz size when hpz > 1 (ZeRO++ hpZ secondary partition,
+    ``zero/partition.py stage_param_specs``), else the full degree (0 means
+    "same as zero_degree").  ``offload_opt_fraction``: fraction of optimizer
+    state OFFLOADED to host/NVMe (``split_by_ratio`` semantics)."""
+    p = n_params / max(1, mp)
+    weights = p * dtype_bytes
+    grads = p * 4
+    opt = p * 12 * max(0.0, 1.0 - offload_opt_fraction)
+    if zero_stage >= 1:
+        opt /= zero_degree
+    if zero_stage >= 2:
+        grads /= zero_degree
+    if zero_stage >= 3:
+        weights /= (weight_shard_degree or zero_degree)
+    return weights + grads + opt
+
+
 def estimate_memory_per_chip(n_params: int, zero_stage: int, dp: int, mp: int,
                              micro_bs: int, seq: int, hidden: int, layers: int,
                              dtype_bytes: int = 2, remat: bool = True) -> float:
     """Analytic memory model (reference ``autotuner.py:278`` area): params +
     grads + optimizer states partitioned per ZeRO stage, + activations."""
-    p = n_params / mp
-    weights = p * dtype_bytes
-    grads = p * 4
-    opt = p * 12  # fp32 master + 2 moments
-    if zero_stage >= 1:
-        opt /= dp
-    if zero_stage >= 2:
-        grads /= dp
-    if zero_stage >= 3:
-        weights /= dp
+    static = estimate_static_state_per_chip(
+        n_params, zero_stage, zero_degree=dp, mp=mp, dtype_bytes=dtype_bytes)
     act_per_layer = micro_bs * seq * hidden * dtype_bytes / mp
     # remat saves only the per-layer residual stream; otherwise ~8 tensors/layer
     acts = act_per_layer * (2 * layers if remat else 8 * layers)
-    return weights + grads + opt + acts
+    return static + acts
 
 
 class Autotuner:

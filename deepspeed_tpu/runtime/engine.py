@@ -524,12 +524,61 @@ class DeepSpeedEngine:
         # ---- compiled fns ----
         self._build_compiled_fns()
 
+        self._memory_preflight()
+
         log_dist(
             f"DeepSpeedEngine: zero_stage={stage} dtype={self.compute_dtype.__name__} "
             f"mesh={topo.axis_sizes} batch=({config.train_batch_size},"
             f"{config.train_micro_batch_size_per_gpu},{config.gradient_accumulation_steps})",
             ranks=[0],
         )
+
+    def _memory_preflight(self) -> None:
+        """OOM guard (reference analogue: the autotuner's memory model,
+        ``autotuner.py:278`` — here applied at engine init): estimate the
+        per-chip STATIC state (weights + grads + optimizer) from the actual
+        param tree and the ZeRO/mesh sharding, and warn loudly when it
+        exceeds the device's capacity — a hint hours cheaper than the OOM.
+        Activations are excluded (batch/remat-dependent), so this
+        under-estimates; crossing it is near-certain failure."""
+        try:
+            from ..autotuning.autotuner import estimate_static_state_per_chip
+            from ..comm.topology import ZERO_AXES
+
+            topo = self.topology
+            n_params = sum(int(np.prod(a.shape))
+                           for a in jax.tree.leaves(self.params))
+            stage = self.config.zero_config.stage
+            # grads/opt shard over the full ZeRO degree; stage-3 WEIGHTS over
+            # hpz only when hpz>1 (zero/partition.py stage_param_specs)
+            zero_degree = max(1, int(np.prod([topo.get_dim(a)
+                                              for a in ZERO_AXES])))
+            hpz = topo.get_dim("hpz")
+            weight_shards = hpz if hpz > 1 else zero_degree
+            mp = max(1, topo.get_dim("model"))
+            offload = self.config.zero_config.offload_optimizer
+            off_frac = 0.0
+            if offload is not None and offload.device in ("cpu", "nvme"):
+                # ratio = fraction OFFLOADED (split_by_ratio semantics)
+                off_frac = max(0.0, min(1.0, getattr(offload, "ratio", 1.0)))
+            est = estimate_static_state_per_chip(
+                n_params, stage, zero_degree=zero_degree, mp=mp,
+                dtype_bytes=2 if self._mixed else 4,
+                offload_opt_fraction=off_frac,
+                weight_shard_degree=weight_shards)
+            from ..accelerator import get_accelerator
+
+            cap = float(get_accelerator().total_memory(0))
+            if cap > 0 and est > 0.92 * cap:
+                logger.warning(
+                    f"memory preflight: static state needs ~{est / 2**30:.1f} "
+                    f"GiB/chip (params {n_params / 1e6:.0f}M, stage {stage}, "
+                    f"zero_degree {zero_degree}, mp {mp}) vs "
+                    f"~{cap / 2**30:.1f} GiB capacity — activations come on "
+                    "top; expect OOM. Raise the ZeRO stage, shard further, "
+                    "or enable offload.")
+        except Exception:  # the guard must never break init
+            pass
 
     # ------------------------------------------------------------------
     @staticmethod

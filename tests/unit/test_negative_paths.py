@@ -232,3 +232,43 @@ class TestLauncherRendezvousTimeout:
         assert "DEADLINE_EXCEEDED" in blob or "RENDEZVOUS_FAILED" in blob, \
             blob[-800:]
         assert elapsed < 90, f"took {elapsed:.0f}s — timeout not honored"
+
+
+class TestMemoryPreflight:
+    def test_warns_when_static_state_exceeds_capacity(self, monkeypatch):
+        """The init-time OOM guard: an over-capacity config warns with the
+        estimate instead of leaving the user to a cryptic allocator abort."""
+        from deepspeed_tpu.accelerator import get_accelerator
+        from deepspeed_tpu.runtime import engine as engine_mod
+
+        acc = get_accelerator()
+        monkeypatch.setattr(type(acc), "total_memory",
+                            lambda self, device_index=0: 10_000)  # tiny cap
+        seen = []
+        monkeypatch.setattr(engine_mod.logger, "warning",
+                            lambda msg, *a, **k: seen.append(str(msg)))
+        topo_mod.reset_topology()
+        deepspeed_tpu.initialize(model=make_simple_model(64), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 0,
+            "mesh": {"data": 8},
+        })
+        assert any("memory preflight" in m for m in seen), seen
+
+    def test_silent_when_capacity_sufficient(self, monkeypatch):
+        from deepspeed_tpu.runtime import engine as engine_mod
+
+        seen = []
+        monkeypatch.setattr(engine_mod.logger, "warning",
+                            lambda msg, *a, **k: seen.append(str(msg)))
+        topo_mod.reset_topology()
+        deepspeed_tpu.initialize(model=make_simple_model(16), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 0,
+            "mesh": {"data": 8},
+        })
+        assert not any("memory preflight" in m for m in seen), seen
