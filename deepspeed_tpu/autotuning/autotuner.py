@@ -43,7 +43,8 @@ def estimate_static_state_per_chip(n_params: int, zero_stage: int,
                                    zero_degree: int, mp: int,
                                    dtype_bytes: int = 2,
                                    offload_opt_fraction: float = 0.0,
-                                   weight_shard_degree: int = 0) -> float:
+                                   weight_shard_degree: int = 0,
+                                   has_master: bool = True) -> float:
     """Per-chip bytes of the STATIC training state (weights + grads + fp32
     master + Adam moments) under the ZeRO sharding rules — THE one memory
     model, shared by the autotuner's pruning and the engine's init-time
@@ -55,11 +56,14 @@ def estimate_static_state_per_chip(n_params: int, zero_stage: int,
     shard over — the hpz size when hpz > 1 (ZeRO++ hpZ secondary partition,
     ``zero/partition.py stage_param_specs``), else the full degree (0 means
     "same as zero_degree").  ``offload_opt_fraction``: fraction of optimizer
-    state OFFLOADED to host/NVMe (``split_by_ratio`` semantics)."""
+    state OFFLOADED to host/NVMe (``split_by_ratio`` semantics).
+    ``has_master``: mixed-precision runs keep an fp32 master copy in the
+    optimizer state (12 bytes/param incl. moments); pure-fp32 runs don't
+    (8 bytes/param — the weights ARE the master)."""
     p = n_params / max(1, mp)
     weights = p * dtype_bytes
     grads = p * 4
-    opt = p * 12 * max(0.0, 1.0 - offload_opt_fraction)
+    opt = p * (12 if has_master else 8) * max(0.0, 1.0 - offload_opt_fraction)
     if zero_stage >= 1:
         opt /= zero_degree
     if zero_stage >= 2:

@@ -1,0 +1,110 @@
+"""``compile`` config block — reference ``runtime/compiler.py`` parity.
+
+The reference wraps the module in ``torch.compile`` when
+``{"compile": {"enabled": true, "backend": ..., "kwargs": {...}}}`` is set
+(``compiler.py CompileConfig`` + ``engine.py:365 CompiledModuleWrapper``).
+Under XLA the engine's training step is ALWAYS whole-program compiled — the
+fused fwd+bwd+optimizer jit is what ``torch.compile`` aspires to — so this
+block validates and surfaces state rather than changing execution:
+
+- ``enabled`` / ``backend`` / ``kwargs`` parse with the reference schema;
+  ``backend`` accepts "inductor" (mapped, with a log line, to the XLA
+  default), "xla", or a dotted path / callable (accepted for API parity).
+- ``engine.compile()`` and ``engine.is_compiled`` mirror the reference's
+  surface; calling ``compile`` is idempotent and logs that the program is
+  already XLA-compiled.
+- ``deepspeed.compiler.disable`` becomes a no-op decorator (XLA has no
+  per-function opt-out of the already-traced program).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Union
+
+from ..utils.logging import log_dist, logger
+from .config_utils import DeepSpeedConfigModel
+
+COMPILE_CONFIG = "compile"
+
+#: backends this runtime understands; anything else must be importable
+KNOWN_BACKENDS = ("xla", "inductor", "eager")
+
+
+def is_compile_supported() -> bool:
+    """Always true here: XLA compiles every engine step by construction."""
+    return True
+
+
+def disable(func: Callable) -> Callable:
+    """Reference ``compiler.disable`` parity: a no-op passthrough (XLA has no
+    per-function compilation opt-out inside an already-traced program)."""
+    return func
+
+
+@dataclass
+class CompileConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    backend: str = "xla"
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def _validate(self):
+        if not isinstance(self.backend, str):
+            return  # callables accepted (reference get_backend_fn parity)
+        if self.backend in KNOWN_BACKENDS:
+            return
+        if "." in self.backend:
+            import importlib
+
+            module_name = ".".join(self.backend.split(".")[:-1])
+            try:
+                importlib.import_module(module_name)
+            except ImportError:
+                raise ValueError(
+                    f"compile.backend {self.backend!r} is not a known backend "
+                    f"({KNOWN_BACKENDS}) and could not be imported")
+            return
+        raise ValueError(
+            f"compile.backend {self.backend!r} is not a known backend "
+            f"({KNOWN_BACKENDS}) or a dotted import path")
+
+
+def get_compile_config(param_dict: Dict[str, Any]) -> CompileConfig:
+    return CompileConfig.from_dict(param_dict.get(COMPILE_CONFIG, {}) or {})
+
+
+def resolve_backend(backend: Union[str, Callable]) -> str:
+    """Map a requested backend onto what this runtime actually does."""
+    if callable(backend):
+        logger.warning(
+            "compile.backend callables are accepted for API parity but the "
+            "XLA whole-program jit is used; the callable is ignored")
+        return "xla"
+    if backend == "inductor":
+        log_dist(
+            "compile.backend 'inductor' maps to the XLA whole-program jit "
+            "(the engine step is already one compiled program)", ranks=[0])
+        return "xla"
+    return backend
+
+
+class CompiledSurface:
+    """Mixin-style helper the engine delegates to for the reference's
+    ``compile()`` / ``is_compiled`` surface."""
+
+    def __init__(self, compile_config: CompileConfig):
+        self.config = compile_config
+        self._compiled = bool(compile_config.enabled)
+        if compile_config.enabled:
+            resolve_backend(compile_config.backend)
+
+    def compile(self, backend: Union[str, Callable] = "xla",
+                compile_kwargs: Dict[str, Any] = None) -> None:
+        """Idempotent (reference ``CompiledModuleWrapper.compile``): the XLA
+        engine step is already whole-program compiled; record the request."""
+        resolve_backend(backend)
+        if self._compiled:
+            logger.info("compile(): engine step is already XLA-compiled")
+        self._compiled = True
+
+    @property
+    def is_compiled(self) -> bool:
+        return self._compiled

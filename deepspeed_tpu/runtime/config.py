@@ -249,6 +249,9 @@ class DeepSpeedConfig:
         self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
         self.comms_config = CommsLoggerConfig.from_dict(pd.get(C.COMMS_LOGGER, {}))
         self.flops_profiler_config = FlopsProfilerConfig.from_dict(pd.get(C.FLOPS_PROFILER, {}))
+        from .compiler import get_compile_config
+
+        self.compile_config = get_compile_config(pd)
         self.monitor_config = {
             "csv_monitor": MonitorSinkConfig.from_dict(pd.get(C.MONITOR_CSV, {})),
             "tensorboard": MonitorSinkConfig.from_dict(pd.get(C.MONITOR_TENSORBOARD, {})),

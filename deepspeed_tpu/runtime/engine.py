@@ -524,6 +524,12 @@ class DeepSpeedEngine:
         # ---- compiled fns ----
         self._build_compiled_fns()
 
+        # reference compile() / is_compiled surface (runtime/compiler.py):
+        # the step IS whole-program compiled; this records/validates the block
+        from .compiler import CompiledSurface
+
+        self._compile_surface = CompiledSurface(config.compile_config)
+
         self._memory_preflight()
 
         log_dist(
@@ -561,11 +567,21 @@ class DeepSpeedEngine:
             if offload is not None and offload.device in ("cpu", "nvme"):
                 # ratio = fraction OFFLOADED (split_by_ratio semantics)
                 off_frac = max(0.0, min(1.0, getattr(offload, "ratio", 1.0)))
+            off_param = self.config.zero_config.offload_param
             est = estimate_static_state_per_chip(
                 n_params, stage, zero_degree=zero_degree, mp=mp,
                 dtype_bytes=2 if self._mixed else 4,
                 offload_opt_fraction=off_frac,
-                weight_shard_degree=weight_shards)
+                weight_shard_degree=weight_shards,
+                # pure-fp32 runs keep no separate master copy
+                has_master=self._mixed)
+            if off_param is not None and getattr(off_param, "device", None) \
+                    in ("cpu", "nvme"):
+                # param-offloaded configs stream weights from the host tier;
+                # HBM holds O(2 layers), not the model (swap_tensor/streamed)
+                est -= (n_params / max(1, mp)) \
+                    * (2 if self._mixed else 4) / (weight_shards
+                                                   if stage >= 3 else 1)
             from ..accelerator import get_accelerator
 
             cap = float(get_accelerator().total_memory(0))
@@ -579,6 +595,16 @@ class DeepSpeedEngine:
                     "or enable offload.")
         except Exception:  # the guard must never break init
             pass
+
+    # ------------------------------------------------------------------
+    def compile(self, backend="xla", compile_kwargs=None) -> None:
+        """Reference ``engine.compile`` parity (runtime/compiler.py): the XLA
+        training step is already one compiled program; validates/logs."""
+        self._compile_surface.compile(backend, compile_kwargs)
+
+    @property
+    def is_compiled(self) -> bool:
+        return self._compile_surface.is_compiled
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -1,0 +1,62 @@
+"""Compile-config surface tests (reference ``runtime/compiler.py``:
+CompileConfig schema, engine.compile()/is_compiled, disable passthrough)."""
+
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.runtime.compiler import (
+    CompileConfig,
+    disable,
+    get_compile_config,
+    is_compile_supported,
+)
+from tests.unit.simple_model import make_simple_model
+
+
+class TestCompileConfig:
+    def test_schema_and_defaults(self):
+        c = get_compile_config({})
+        assert (c.enabled, c.backend, c.kwargs) == (False, "xla", {})
+        c2 = get_compile_config({"compile": {"enabled": True,
+                                             "backend": "inductor",
+                                             "kwargs": {"mode": "max-autotune"}}})
+        assert c2.enabled and c2.backend == "inductor"
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="not a known backend"):
+            CompileConfig.from_dict({"backend": "tvm"})
+
+    def test_dotted_backend_importable(self):
+        CompileConfig.from_dict({"backend": "json.dumps"})  # importable: ok
+        with pytest.raises(ValueError, match="could not be imported"):
+            CompileConfig.from_dict({"backend": "no_such_module.fn"})
+
+    def test_disable_is_passthrough(self):
+        f = lambda x: x + 1  # noqa: E731
+        assert disable(f) is f and is_compile_supported()
+
+
+class TestEngineSurface:
+    def _engine(self, compile_block=None):
+        topo_mod.reset_topology()
+        cfg = {"train_batch_size": 8,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 1},
+               "steps_per_print": 0,
+               "mesh": {"data": 8}}
+        if compile_block is not None:
+            cfg["compile"] = compile_block
+        engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(16),
+                                              config=cfg)
+        return engine
+
+    def test_disabled_by_default_then_compile_call(self):
+        engine = self._engine()
+        assert engine.is_compiled is False
+        engine.compile()  # idempotent, validates backend
+        assert engine.is_compiled is True
+
+    def test_enabled_block_marks_compiled(self):
+        engine = self._engine({"enabled": True, "backend": "inductor"})
+        assert engine.is_compiled is True
