@@ -47,24 +47,40 @@ class CompileConfig(DeepSpeedConfigModel):
     kwargs: Dict[str, Any] = field(default_factory=dict)
 
     def _validate(self):
-        if not isinstance(self.backend, str):
-            return  # callables accepted (reference get_backend_fn parity)
-        if self.backend in KNOWN_BACKENDS:
-            return
-        if "." in self.backend:
-            import importlib
+        validate_backend(self.backend)
 
-            module_name = ".".join(self.backend.split(".")[:-1])
-            try:
-                importlib.import_module(module_name)
-            except ImportError:
-                raise ValueError(
-                    f"compile.backend {self.backend!r} is not a known backend "
-                    f"({KNOWN_BACKENDS}) and could not be imported")
-            return
+
+def validate_backend(backend: Union[str, Callable]) -> None:
+    """Shared validation (reference ``get_backend_fn`` contract): known name,
+    or a dotted path that imports AND resolves to an attribute, or a
+    callable. One implementation for the config block and engine.compile()."""
+    if callable(backend):
+        return
+    if not isinstance(backend, str):
         raise ValueError(
-            f"compile.backend {self.backend!r} is not a known backend "
-            f"({KNOWN_BACKENDS}) or a dotted import path")
+            f"compile.backend must be a string or callable, got "
+            f"{type(backend).__name__}")
+    if backend in KNOWN_BACKENDS:
+        return
+    if "." in backend:
+        import importlib
+
+        module_name = ".".join(backend.split(".")[:-1])
+        fn_name = backend.split(".")[-1]
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError:
+            raise ValueError(
+                f"compile.backend {backend!r} is not a known backend "
+                f"({KNOWN_BACKENDS}) and could not be imported")
+        if not hasattr(module, fn_name):
+            raise ValueError(
+                f"compile.backend {backend!r}: module {module_name!r} has "
+                f"no attribute {fn_name!r}")
+        return
+    raise ValueError(
+        f"compile.backend {backend!r} is not a known backend "
+        f"({KNOWN_BACKENDS}) or a dotted import path")
 
 
 def get_compile_config(param_dict: Dict[str, Any]) -> CompileConfig:
@@ -72,7 +88,8 @@ def get_compile_config(param_dict: Dict[str, Any]) -> CompileConfig:
 
 
 def resolve_backend(backend: Union[str, Callable]) -> str:
-    """Map a requested backend onto what this runtime actually does."""
+    """Validate, then map a requested backend onto what this runtime does."""
+    validate_backend(backend)
     if callable(backend):
         logger.warning(
             "compile.backend callables are accepted for API parity but the "

@@ -266,6 +266,18 @@ class DeepSpeedConfig:
         ckpt_dict = dict(pd.get(C.CHECKPOINT, {}))
         if C.LOAD_UNIVERSAL_CHECKPOINT in pd:
             ckpt_dict["load_universal"] = pd[C.LOAD_UNIVERSAL_CHECKPOINT]
+        # reference `nebula` block (nebula/config.py: async Azure checkpoint
+        # service): its role here is the async checkpoint engine — map
+        # nebula.enabled onto checkpoint.async_save so reference configs work
+        nebula = pd.get("nebula", {}) or {}
+        if nebula.get("enabled") and "async_save" not in ckpt_dict:
+            from ..utils.logging import logger as _logger
+
+            _logger.info(
+                "config: nebula.enabled maps to checkpoint.async_save (the "
+                "AsyncCheckpointEngine fills the nebula role; Azure-service "
+                "keys are accepted and ignored)")
+            ckpt_dict["async_save"] = True
         self.checkpoint_config = CheckpointConfig.from_dict(ckpt_dict)
         self.load_universal_checkpoint = self.checkpoint_config.load_universal
         self.use_node_local_storage = self.checkpoint_config.use_node_local_storage

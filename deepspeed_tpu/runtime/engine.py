@@ -398,6 +398,26 @@ class DeepSpeedEngine:
                 increment=int(sc.get("seq_per_step", 16)),
             )
 
+        # ---- legacy curriculum learning (reference engine.py:1824-1837 +
+        # top-level `curriculum_learning` block): seqlen-difficulty truncation
+        # of each training batch. The difficulty is a host int quantized by
+        # difficulty_step, so each schedule phase is one static shape → one
+        # jit variant (the LTD pattern), not a per-step retrace ----
+        self._curriculum = None
+        from .constants import CURRICULUM_LEARNING_LEGACY
+
+        cl = config._param_dict.get(CURRICULUM_LEARNING_LEGACY, {}) or {}
+        if cl.get("enabled"):
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            ctype = cl.get("curriculum_type", "seqlen")
+            if ctype != "seqlen":
+                raise ValueError(
+                    f"legacy curriculum_learning supports curriculum_type "
+                    f"'seqlen' (got {ctype!r}); metric-based curricula use "
+                    "data_efficiency.data_sampling (DeepSpeedDataSampler)")
+            self._curriculum = CurriculumScheduler(cl)
+
         # ---- sharding rules per ZeRO stage ----
         stage = config.zero_config.stage
         self.zero_stage = stage
@@ -595,6 +615,17 @@ class DeepSpeedEngine:
                     "or enable offload.")
         except Exception:  # the guard must never break init
             pass
+
+    # ------------------------------------------------------------------
+    def curriculum_enabled_legacy(self) -> bool:
+        """Reference ``engine.curriculum_enabled_legacy`` parity."""
+        return self._curriculum is not None
+
+    def curriculum_seqlen(self) -> int:
+        """The current legacy-curriculum difficulty (training seqlen)."""
+        if self._curriculum is None:
+            raise RuntimeError("legacy curriculum_learning is not enabled")
+        return int(self._curriculum.get_difficulty(self.global_steps))
 
     # ------------------------------------------------------------------
     def compile(self, backend="xla", compile_kwargs=None) -> None:
@@ -1178,7 +1209,33 @@ class DeepSpeedEngine:
 
     def _inject_train_kwargs(self, batch):
         """Curriculum/PLD injection (reference engine.py:1824-1837): adds the
-        per-step progressive-layer-drop theta to dict batches."""
+        per-step progressive-layer-drop theta to dict batches and applies the
+        legacy curriculum's seqlen truncation."""
+        if self._curriculum is not None and getattr(self, "_training", True):
+            seqlen = int(self._curriculum.get_difficulty(self.global_steps))
+            # host-side static slice: one jit variant per quantized
+            # difficulty value (difficulty_step bounds the variant count)
+            if isinstance(batch, dict):
+                ids = batch.get("input_ids")
+                if ids is not None and ids.shape[-1] > seqlen:
+                    batch = dict(batch)
+                    for k in ("input_ids", "labels", "positions",
+                              "attention_mask", "token_type_ids"):
+                        if k in batch and hasattr(batch[k], "shape") \
+                                and batch[k].shape[-1] == ids.shape[-1]:
+                            batch[k] = batch[k][..., :seqlen]
+            elif isinstance(batch, (tuple, list)):
+                full = max((a.shape[-1] for a in batch
+                            if hasattr(a, "shape") and a.ndim >= 1),
+                           default=0)
+                if full > seqlen:
+                    batch = type(batch)(
+                        a[..., :seqlen] if hasattr(a, "shape")
+                        and a.ndim >= 1 and a.shape[-1] == full else a
+                        for a in batch)
+            elif hasattr(batch, "shape") and batch.ndim >= 1 \
+                    and batch.shape[-1] > seqlen:
+                batch = batch[..., :seqlen]
         pld = self.config.progressive_layer_drop
         if (pld and pld.get("enabled") and isinstance(batch, dict)
                 and getattr(self, "_training", True)):

@@ -60,3 +60,23 @@ class TestEngineSurface:
     def test_enabled_block_marks_compiled(self):
         engine = self._engine({"enabled": True, "backend": "inductor"})
         assert engine.is_compiled is True
+
+
+class TestBackendValidationShared:
+    def test_engine_compile_rejects_unknown_backend(self):
+        topo_mod.reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(16),
+                                              config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "steps_per_print": 0, "mesh": {"data": 8}})
+        with pytest.raises(ValueError, match="not a known backend"):
+            engine.compile(backend="tvm")
+        engine.compile(backend="xla")  # valid path still works
+        assert engine.is_compiled
+
+    def test_dotted_backend_attribute_checked(self):
+        from deepspeed_tpu.runtime.compiler import CompileConfig
+
+        with pytest.raises(ValueError, match="no attribute"):
+            CompileConfig.from_dict({"backend": "json.no_such_fn"})

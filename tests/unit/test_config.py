@@ -251,3 +251,20 @@ def test_bad_scheduler_type_raises():
             "train_batch_size": 8,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
             "scheduler": {"type": "NoSuchLR", "params": {}}})
+
+
+def test_nebula_block_maps_to_async_save():
+    """Reference `nebula` configs (nebula/config.py) enable the async
+    checkpoint engine here; an explicit checkpoint.async_save wins."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "nebula": {"enabled": True,
+                                      "persistent_storage_path": "/tmp/x"}})
+    assert cfg.checkpoint_config.async_save is True
+    cfg2 = DeepSpeedConfig({"train_batch_size": 8,
+                            "nebula": {"enabled": True},
+                            "checkpoint": {"async_save": False}})
+    assert cfg2.checkpoint_config.async_save is False
+    cfg3 = DeepSpeedConfig({"train_batch_size": 8})
+    assert cfg3.checkpoint_config.async_save is False

@@ -386,3 +386,77 @@ def test_lazy_loss_matches_eager_trajectory():
         float(engine2(random_batch(batch_size=16, hidden_dim=HIDDEN, seed=99)))
         engine2._cached = None  # discard the un-backwarded validation forward
     np.testing.assert_allclose(losses, losses2, rtol=1e-6)
+
+
+def test_legacy_curriculum_truncates_and_anneals():
+    """Reference top-level `curriculum_learning` block (engine.py:1824-1837):
+    training batches truncate to the scheduled seqlen, the difficulty anneals
+    to full length, and each quantized phase is ONE jit variant."""
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    reset_topology()
+    cfg = gpt2_config("125m", hidden_size=32, num_layers=2, num_heads=2,
+                      vocab_size=128, max_seq_len=64)
+    engine, *_ = deepspeed_tpu.initialize(model=TransformerLM(cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 0,
+        "curriculum_learning": {
+            "enabled": True,
+            "curriculum_type": "seqlen",
+            "min_difficulty": 16,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 16},
+        },
+    })
+    assert engine.curriculum_enabled_legacy()
+    assert engine.curriculum_seqlen() == 16
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 64), dtype=np.int32))
+    seen = []
+    for _ in range(6):
+        seen.append(engine.curriculum_seqlen())
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(loss))
+    assert seen[0] == 16 and seen[-1] == 64, seen
+    assert seen == sorted(seen), f"difficulty must be non-decreasing: {seen}"
+    # 16→64 with difficulty_step 16 → at most 4 shapes → ≤4 compiled variants
+    assert engine._fwd_bwd._cache_size() <= 4
+
+
+def test_legacy_curriculum_truncates_tuple_batches():
+    """Tuple batches (documented model input form) must also truncate —
+    a configured curriculum silently no-opping would be worse than an
+    error."""
+    from deepspeed_tpu.comm.topology import reset_topology
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    reset_topology()
+    cfg = gpt2_config("125m", hidden_size=32, num_layers=2, num_heads=2,
+                      vocab_size=128, max_seq_len=64)
+    engine, *_ = deepspeed_tpu.initialize(model=TransformerLM(cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 16, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 16},
+        },
+    })
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 64), dtype=np.int32))
+    out = engine._inject_train_kwargs((ids,))
+    assert out[0].shape == (2, 16)
+    out2 = engine._inject_train_kwargs(ids)
+    assert out2.shape == (2, 16)
