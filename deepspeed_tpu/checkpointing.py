@@ -1,0 +1,13 @@
+"""``deepspeed.checkpointing`` parity alias (reference ``deepspeed/__init__.py``
+exposes activation checkpointing at the package top level; the implementation
+lives in ``runtime/activation_checkpointing/checkpointing.py``)."""
+
+from .runtime.activation_checkpointing.checkpointing import (  # noqa: F401
+    RNGStatesTracker,
+    checkpoint,
+    checkpoint_wrapped,
+    configure,
+    get_cuda_rng_tracker,
+    is_configured,
+    model_parallel_cuda_manual_seed,
+)

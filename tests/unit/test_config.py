@@ -268,3 +268,20 @@ def test_nebula_block_maps_to_async_save():
     assert cfg2.checkpoint_config.async_save is False
     cfg3 = DeepSpeedConfig({"train_batch_size": 8})
     assert cfg3.checkpoint_config.async_save is False
+
+
+def test_reference_top_level_module_surface():
+    """Users migrating from the reference import these names directly
+    (deepspeed.zero / checkpointing / moe / compression / comm / compiler
+    role under runtime) — all must resolve."""
+    import importlib
+
+    for name in ("deepspeed_tpu.zero", "deepspeed_tpu.checkpointing",
+                 "deepspeed_tpu.moe", "deepspeed_tpu.compression",
+                 "deepspeed_tpu.comm", "deepspeed_tpu.runtime.compiler",
+                 "deepspeed_tpu.elasticity", "deepspeed_tpu.autotuning",
+                 "deepspeed_tpu.monitor", "deepspeed_tpu.profiling",
+                 "deepspeed_tpu.checkpoint"):
+        importlib.import_module(name)
+    from deepspeed_tpu.checkpointing import checkpoint, configure  # noqa: F401
+    from deepspeed_tpu.zero import Init  # noqa: F401
