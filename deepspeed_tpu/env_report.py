@@ -4,6 +4,7 @@ Prints JAX/platform versions, visible devices, and host-side native op
 compatibility (the TPU build's analogue of the CUDA op compatibility matrix).
 """
 
+import os
 import shutil
 import sys
 
@@ -63,6 +64,23 @@ def debug_report():
         pass
     for name, value in rows:
         print(f"{name:<24} {value}")
+    print("-" * 60)
+    print("DeepSpeed-TPU environment knobs (set = shown, else default):")
+    print("-" * 60)
+    knobs = [
+        ("DS_ACCELERATOR", "accelerator override (tpu/cpu)"),
+        ("DSTPU_PALLAS_INTERPRET", "0=force Mosaic kernels, 1=interpreter"),
+        ("DSTPU_LOG_LEVEL", "package log level"),
+        ("DSTPU_NUM_PROCESSES", "multi-process world size"),
+        ("DSTPU_PROCESS_ID", "this process's rank"),
+        ("COORDINATOR_ADDRESS", "rendezvous coordinator host:port"),
+        ("DSTPU_FORCE_PAGED_KERNEL", "exercise the paged kernel off-TPU"),
+        ("XLA_FLAGS", "XLA backend flags"),
+        ("JAX_PLATFORMS", "jax platform pin"),
+    ]
+    for name, desc in knobs:
+        val = os.environ.get(name)
+        print(f"{name:<28} {val if val is not None else '(unset)':<24} {desc}")
 
 
 def main():
