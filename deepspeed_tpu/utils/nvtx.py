@@ -1,0 +1,43 @@
+"""Trace-range annotation shim (reference ``deepspeed/utils/nvtx.py``
+``instrument_w_nvtx`` + accelerator ``range_push``/``range_pop``,
+``abstract_accelerator.py:189``).
+
+On TPU the profiler is xprof/Perfetto, not NVTX: ranges map to
+``jax.profiler.TraceAnnotation`` so decorated host-side functions show up as
+named spans in captured traces. Device-side program internals are annotated
+by XLA itself (HLO op metadata) — this shim covers the host orchestration
+layer the reference instruments (fetch/release, step phases, IO).
+"""
+
+import functools
+
+import jax
+
+
+def range_push(name: str):
+    """Start a named host trace span; returns the annotation object (pass it
+    to ``range_pop``). Prefer ``instrument_w_nvtx`` or ``annotate``."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    return ann
+
+
+def range_pop(ann) -> None:
+    ann.__exit__(None, None, None)
+
+
+def annotate(name: str):
+    """Context manager: ``with annotate("phase"): ...``"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def instrument_w_nvtx(func):
+    """Decorator: record a named trace span for every call (reference
+    ``instrument_w_nvtx``; spans appear in xprof captures)."""
+
+    @functools.wraps(func)
+    def wrapped_fn(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped_fn
