@@ -13,16 +13,30 @@ import functools
 
 import jax
 
+#: open spans for the no-argument reference signature (LIFO, like NVTX)
+_range_stack = []
+
 
 def range_push(name: str):
-    """Start a named host trace span; returns the annotation object (pass it
-    to ``range_pop``). Prefer ``instrument_w_nvtx`` or ``annotate``."""
+    """Start a named host trace span (reference ``accelerator.range_push``
+    signature). Spans nest LIFO; close with ``range_pop()``. Prefer
+    ``instrument_w_nvtx`` or ``annotate`` in new code."""
     ann = jax.profiler.TraceAnnotation(name)
     ann.__enter__()
+    _range_stack.append(ann)
     return ann
 
 
-def range_pop(ann) -> None:
+def range_pop(ann=None) -> None:
+    """Close a span. With no argument (the reference's signature) the most
+    recently pushed span closes; passing the object from ``range_push``
+    also works."""
+    if ann is None:
+        if not _range_stack:
+            return
+        ann = _range_stack.pop()
+    elif ann in _range_stack:
+        _range_stack.remove(ann)
     ann.__exit__(None, None, None)
 
 
