@@ -112,15 +112,16 @@ def mpi_discovery(distributed_port: int = DEFAULT_COORDINATOR_PORT,
                 "mpi_discovery: no mpi4py and no OMPI_*/PMI_* environment — "
                 "not an MPI launch")
         master_addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    # a launcher-provided MASTER_PORT wins over the default argument
+    port = int(os.environ.get("MASTER_PORT", distributed_port))
     os.environ["RANK"] = str(rank)
     os.environ["WORLD_SIZE"] = str(world_size)
     os.environ["LOCAL_RANK"] = str(local_rank)
     os.environ.setdefault("MASTER_ADDR", master_addr)
-    os.environ.setdefault("MASTER_PORT", str(distributed_port))
+    os.environ.setdefault("MASTER_PORT", str(port))
     os.environ["DSTPU_NUM_PROCESSES"] = str(world_size)
     os.environ["DSTPU_PROCESS_ID"] = str(rank)
-    os.environ.setdefault("COORDINATOR_ADDRESS",
-                          f"{master_addr}:{distributed_port}")
+    os.environ.setdefault("COORDINATOR_ADDRESS", f"{master_addr}:{port}")
     if verbose:
         logger.info(
             f"mpi_discovery: rank={rank} local_rank={local_rank} "
@@ -138,14 +139,17 @@ def patch_aml_env(master_port: int = DEFAULT_COORDINATOR_PORT,
         addr = os.environ["AZ_BATCH_MASTER_NODE"].split(":")[0]
     else:
         addr = os.environ["AZ_BATCHAI_MPI_MASTER_NODE"]
+    # a preset MASTER_PORT wins over the default argument (must agree with
+    # COORDINATOR_ADDRESS, same rule as mpi_discovery)
+    port = int(os.environ.get("MASTER_PORT", master_port))
     os.environ["RANK"] = rank
     os.environ["WORLD_SIZE"] = world
     os.environ["LOCAL_RANK"] = os.environ["OMPI_COMM_WORLD_LOCAL_RANK"]
     os.environ.setdefault("MASTER_ADDR", addr)
-    os.environ.setdefault("MASTER_PORT", str(master_port))
+    os.environ.setdefault("MASTER_PORT", str(port))
     os.environ["DSTPU_NUM_PROCESSES"] = world
     os.environ["DSTPU_PROCESS_ID"] = rank
-    os.environ.setdefault("COORDINATOR_ADDRESS", f"{addr}:{master_port}")
+    os.environ.setdefault("COORDINATOR_ADDRESS", f"{addr}:{port}")
     if verbose:
         logger.info(
             f"AzureML env: rank={rank} world={world} "

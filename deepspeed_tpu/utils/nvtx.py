@@ -10,33 +10,46 @@ layer the reference instruments (fetch/release, step phases, IO).
 """
 
 import functools
+import threading
 
 import jax
 
-#: open spans for the no-argument reference signature (LIFO, like NVTX)
-_range_stack = []
+#: open spans for the no-argument reference signature — PER THREAD (NVTX
+#: ranges are thread-scoped; a global stack would let one thread pop
+#: another's span, and exceptions would leak entries forever)
+_ranges = threading.local()
+
+
+def _stack():
+    if not hasattr(_ranges, "stack"):
+        _ranges.stack = []
+    return _ranges.stack
 
 
 def range_push(name: str):
     """Start a named host trace span (reference ``accelerator.range_push``
-    signature). Spans nest LIFO; close with ``range_pop()``. Prefer
-    ``instrument_w_nvtx`` or ``annotate`` in new code."""
+    signature). Spans nest LIFO per thread; close with ``range_pop()``.
+    Prefer ``instrument_w_nvtx`` or ``annotate`` in new code — as context
+    managers they cannot leak a span across an exception."""
     ann = jax.profiler.TraceAnnotation(name)
     ann.__enter__()
-    _range_stack.append(ann)
+    _stack().append(ann)
     return ann
 
 
 def range_pop(ann=None) -> None:
-    """Close a span. With no argument (the reference's signature) the most
-    recently pushed span closes; passing the object from ``range_push``
-    also works."""
+    """Close a span. With no argument (the reference's signature) this
+    thread's most recently pushed span closes; passing the object from
+    ``range_push`` also works."""
+    stack = _stack()
     if ann is None:
-        if not _range_stack:
+        if not stack:
             return
-        ann = _range_stack.pop()
-    elif ann in _range_stack:
-        _range_stack.remove(ann)
+        ann = stack.pop()
+    elif ann in stack:
+        # also drop anything pushed above it that was never popped (an
+        # exception skipped those pops) so the stack cannot grow unboundedly
+        del stack[stack.index(ann):]
     ann.__exit__(None, None, None)
 
 
