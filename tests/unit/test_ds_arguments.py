@@ -1,0 +1,109 @@
+"""initialize()/CLI argument surface (reference
+``tests/unit/launcher/test_ds_arguments.py`` + ``runtime/test_ds_initialize.py``
+intent): argparse integration, config-source precedence, deprecated aliases,
+and the initialize() validation matrix."""
+
+import argparse
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import topology as topo_mod
+from tests.unit.simple_model import make_simple_model
+
+BASE = {"train_batch_size": 8,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 0, "mesh": {"data": 8}}
+
+
+class TestAddConfigArguments:
+    def _parse(self, argv):
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--user_arg", type=int, default=1)
+        deepspeed_tpu.add_config_arguments(parser)
+        return parser.parse_args(argv)
+
+    def test_flags_present_with_defaults(self):
+        args = self._parse([])
+        assert args.deepspeed is False
+        assert args.deepspeed_config is None
+        assert args.deepscale is False  # deprecated alias exists
+        assert args.user_arg == 1  # user args coexist
+
+    def test_flags_parse(self):
+        args = self._parse(["--deepspeed", "--deepspeed_config", "/x.json",
+                            "--user_arg", "7"])
+        assert args.deepspeed and args.deepspeed_config == "/x.json"
+        assert args.user_arg == 7
+
+
+class TestInitializeValidation:
+    def test_model_required(self):
+        with pytest.raises(AssertionError, match="model is a required"):
+            deepspeed_tpu.initialize(config=dict(BASE))
+
+    def test_config_required(self):
+        with pytest.raises(AssertionError, match="deepspeed_config"):
+            deepspeed_tpu.initialize(model=make_simple_model(16))
+
+    def test_config_from_args_namespace(self, tmp_path):
+        """Reference flow: argparse namespace carrying --deepspeed_config."""
+        p = tmp_path / "ds.json"
+        p.write_text(json.dumps(BASE))
+        ns = argparse.Namespace(deepspeed_config=str(p))
+        topo_mod.reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(args=ns,
+                                              model=make_simple_model(16))
+        assert engine.train_batch_size == 8
+
+    def test_config_params_alias(self):
+        """The reference's deprecated config_params= kwarg still works."""
+        topo_mod.reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(model=make_simple_model(16),
+                                              config_params=dict(BASE))
+        assert engine.train_batch_size == 8
+
+    def test_explicit_config_wins_over_args(self, tmp_path):
+        p = tmp_path / "ds.json"
+        p.write_text(json.dumps(dict(BASE, train_batch_size=16)))
+        ns = argparse.Namespace(deepspeed_config=str(p))
+        topo_mod.reset_topology()
+        engine, *_ = deepspeed_tpu.initialize(args=ns, config=dict(BASE),
+                                              model=make_simple_model(16))
+        assert engine.train_batch_size == 8  # dict config took precedence
+
+    def test_mpu_accepted_and_warned(self, monkeypatch):
+        import deepspeed_tpu as pkg
+
+        seen = []
+        monkeypatch.setattr(pkg.logger, "warning",
+                            lambda m, *a, **k: seen.append(str(m)))
+        topo_mod.reset_topology()
+        deepspeed_tpu.initialize(model=make_simple_model(16),
+                                 config=dict(BASE), mpu=object())
+        assert any("mpu" in m for m in seen)
+
+    def test_returns_reference_four_tuple(self):
+        topo_mod.reset_topology()
+        out = deepspeed_tpu.initialize(model=make_simple_model(16),
+                                       config=dict(BASE))
+        assert len(out) == 4
+        engine, optimizer, dataloader, lr_sched = out
+        assert optimizer is engine.optimizer
+        assert dataloader is None and lr_sched is None
+
+    def test_training_data_builds_dataloader(self):
+        from tests.unit.simple_model import random_dataset
+
+        topo_mod.reset_topology()
+        data = random_dataset(n=32, hidden_dim=16, seed=0)
+        engine, _, loader, _ = deepspeed_tpu.initialize(
+            model=make_simple_model(16), config=dict(BASE),
+            training_data=data)
+        assert loader is not None
+        x, y = next(iter(loader))
+        assert np.asarray(x).shape[0] == engine.train_micro_batch_size_per_gpu \
+            * engine.topology.data_parallel_size
