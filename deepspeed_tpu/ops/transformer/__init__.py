@@ -1,3 +1,7 @@
 """Transformer ops: attention dispatch + Pallas kernels (reference deepspeed/ops/transformer)."""
 
 from .attention import attention, set_default_impl, xla_attention  # noqa: F401
+from .transformer import (  # noqa: F401
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)

@@ -1,0 +1,167 @@
+"""Standalone DeepSpeedTransformerLayer tests (reference
+``ops/transformer/transformer.py`` + ``tests/unit/ops/transformer``): layer
+math vs an independent reference implementation, pre/post-LN variants, mask
+semantics, seeded-weight import, and grads through one jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+
+def _cfg(**kw):
+    base = dict(hidden_size=64, heads=4, intermediate_size=128,
+                attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+                num_hidden_layers=2)
+    base.update(kw)
+    return DeepSpeedTransformerConfig(**base)
+
+
+def _ref_forward(p, x, cfg, mask=None):
+    """Independent numpy/jnp re-derivation of the BERT layer math."""
+    H, nh = cfg.hidden_size, cfg.heads
+    hd = H // nh
+
+    def ln(h, w, b):
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        return (h - mu) / np.sqrt(var + cfg.layer_norm_eps) * w + b
+
+    def attn(h):
+        qkv = h @ np.asarray(p["qkvw"]) + np.asarray(p["qkvb"])
+        q, k, v = np.split(qkv, 3, axis=-1)
+        B, S, _ = h.shape
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+        if mask is not None:
+            logits = logits + (1.0 - mask[:, None, None, :]) * -1e9
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        ctx = (w @ v).transpose(0, 2, 1, 3).reshape(B, S, H)
+        return ctx @ np.asarray(p["attn_ow"]) + np.asarray(p["attn_ob"])
+
+    def mlp(h):
+        inter = h @ np.asarray(p["inter_w"]) + np.asarray(p["inter_b"])
+        from scipy.stats import norm  # exact gelu
+        inter = inter * norm.cdf(inter)
+        return inter @ np.asarray(p["output_w"]) + np.asarray(p["output_b"])
+
+    if cfg.pre_layer_norm:
+        h = x + attn(ln(x, np.asarray(p["attn_nw"]), np.asarray(p["attn_nb"])))
+        return h + mlp(ln(h, np.asarray(p["norm_w"]), np.asarray(p["norm_b"])))
+    h = ln(x + attn(x), np.asarray(p["attn_nw"]), np.asarray(p["attn_nb"]))
+    return ln(h + mlp(h), np.asarray(p["norm_w"]), np.asarray(p["norm_b"]))
+
+
+class TestLayerMath:
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    def test_matches_independent_reference(self, pre_ln):
+        cfg = _cfg(pre_layer_norm=pre_ln)
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = np.random.default_rng(1).standard_normal((2, 16, 64)).astype(np.float32)
+        got = np.asarray(layer.apply(p, jnp.asarray(x), train=False))
+        want = _ref_forward(p, x, cfg)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_attention_mask_blocks_padding_batched(self):
+        """B>1 with PER-BATCH masks: padding in one row must not leak into
+        its own unmasked positions, and must not affect the other row at
+        all (a mis-broadcast mask corrupts exactly these)."""
+        cfg = _cfg()
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 8, 64)).astype(np.float32)
+        mask = np.ones((2, 8), np.float32)
+        mask[0, 6:] = 0.0  # row 0: last two positions are padding
+        y = np.asarray(layer.apply(p, jnp.asarray(x),
+                                   attention_mask=jnp.asarray(mask),
+                                   train=False))
+        # perturbing row 0's masked position changes neither row 0's
+        # unmasked outputs nor row 1
+        x2 = x.copy()
+        x2[0, 7] += 100.0
+        y2 = np.asarray(layer.apply(p, jnp.asarray(x2),
+                                    attention_mask=jnp.asarray(mask),
+                                    train=False))
+        np.testing.assert_allclose(y[0, :6], y2[0, :6], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(y[1], y2[1], rtol=1e-6)
+        # row 1 (no padding) matches the unmasked computation exactly
+        y_nomask = np.asarray(layer.apply(p, jnp.asarray(x), train=False))
+        np.testing.assert_allclose(y[1], y_nomask[1], rtol=1e-5, atol=1e-6)
+
+    def test_attn_prob_dropout_path_matches_eval_at_zero_ratio(self):
+        """The prob-dropout training path (explicit einsum attention) must
+        be numerically consistent with the registry path it replaces."""
+        cfg = _cfg(attn_dropout_ratio=0.3)
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(7).standard_normal(
+            (2, 8, 64)), jnp.float32)
+        # train=True with rng exercises the einsum+prob-dropout path; at
+        # ratio→0 (rebuild config) it must agree with eval
+        cfg0 = _cfg(attn_dropout_ratio=1e-9)
+        layer0 = DeepSpeedTransformerLayer(cfg0)
+        t = layer0.apply(p, x, train=True, rng=jax.random.PRNGKey(3))
+        e = layer.apply(p, x, train=False)
+        np.testing.assert_allclose(np.asarray(t), np.asarray(e), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_grads_flow_under_jit(self):
+        cfg = _cfg(gelu_checkpoint=True, attn_dropout_checkpoint=True)
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(3).standard_normal(
+            (2, 16, 64)), jnp.float32)
+
+        @jax.jit
+        def loss_fn(p):
+            return jnp.sum(layer.apply(p, x, train=False) ** 2)
+
+        g = jax.grad(loss_fn)(p)
+        for k, v in g.items():
+            assert bool(jnp.all(jnp.isfinite(v))), k
+        assert float(jnp.max(jnp.abs(g["qkvw"]))) > 0
+
+    def test_seeded_weight_import(self):
+        """initial_weights/biases seed qkv+output projections from existing
+        (torch-layout) weights — the reference's HF-BERT injection path."""
+        cfg = _cfg()
+        rng = np.random.default_rng(4)
+        H = 64
+        ws = [rng.standard_normal((H, H)).astype(np.float32) for _ in range(4)]
+        bs = [rng.standard_normal((H,)).astype(np.float32) for _ in range(4)]
+        layer = DeepSpeedTransformerLayer(cfg, initial_weights=ws,
+                                          initial_biases=bs)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(p["qkvw"][:, :H]), ws[0].T)
+        np.testing.assert_allclose(np.asarray(p["attn_ow"]), ws[3].T)
+        np.testing.assert_allclose(np.asarray(p["qkvb"][H:2 * H]), bs[1])
+
+    def test_dropout_train_vs_eval(self):
+        cfg = _cfg(attn_dropout_ratio=0.5, hidden_dropout_ratio=0.5)
+        layer = DeepSpeedTransformerLayer(cfg)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(np.random.default_rng(5).standard_normal(
+            (1, 8, 64)), jnp.float32)
+        e1 = layer.apply(p, x, train=False)
+        e2 = layer.apply(p, x, train=False)
+        np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+        t1 = layer.apply(p, x, train=True, rng=jax.random.PRNGKey(1))
+        t2 = layer.apply(p, x, train=True, rng=jax.random.PRNGKey(2))
+        assert not np.allclose(np.asarray(t1), np.asarray(t2))
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="divisible"):
+            _cfg(hidden_size=65)
+        c = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
+                                       intermediate_size=0)
+        assert c.intermediate_size == 256  # defaults to 4H
