@@ -60,6 +60,31 @@ class DeepSpeedTransformerConfig:
                 f"hidden_size {self.hidden_size} not divisible by heads "
                 f"{self.heads}")
 
+    @classmethod
+    def from_dict(cls, json_object: dict) -> "DeepSpeedTransformerConfig":
+        """reference ``from_dict:130`` — unknown keys warn instead of the
+        reference's silent ``__dict__`` injection."""
+        import dataclasses
+
+        from ...utils.logging import logger
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, value in (json_object or {}).items():
+            if key in known:
+                kwargs[key] = value
+            else:
+                logger.warning(
+                    f"DeepSpeedTransformerConfig: unknown key '{key}' ignored")
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json_file(cls, json_file: str) -> "DeepSpeedTransformerConfig":
+        import json
+
+        with open(json_file, "r") as reader:
+            return cls.from_dict(json.loads(reader.read()))
+
 
 class DeepSpeedTransformerLayer:
     """reference ``DeepSpeedTransformerLayer:296``: one BERT-style layer."""

@@ -165,3 +165,18 @@ class TestLayerMath:
         c = DeepSpeedTransformerConfig(hidden_size=64, heads=4,
                                        intermediate_size=0)
         assert c.intermediate_size == 256  # defaults to 4H
+
+
+class TestConfigConstructors:
+    def test_from_dict_and_json_file(self, tmp_path):
+        import json
+
+        d = {"hidden_size": 64, "heads": 4, "intermediate_size": 128,
+             "pre_layer_norm": False, "bogus": 1}
+        c = DeepSpeedTransformerConfig.from_dict(d)
+        assert (c.hidden_size, c.heads, c.pre_layer_norm) == (64, 4, False)
+        assert not hasattr(c, "bogus")  # warned + ignored, not injected
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps(d))
+        c2 = DeepSpeedTransformerConfig.from_json_file(str(p))
+        assert c2 == c
