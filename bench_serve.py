@@ -17,6 +17,12 @@ flushed (eviction) and queued requests admitted when ``can_schedule`` says so
 configuration. Compiled-program counts are recorded — the paged engine must
 hold at most TWO ragged programs (mixed-budget + decode-round shape)
 regardless of load — the fixed-shape design.
+
+The ``shared_prefix`` rows bench block-level prefix caching
+(docs/PREFIX_CACHING.md): every request shares a 256-token system prompt, and
+the paged engine is run with the cache on and off (``prefix_cache=False``);
+hit-rate and skipped-prefill-token counters are reported per row along with
+the cache-on/cache-off speedup.
 """
 
 import json
@@ -35,13 +41,19 @@ from deepspeed_tpu.utils.transfer import install_transfer_guard
 install_transfer_guard()
 
 def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
-             prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False):
-    """Drive the engine with Poisson arrivals until all requests finish."""
+             prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False,
+             shared_prefix=None):
+    """Drive the engine with Poisson arrivals until all requests finish.
+
+    ``shared_prefix``: token list prepended to EVERY prompt — the
+    system-prompt / few-shot serving shape the prefix cache targets."""
     import jax
 
     vocab = engine.cfg.vocab_size
+    base = list(shared_prefix) if shared_prefix else []
     arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n_requests))
-    prompts = [rng.integers(0, vocab, rng.integers(prompt_lo, prompt_hi + 1)).tolist()
+    prompts = [base + rng.integers(0, vocab,
+                                   rng.integers(prompt_lo, prompt_hi + 1)).tolist()
                for _ in range(n_requests)]
     gen_targets = rng.integers(gen_lo, gen_hi + 1, n_requests)
 
@@ -104,7 +116,28 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     return out
 
 
-def run_config(mode: str, max_seqs: int) -> dict:
+def _metric_name(mode: str, max_seqs: int, workload: str,
+                 prefix_cache: bool) -> str:
+    name = f"serve_{mode}_{max_seqs}seq"
+    if workload != "mixed":
+        name += f"_{workload}"
+    if not prefix_cache:
+        name += "_nocache"
+    return name + "_tokens_per_s"
+
+
+def run_config(mode: str, max_seqs: int, workload: str = "mixed",
+               prefix_cache: bool = True) -> dict:
+    """One engine configuration under one workload.
+
+    workloads:
+    - ``mixed``: independent random prompts U[32,256] (no reuse to exploit) —
+      the prefix-cache cold path, which must match the pre-cache numbers.
+    - ``shared_prefix``: every request carries the same 256-token system
+      prompt (4 full 64-token blocks) plus a U[32,128] unique tail — the
+      serving shape prefix caching targets. ``prefix_cache=False`` benches the
+      same workload with the cache disabled (the comparison baseline).
+    """
     import logging
 
     logging.getLogger("DeepSpeedTPU").setLevel(logging.WARNING)
@@ -114,32 +147,56 @@ def run_config(mode: str, max_seqs: int) -> dict:
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
     from deepspeed_tpu.models import TransformerLM, gpt2_config
 
-    cfg = gpt2_config("350m", max_seq_len=1024)
+    # host-capability knobs (defaults are the production-shaped run):
+    #   DSTPU_BENCH_GPT2      preset size, default 350m
+    #   DSTPU_BENCH_OVERRIDES JSON kwargs into gpt2_config (tiny-model CI)
+    #   DSTPU_BENCH_REQUESTS  throughput-phase request count, default 120
+    size = os.environ.get("DSTPU_BENCH_GPT2", "350m")
+    overrides = json.loads(os.environ.get("DSTPU_BENCH_OVERRIDES", "{}"))
+    n_req = int(os.environ.get("DSTPU_BENCH_REQUESTS", "120"))
+    cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     rng = np.random.default_rng(7)
+    shared = workload == "shared_prefix"
+    # paged value proposition: the pool is sized for the WORKLOAD, not
+    # max_seqs×max_ctx. mixed: ≤320 tokens/seq = 5 blocks (3.2× less KV
+    # memory than the slot layout at the same max_seqs). shared_prefix:
+    # ≤256+128+64 = 448 tokens/seq = 7 blocks — sized for the CACHE-OFF
+    # baseline so both cache settings run the same pool (with the cache on,
+    # the shared blocks make the pool effectively deeper, not the other way
+    # around).
+    blocks_per_seq = 7 if shared else 5
     eng = InferenceEngineV2(
         model, params, max_seqs=max_seqs, max_seq_len=1024,
         prefill_chunk=256, dtype=jnp.bfloat16, paged=(mode == "paged"),
         block_size=64, token_budget=256 if mode == "paged" else 0,
-        # paged value proposition: the pool is sized for the WORKLOAD (≤320
-        # tokens/seq = 5 blocks), not max_seqs×max_ctx — 3.2× less KV memory
-        # than the slot layout at the same max_seqs
-        num_blocks=(1 + max_seqs * 5) if mode == "paged" else None)
+        num_blocks=(1 + max_seqs * blocks_per_seq) if mode == "paged" else None,
+        prefix_cache=prefix_cache)
+    prefix = (rng.integers(0, cfg.vocab_size, 256).tolist() if shared else None)
+    load_kw = dict(shared_prefix=prefix)
+    if shared:
+        load_kw.update(prompt_lo=32, prompt_hi=128)
     # phase 1: pipelined throughput
-    tput = run_load(eng, n_requests=120, arrival_rate=200.0, rng=rng)
+    tput = run_load(eng, n_requests=n_req, arrival_rate=200.0, rng=rng,
+                    **load_kw)
     # phase 2: per-token latency (synced steps), fresh engine state
     for uid in list(eng.state.seqs):
         eng.flush(uid)
-    lat = run_load(eng, n_requests=60, arrival_rate=200.0, rng=rng,
-                   sync_each_step=True)
+    lat = run_load(eng, n_requests=max(1, n_req // 2), arrival_rate=200.0,
+                   rng=rng, sync_each_step=True, **load_kw)
+    model_note = f"gpt2-{size} bf16" + (f" {overrides}" if overrides else "")
     row = {
-        "metric": f"serve_{mode}_{max_seqs}seq_tokens_per_s",
+        "metric": _metric_name(mode, max_seqs, workload, prefix_cache),
         "value": tput["tokens_per_s"], "unit": "tokens/s",
         "vs_baseline": None,
         "detail": {
-            "mode": mode, "max_seqs": max_seqs, "model": "gpt2-350m bf16",
-            "workload": "Poisson arrivals, prompts U[32,256], gen U[16,64]",
+            "mode": mode, "max_seqs": max_seqs, "model": model_note,
+            "workload": (
+                "Poisson arrivals, 256-tok shared system prompt + tails "
+                "U[32,128], gen U[16,64]" if shared else
+                "Poisson arrivals, prompts U[32,256], gen U[16,64]"),
+            "prefix_cache": bool(prefix_cache and mode == "paged"),
             "throughput": tput, "latency": lat,
             "compiled_programs": (
                 eng.ragged_cache_size if mode == "paged"
@@ -147,9 +204,23 @@ def run_config(mode: str, max_seqs: int) -> dict:
         },
     }
     if mode == "paged":
-        # two fixed shapes ever: mixed-budget + decode-round (O(1) vs load)
+        # cache-effectiveness counters (also exported live through
+        # engine.prefix_cache_stats() / engine.monitor_events())
+        row["detail"]["prefix_cache_stats"] = eng.prefix_cache_stats()
+        # two fixed shapes ever: mixed-budget + decode-round (O(1) vs load);
+        # the prefix cache is host-side bookkeeping and must add none
         assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
     return row
+
+
+#: (mode, max_seqs, workload, prefix_cache) per bench row
+CONFIGS = (
+    ("paged", 32, "mixed", True),
+    ("paged", 64, "mixed", True),
+    ("slot", 32, "mixed", True),
+    ("paged", 32, "shared_prefix", True),
+    ("paged", 32, "shared_prefix", False),
+)
 
 
 def main():
@@ -160,19 +231,30 @@ def main():
     import sys
 
     results = []
-    for mode, max_seqs in (("paged", 32), ("paged", 64), ("slot", 32)):
+    rows = {}
+    for mode, max_seqs, workload, cache in CONFIGS:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), mode, str(max_seqs)],
+            [sys.executable, os.path.abspath(__file__), mode, str(max_seqs),
+             workload, str(int(cache))],
             capture_output=True, text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
         try:
             row = json.loads(line)
         except json.JSONDecodeError:
-            row = {"metric": f"serve_{mode}_{max_seqs}seq_tokens_per_s",
+            row = {"metric": _metric_name(mode, max_seqs, workload, cache),
                    "error": proc.stderr[-400:]}
         results.append(row)
+        rows[row["metric"]] = row
         print(json.dumps(row), flush=True)
+    hit = rows.get("serve_paged_32seq_shared_prefix_tokens_per_s", {})
+    cold = rows.get("serve_paged_32seq_shared_prefix_nocache_tokens_per_s", {})
+    if "value" in hit and "value" in cold and cold["value"]:
+        speedup = hit["value"] / cold["value"]
+        hit["vs_baseline"] = round(speedup, 2)
+        print(json.dumps({"metric": "prefix_cache_speedup_shared_prefix",
+                          "value": round(speedup, 2), "unit": "x vs cache off"}),
+              flush=True)
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_SERVE.json"), "w") as f:
         json.dump(results, f, indent=1)
@@ -181,7 +263,10 @@ def main():
 if __name__ == "__main__":
     import sys
 
-    if len(sys.argv) == 3:
-        print(json.dumps(run_config(sys.argv[1], int(sys.argv[2]))))
+    if len(sys.argv) >= 3:
+        print(json.dumps(run_config(
+            sys.argv[1], int(sys.argv[2]),
+            sys.argv[3] if len(sys.argv) > 3 else "mixed",
+            bool(int(sys.argv[4])) if len(sys.argv) > 4 else True)))
     else:
         main()

@@ -111,7 +111,22 @@ def mpi_discovery(distributed_port: int = DEFAULT_COORDINATOR_PORT,
             raise RuntimeError(
                 "mpi_discovery: no mpi4py and no OMPI_*/PMI_* environment — "
                 "not an MPI launch")
-        master_addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+        master_addr = os.environ.get("MASTER_ADDR")
+        if master_addr is None and os.environ.get("COORDINATOR_ADDRESS"):
+            # a preset coordinator names the rendezvous host already
+            master_addr = os.environ["COORDINATOR_ADDRESS"].rsplit(":", 1)[0]
+        if master_addr is None:
+            if world_size > 1:
+                # without mpi4py there is no hostname broadcast: defaulting
+                # the coordinator to loopback would make every node rendezvous
+                # with itself and hang the job at init
+                raise RuntimeError(
+                    f"mpi_discovery: world_size={world_size} but MASTER_ADDR "
+                    "is unset and mpi4py is unavailable to broadcast the "
+                    "coordinator hostname. Export MASTER_ADDR=<rank-0 host> "
+                    "on every rank (and optionally MASTER_PORT), or install "
+                    "mpi4py so rank 0 can broadcast its address.")
+            master_addr = "127.0.0.1"  # single process: loopback is correct
     # a launcher-provided MASTER_PORT wins over the default argument
     port = int(os.environ.get("MASTER_PORT", distributed_port))
     os.environ["RANK"] = str(rank)
@@ -463,7 +478,10 @@ def all_reduce(tensor, op: str = "sum", group=None, async_op: bool = False, log_
     def _reduce(x):
         return inprog_all_reduce(x, active, op)
 
-    from jax import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.7 top-level export
+    except ImportError:  # older jax: the function lives under experimental
+        from jax.experimental.shard_map import shard_map
 
     f = shard_map(_reduce, mesh=mesh, in_specs=in_spec, out_specs=_drop_axes(in_spec, active))
     out = jax.jit(f, out_shardings=NamedSharding(mesh, PartitionSpec()))(tensor)

@@ -1,4 +1,5 @@
 """Inference v2: continuous batching (reference deepspeed/inference/v2/)."""
 
 from .engine_v2 import InferenceEngineV2  # noqa: F401
-from .ragged_manager import DSStateManager, SequenceDescriptor  # noqa: F401
+from .ragged_manager import (BlockedKVCache, DSStateManager,  # noqa: F401
+                             SequenceDescriptor)

@@ -49,7 +49,8 @@ class InferenceEngineV2:
     def __init__(self, model, params=None, *, max_seqs: Optional[int] = None,
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
-                 num_blocks: Optional[int] = None, token_budget: int = 0):
+                 num_blocks: Optional[int] = None, token_budget: int = 0,
+                 prefix_cache: bool = True):
         self.model = model
         self.cfg = model.config
         # default serving width: paged mode shares one block pool so 32 slots
@@ -85,6 +86,8 @@ class InferenceEngineV2:
         self.state = DSStateManager(max_seqs, self.max_seq_len)
         self._prefill_fns = {}
         self._decode_fn = None
+        self._cow_fn = None
+        self.prefix_cache = bool(prefix_cache) and paged
         if paged:
             # paged-block pool (reference BlockedKVCache): total KV memory is
             # num_blocks*block_size tokens shared across sequences instead of
@@ -95,12 +98,14 @@ class InferenceEngineV2:
             if num_blocks is None:
                 num_blocks = 1 + max_seqs * max_blocks_per_seq  # = slot capacity
             self.block_mgr = BlockedKVCache(num_blocks, block_size,
-                                            max_blocks_per_seq)
+                                            max_blocks_per_seq,
+                                            prefix_cache=self.prefix_cache)
             self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
             log_dist(
                 f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
                 f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk} "
-                f"token_budget={self.token_budget}",
+                f"token_budget={self.token_budget} "
+                f"prefix_cache={'on' if self.prefix_cache else 'off'}",
                 ranks=[0],
             )
         else:
@@ -210,6 +215,23 @@ class InferenceEngineV2:
         self._prefill_fns["ragged"] = fn
         return fn
 
+    def _get_cow(self):
+        """Single fixed-shape block-copy program for copy-on-write: duplicate
+        pool block ``src`` into ``dst``. ``src``/``dst`` are traced scalars, so
+        this compiles exactly ONCE regardless of which blocks are copied — it
+        does not add to the ragged-step trace count and cannot retrace under
+        load (the fixed-shape discipline; see ``ragged_cache_size``)."""
+        if self._cow_fn is None:
+
+            def cow(kv, src, dst):
+                k, v = kv  # (L, kvh, NB, BS, hd) each; block axis = 2
+                k = k.at[:, :, dst].set(k[:, :, src])
+                v = v.at[:, :, dst].set(v[:, :, src])
+                return k, v
+
+            self._cow_fn = jax.jit(cow, donate_argnums=(0,))
+        return self._cow_fn
+
     @property
     def ragged_cache_size(self) -> int:
         """Number of compiled traces of the ragged-step program. Bounded at
@@ -258,6 +280,22 @@ class InferenceEngineV2:
             # state — an exhaustion raise must leave every descriptor intact
             for d, take in plan:
                 self.block_mgr.ensure(d, d.seen_tokens + take)
+            if self.prefix_cache:
+                # copy-on-write: a write landing inside a block some OTHER
+                # sequence also references (a full-prompt cache hit recomputes
+                # its final token inside the last shared block) must first
+                # detach a private copy — shared blocks are immutable. Fresh
+                # ensure()-allocated blocks have refcount 1 and are skipped.
+                for d, take in plan:
+                    bs = self.block_mgr.block_size
+                    first = d.seen_tokens // bs
+                    last = min((d.seen_tokens + take - 1) // bs,
+                               len(d.blocks) - 1)
+                    for j in range(first, last + 1):
+                        if self.block_mgr.refcount(d.blocks[j]) > 1:
+                            src, dst = self.block_mgr.copy_on_write(d, j)
+                            self.kv = self._get_cow()(
+                                self.kv, jnp.int32(src), jnp.int32(dst))
             ids = np.zeros((T, 1), np.int32)
             tables = np.zeros((T, self.block_mgr.max_blocks_per_seq), np.int32)
             starts = np.zeros((T,), np.int32)
@@ -275,12 +313,20 @@ class InferenceEngineV2:
                 if completes:
                     logit_rows[len(finals)] = r - 1
                     finals.append(d)
+                if self.prefix_cache:
+                    d.history.extend(d.pending[:take])
                 del d.pending[:take]
                 d.seen_tokens += take
             fn = self._get_ragged()
             lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
                              jnp.asarray(tables), jnp.asarray(starts),
                              jnp.asarray(logit_rows), greedy)
+            if self.prefix_cache:
+                # the step's writes are dispatched: every block it filled now
+                # holds valid prefix content — publish to the content index
+                # (dedup-aware: identical blocks collapse onto one copy)
+                for d, _ in plan:
+                    self.block_mgr.register(d)
             lg = np.asarray(lg)
             for i, d in enumerate(finals):
                 out[d.uid] = int(lg[i]) if greedy else lg[i]
@@ -309,7 +355,18 @@ class InferenceEngineV2:
         for uid, toks in zip(batch_uids, batch_tokens):
             desc = self.state.get_or_create_sequence(uid)
             if toks is not None and len(toks):
+                fresh = (self.prefix_cache and desc.seen_tokens == 0
+                         and not desc.blocks and not desc.pending)
                 desc.pending.extend(int(t) for t in toks)
+                if fresh and len(desc.pending) > 1:
+                    # prefix-cache admission: map every fully-cached prompt
+                    # block into the block table and advance past those
+                    # tokens — their prefill rows are never scheduled
+                    skipped = self.block_mgr.lookup(desc, desc.pending)
+                    if skipped:
+                        desc.history.extend(desc.pending[:skipped])
+                        del desc.pending[:skipped]
+                        desc.seen_tokens = skipped
 
         out: Dict[int, np.ndarray] = {}
         if self.paged:
@@ -426,6 +483,26 @@ class InferenceEngineV2:
                                    self.block_mgr.free_blocks
                                    * self.block_mgr.block_size)
         return free_slots, self.max_seq_len
+
+    def prefix_cache_stats(self) -> Dict[str, float]:
+        """Prefix-cache effectiveness counters (paged mode): lookups, hits,
+        hit_rate, hit_blocks, skipped_prefill_tokens, cow_copies,
+        dedup_blocks, evicted_blocks, cached_blocks, free_blocks. Empty when
+        the cache is off — dashboards can key on that."""
+        if not self.prefix_cache:
+            return {}
+        s = dict(self.block_mgr.stats)
+        s["hit_rate"] = (s["hits"] / s["lookups"]) if s["lookups"] else 0.0
+        s["cached_blocks"] = self.block_mgr.cached_blocks
+        s["free_blocks"] = self.block_mgr.free_blocks
+        return s
+
+    def monitor_events(self, step: int = 0) -> List[Tuple[str, float, int]]:
+        """Prefix-cache counters as ``(label, value, step)`` events for
+        ``deepspeed_tpu.monitor.MonitorMaster.write_events`` — serving
+        dashboards plot cache effectiveness alongside training metrics."""
+        return [(f"inference/prefix_cache/{k}", float(v), step)
+                for k, v in sorted(self.prefix_cache_stats().items())]
 
     def can_schedule(self, n_new: int = 1) -> bool:
         if not self.state.can_allocate(n_new):

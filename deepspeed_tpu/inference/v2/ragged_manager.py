@@ -10,12 +10,26 @@ must be static-shaped, so the cache is a fixed pool of **sequence slots**
 (max_seqs × max_seq_len) and the host-side scheduler packs work into bucketed
 shapes; "ragged" bookkeeping (who occupies which slot, how far each sequence
 has decoded) lives here on the host where shapes don't matter.
+
+Prefix caching (vLLM-style automatic prefix caching, docs/PREFIX_CACHING.md):
+``BlockedKVCache`` additionally keeps per-block reference counts and an exact
+content index over FULL blocks, chained so a block's key embeds its whole
+prefix — ``(parent_block_id, tokens_in_block)``. A new prompt walks the chain
+from the root and maps every hit block straight into its block table, skipping
+those tokens' prefill entirely. Unreferenced cached blocks park in an LRU and
+are reclaimed (leaf-first, so a chain never dangles) when the free list runs
+dry. All of this is host-side bookkeeping: device programs see only block
+tables, so the fixed-shape discipline of the ragged engine is untouched.
 """
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
+
+#: chain root sentinel for the content index (block ids are >= 0)
+_ROOT = -1
 
 
 @dataclass
@@ -27,6 +41,8 @@ class SequenceDescriptor:
     seen_tokens: int = 0  # tokens already in the KV cache
     pending: List[int] = field(default_factory=list)  # tokens not yet prefilled
     blocks: List[int] = field(default_factory=list)  # paged mode: pool block ids
+    history: List[int] = field(default_factory=list)  # paged: tokens in cache order
+    n_indexed: int = 0  # leading blocks registered in the prefix index
     done: bool = False
 
     @property
@@ -37,21 +53,122 @@ class SequenceDescriptor:
 class BlockedKVCache:
     """Paged-block allocator (reference ``ragged/kv_cache.py:40
     BlockedKVCache``): a fixed pool of fixed-size blocks handed to sequences
-    on demand. Block 0 is reserved as the trash block masked writes target."""
+    on demand. Block 0 is reserved as the trash block masked writes target.
 
-    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+    With ``prefix_cache=True`` the allocator also runs the block-level prefix
+    cache: refcounts, the chained content index, and LRU reclaim of cached
+    blocks. The engine drives it through four calls — ``lookup`` at admission,
+    ``copy_on_write`` before writing into a shared block, ``register`` after a
+    step fills blocks, and ``free`` at flush."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int,
+                 prefix_cache: bool = False):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.prefix_cache = prefix_cache
         self._free: List[int] = list(range(1, num_blocks))[::-1]  # 0 reserved
+        self._ref: Dict[int, int] = {}  # block -> refcount (present iff > 0)
+        # content index: (parent block id | _ROOT, token tuple) -> block id.
+        # Exact keys (no hashing) — a collision would silently serve another
+        # prompt's KV, so the tokens themselves are the key.
+        self._index: Dict[Tuple[int, Tuple[int, ...]], int] = {}
+        self._meta: Dict[int, Tuple[Tuple[int, Tuple[int, ...]], int]] = {}
+        self._children: Dict[int, set] = {}  # parent block -> indexed children
+        #: cached-but-unreferenced blocks, insertion order = eviction order
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.stats = {"lookups": 0, "hits": 0, "hit_blocks": 0,
+                      "skipped_prefill_tokens": 0, "evicted_blocks": 0,
+                      "cow_copies": 0, "dedup_blocks": 0}
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free plus cached-evictable."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently holding indexed prefix content."""
+        return len(self._meta)
 
     def blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
 
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
+
+    # ------------------------------------------------------------------
+    # refcounting + LRU reclaim
+    # ------------------------------------------------------------------
+    def _incref(self, block: int):
+        if block in self._lru:  # cached block comes back into use
+            del self._lru[block]
+        self._ref[block] = self._ref.get(block, 0) + 1
+
+    def _decref(self, block: int):
+        r = self._ref[block] - 1
+        if r < 0:
+            raise AssertionError(f"block {block}: refcount went negative")
+        if r:
+            self._ref[block] = r
+            return
+        del self._ref[block]
+        if block in self._meta:
+            # still carries indexed prefix content: park in the LRU (MRU end)
+            # rather than the free list so future prompts can hit it
+            self._lru[block] = None
+        else:
+            self._free.append(block)
+
+    def _unindex(self, block: int):
+        key, parent = self._meta.pop(block)
+        del self._index[key]
+        if parent != _ROOT:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(block)
+                if not kids:
+                    del self._children[parent]
+        self._children.pop(block, None)
+
+    def _evict_one(self) -> bool:
+        """Reclaim one unreferenced cached block into the free list.
+
+        Leaf-first among the LRU: evicting an interior block would leave its
+        indexed children keyed on a dead parent id. An unreferenced block's
+        descendants are all unreferenced too (a sequence holding a child holds
+        the whole chain), so every LRU subtree has its leaves in the LRU and
+        the scan below always finds one."""
+        for b in self._lru:  # oldest → newest
+            if not self._children.get(b):
+                self._unindex(b)
+                del self._lru[b]
+                self._free.append(b)
+                self.stats["evicted_blocks"] += 1
+                return True
+        if self._lru:  # unreachable unless an invariant broke; stay safe
+            raise AssertionError("prefix-cache LRU holds only interior blocks")
+        return False
+
+    def flush_cache(self):
+        """Force-evict every cached (unreferenced) block back to the free
+        pool — drops all prefix reuse state held beyond live sequences."""
+        while self._lru:
+            self._evict_one()
+
+    def _allocate(self, uid: int) -> int:
+        while not self._free:
+            if not self._evict_one():
+                raise RuntimeError(
+                    f"KV block pool exhausted (uid {uid}; "
+                    f"{self.num_blocks - 1} usable blocks)")
+        b = self._free.pop()
+        self._ref[b] = 1
+        return b
+
+    # ------------------------------------------------------------------
+    # allocation surface (pre-existing)
+    # ------------------------------------------------------------------
     def ensure(self, desc: SequenceDescriptor, n_tokens: int):
         """Grow ``desc.blocks`` to cover ``n_tokens`` logical positions."""
         need = self.blocks_needed(n_tokens)
@@ -60,11 +177,7 @@ class BlockedKVCache:
                 f"uid {desc.uid}: {n_tokens} tokens need {need} blocks > "
                 f"max {self.max_blocks_per_seq} per sequence")
         while len(desc.blocks) < need:
-            if not self._free:
-                raise RuntimeError(
-                    f"KV block pool exhausted (uid {desc.uid}; "
-                    f"{self.num_blocks - 1} usable blocks)")
-            desc.blocks.append(self._free.pop())
+            desc.blocks.append(self._allocate(desc.uid))
 
     def table_row(self, desc: SequenceDescriptor) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -72,8 +185,128 @@ class BlockedKVCache:
         return row
 
     def free(self, desc: SequenceDescriptor):
-        self._free.extend(desc.blocks)
+        for b in desc.blocks:
+            self._decref(b)
         desc.blocks = []
+        desc.history = []
+        desc.n_indexed = 0
+
+    # ------------------------------------------------------------------
+    # prefix cache: lookup / copy-on-write / registration
+    # ------------------------------------------------------------------
+    def lookup(self, desc: SequenceDescriptor, tokens: Sequence[int]) -> int:
+        """Map the longest fully-cached block chain of ``tokens`` into a
+        FRESH ``desc``; returns how many leading tokens of ``tokens`` are
+        thereby already in the KV cache (their prefill can be skipped).
+
+        Capped at ``len(tokens) - 1``: the engine must still run at least the
+        final prompt token to produce logits — a full-prompt hit therefore
+        leaves one token pending, whose write lands inside the last shared
+        block and triggers copy-on-write."""
+        if not self.prefix_cache:
+            return 0
+        if desc.blocks or desc.seen_tokens:
+            raise AssertionError(
+                f"uid {desc.uid}: prefix lookup on a non-fresh sequence")
+        self.stats["lookups"] += 1
+        bs = self.block_size
+        chain: List[int] = []
+        parent = _ROOT
+        while (len(chain) + 1) * bs <= min(
+                len(tokens), self.max_blocks_per_seq * bs):
+            key = (parent, tuple(int(t) for t in
+                                 tokens[len(chain) * bs:(len(chain) + 1) * bs]))
+            b = self._index.get(key)
+            if b is None:
+                break
+            chain.append(b)
+            parent = b
+        if not chain:
+            return 0
+        skipped = min(len(chain) * bs, len(tokens) - 1)
+        for b in chain:
+            self._incref(b)
+        desc.blocks = list(chain)
+        desc.n_indexed = len(chain)
+        self.stats["hits"] += 1
+        self.stats["hit_blocks"] += len(chain)
+        self.stats["skipped_prefill_tokens"] += skipped
+        return skipped
+
+    def copy_on_write(self, desc: SequenceDescriptor, j: int) -> Tuple[int, int]:
+        """Detach ``desc``'s shared block ``j`` before a write: allocate a
+        private block, hand back ``(src, dst)`` so the engine copies the KV
+        content on device, and repoint the descriptor. Never mutates ``src``
+        — other holders keep reading it."""
+        src = desc.blocks[j]
+        dst = self._allocate(desc.uid)  # src holds refs > 1 → cannot be evicted
+        self._decref(src)
+        desc.blocks[j] = dst
+        desc.n_indexed = min(desc.n_indexed, j)
+        self.stats["cow_copies"] += 1
+        return src, dst
+
+    def register(self, desc: SequenceDescriptor):
+        """Index every newly-filled full block of ``desc`` (chained on its
+        predecessor). If an identical block is already indexed, the duplicate
+        is deduplicated: ``desc`` adopts the canonical block and its own copy
+        returns to the free list — identical content, identical KV."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        n_full = desc.seen_tokens // bs
+        while desc.n_indexed < n_full:
+            j = desc.n_indexed
+            if len(desc.history) < (j + 1) * bs:
+                raise AssertionError(
+                    f"uid {desc.uid}: history shorter than cached tokens")
+            parent = desc.blocks[j - 1] if j else _ROOT
+            key = (parent, tuple(desc.history[j * bs:(j + 1) * bs]))
+            own = desc.blocks[j]
+            existing = self._index.get(key)
+            if existing is not None and existing != own:
+                self._incref(existing)
+                self._decref(own)  # own is unindexed → straight to free list
+                desc.blocks[j] = existing
+                self.stats["dedup_blocks"] += 1
+            elif existing is None:
+                self._index[key] = own
+                self._meta[own] = (key, parent)
+                if parent != _ROOT:
+                    self._children.setdefault(parent, set()).add(own)
+            desc.n_indexed = j + 1
+
+    # ------------------------------------------------------------------
+    # invariants (exercised by tests; cheap enough for debug asserts)
+    # ------------------------------------------------------------------
+    def check_invariants(self, descs: Iterable[SequenceDescriptor] = ()):
+        """Raise AssertionError if internal bookkeeping is inconsistent."""
+        assert all(r > 0 for r in self._ref.values()), "non-positive refcount"
+        free, lru, ref = set(self._free), set(self._lru), set(self._ref)
+        assert not (free & lru) and not (free & ref) and not (lru & ref), \
+            "block in more than one pool"
+        assert len(free) == len(self._free), "duplicate block in free list"
+        assert 0 not in free | lru | ref, "trash block 0 escaped reservation"
+        assert len(free | lru | ref) <= self.num_blocks - 1, "phantom block"
+        for key, b in self._index.items():
+            assert self._meta.get(b, (None,))[0] == key, "index/meta mismatch"
+            parent = key[0]
+            assert parent == _ROOT or parent in self._meta, \
+                "indexed block chained on an unindexed parent"
+        for b in self._meta:
+            assert b in ref or b in lru, "indexed block is in the free list"
+        for parent, kids in self._children.items():
+            for c in kids:
+                assert self._meta.get(c, (None, None))[1] == parent, \
+                    "children edge without matching meta parent"
+        descs = list(descs)
+        if descs:
+            counted: Dict[int, int] = {}
+            for d in descs:
+                for b in d.blocks:
+                    counted[b] = counted.get(b, 0) + 1
+            assert counted == self._ref, (
+                f"refcounts {self._ref} != descriptor holdings {counted}")
 
 
 class DSStateManager:

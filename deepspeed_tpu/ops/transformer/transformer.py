@@ -124,15 +124,31 @@ class DeepSpeedTransformerLayer:
             "norm_b": jnp.zeros((H,), dt),
         }
         if self._init_w is not None and self._init_b is not None:
-            # reference: seed from existing (e.g. HF BERT) weights — torch
-            # Linear weights are (out, in); ours are (in, out)
+            # reference: seed from existing (e.g. HF BERT) weights — the
+            # 8-tuple (q, k, v, attn_ow, attn_nw, inter_w, output_w, norm_w)
+            # plus matching biases. torch Linear weights are (out, in); ours
+            # are (in, out), so 2D entries transpose; norm vectors pass as-is.
+            # The reference zeroes attn_qkvb (HF fuses no qkv bias here).
+            if len(self._init_w) != 8 or len(self._init_b) != 8:
+                raise ValueError(
+                    "initial_weights/initial_biases must each have exactly 8 "
+                    "entries (q, k, v, attn_ow, attn_nw, inter_w, output_w, "
+                    f"norm_w); got {len(self._init_w)} weights / "
+                    f"{len(self._init_b)} biases")
             qw = jnp.concatenate([jnp.asarray(w).T for w in self._init_w[:3]],
                                  axis=1)
             p["qkvw"] = qw.astype(dt)
-            p["qkvb"] = jnp.concatenate(
-                [jnp.asarray(b) for b in self._init_b[:3]]).astype(dt)
+            p["qkvb"] = jnp.zeros((3 * H,), dt)
             p["attn_ow"] = jnp.asarray(self._init_w[3]).T.astype(dt)
             p["attn_ob"] = jnp.asarray(self._init_b[3]).astype(dt)
+            p["attn_nw"] = jnp.asarray(self._init_w[4]).astype(dt)
+            p["attn_nb"] = jnp.asarray(self._init_b[4]).astype(dt)
+            p["inter_w"] = jnp.asarray(self._init_w[5]).T.astype(dt)
+            p["inter_b"] = jnp.asarray(self._init_b[5]).astype(dt)
+            p["output_w"] = jnp.asarray(self._init_w[6]).T.astype(dt)
+            p["output_b"] = jnp.asarray(self._init_b[6]).astype(dt)
+            p["norm_w"] = jnp.asarray(self._init_w[7]).astype(dt)
+            p["norm_b"] = jnp.asarray(self._init_b[7]).astype(dt)
         return p
 
     # -- forward -----------------------------------------------------------

@@ -59,6 +59,24 @@ from .zero.partition import (
 )
 
 
+def _donate(*argnums):
+    """``donate_argnums`` kwargs for the train-step jits, version-gated.
+
+    Modern jax silently skips aliasing a donated input whose sharding differs
+    from the paired output's; jaxlib <= 0.4.x instead CRASHES at run time
+    ("Expected aliased input ... to have the same size") whenever a sharded
+    config changes a buffer's layout across the step. The mismatches are
+    config-dependent (ZeRO stages mix replicated and sharded buffers, qgZ /
+    1-bit comm re-shards even on a pure-data mesh, hpz/pipeline/TP re-lay-out
+    state), so no whitelist: old jax simply steps without donation —
+    correctness over the transient buffer saving. Old jax is detected by the
+    shard_map compat alias ``deepspeed_tpu/__init__`` installs (native
+    ``jax.shard_map`` carries no ``_dstpu_shim`` mark)."""
+    if getattr(jax.shard_map, "_dstpu_shim", False):
+        return {}
+    return {"donate_argnums": argnums}
+
+
 def _gather_to_host(tree):
     """Materialize every jax.Array as a host numpy array, collectively gathering
     shards that are not fully addressable from this process (multi-host save).
@@ -760,7 +778,8 @@ class DeepSpeedEngine:
         def acc(acc_grads, grads):
             return jax.tree.map(lambda a, g: a + g.astype(a.dtype), acc_grads, grads)
 
-        self._acc = jax.jit(acc, donate_argnums=(0,), out_shardings=self._grad_shardings)
+        self._acc = jax.jit(acc, **_donate(0),
+                            out_shardings=self._grad_shardings)
 
         opt = self.optimizer
         scaler = self.loss_scaler
@@ -791,7 +810,7 @@ class DeepSpeedEngine:
         if opt is not None:
             self._step_fn = jax.jit(
                 step_fn,
-                donate_argnums=(0, 1, 2, 3),
+                **_donate(0, 1, 2, 3),
                 out_shardings=(
                     self._param_shardings,
                     self._opt_shardings if mixed else None,
@@ -826,7 +845,7 @@ class DeepSpeedEngine:
         if opt is not None:
             self._fused_step_fn = jax.jit(
                 fused_step,
-                donate_argnums=(0, 1, 2),
+                **_donate(0, 1, 2),
                 out_shardings=(
                     self._param_shardings,
                     self._opt_shardings if mixed else None,
@@ -869,7 +888,7 @@ class DeepSpeedEngine:
 
             self._multi_step_fn = jax.jit(
                 multi_step,
-                donate_argnums=(0, 1, 2),
+                **_donate(0, 1, 2),
                 out_shardings=(
                     self._param_shardings,
                     self._opt_shardings if mixed else None,
@@ -1141,7 +1160,8 @@ class DeepSpeedEngine:
                     new_master, new_state = opt.update(g, state, master, lr)
                     return new_master, new_state.m, new_state.v
 
-                self._sub_step_fn = jax.jit(sub_step, donate_argnums=(0, 1, 2))
+                self._sub_step_fn = jax.jit(
+                    sub_step, **_donate(0, 1, 2))
             d = mgr["dev"]
             dev_out = self._sub_step_fn(
                 d["master"], d["m"], d["v"],
@@ -1229,10 +1249,19 @@ class DeepSpeedEngine:
                             if hasattr(a, "shape") and a.ndim >= 1),
                            default=0)
                 if full > seqlen:
-                    batch = type(batch)(
-                        a[..., :seqlen] if hasattr(a, "shape")
-                        and a.ndim >= 1 and a.shape[-1] == full else a
-                        for a in batch)
+                    elems = [a[..., :seqlen] if hasattr(a, "shape")
+                             and a.ndim >= 1 and a.shape[-1] == full else a
+                             for a in batch]
+                    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+                        # NamedTuple constructors take positional fields, not
+                        # an iterable — type(batch)(generator) would stuff the
+                        # whole generator into the first field (or raise)
+                        batch = type(batch)(*elems)
+                    else:
+                        try:
+                            batch = type(batch)(elems)
+                        except TypeError:  # exotic sequence subclass
+                            batch = tuple(elems)
             elif hasattr(batch, "shape") and batch.ndim >= 1 \
                     and batch.shape[-1] > seqlen:
                 batch = batch[..., :seqlen]

@@ -50,6 +50,10 @@ def range_pop(ann=None) -> None:
         # also drop anything pushed above it that was never popped (an
         # exception skipped those pops) so the stack cannot grow unboundedly
         del stack[stack.index(ann):]
+    else:
+        # not on this thread's stack: already popped, or pushed by another
+        # thread — closing it here would double-__exit__ the annotation
+        return
     ann.__exit__(None, None, None)
 
 

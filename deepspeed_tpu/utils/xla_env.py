@@ -2,6 +2,10 @@
 benches, and tests (everything that self-provisions a virtual CPU device mesh).
 """
 
+import os
+import re
+import subprocess
+import sys
 
 #: stability flags for the virtual CPU mesh on oversubscribed hosts:
 #: - the concurrency-optimized thunk scheduler reorders independent
@@ -27,6 +31,40 @@ def force_device_count_flags(flags: str, n: int) -> str:
     return (kept + f" --xla_force_host_platform_device_count={n}").strip()
 
 
+#: env marker so child processes (conftest re-exec, bench subprocesses)
+#: inherit an already-validated flag string instead of re-probing
+_VALIDATED_ENV = "_DSTPU_XLA_FLAGS_VALIDATED"
+
+
+def drop_unsupported_flags(flags: str) -> str:
+    """Drop XLA_FLAGS entries the linked jaxlib does not recognize.
+
+    XLA's env-flag parsing is FATAL on unknown flags (``parse_flags_from_env``
+    aborts the process), so a stability flag introduced after the installed
+    jaxlib was built would kill every backend init — the whole test suite dies
+    at the first ``jax.devices()``. Probe once in a throwaway subprocess and
+    strip exactly the flags it rejects; the result is cached in the
+    environment so re-execs and bench subprocesses skip the probe."""
+    if not flags:
+        return flags
+    if os.environ.get(_VALIDATED_ENV) == flags:
+        return flags
+    probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        env={**os.environ, "XLA_FLAGS": flags, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True)
+    if probe.returncode != 0:
+        m = re.search(r"Unknown flags in XLA_FLAGS: (.*)", probe.stderr)
+        if m:
+            bad = {f.split("=")[0] for f in m.group(1).split()}
+            flags = " ".join(f for f in flags.split()
+                             if f.split("=")[0] not in bad)
+        # any other failure mode is not flag parsing — let the caller hit it
+        # with full context rather than masking it here
+    os.environ[_VALIDATED_ENV] = flags
+    return flags
+
+
 def virtual_mesh_flags(flags: str, n: int) -> str:
     """Device-count flag plus the stability flags (deduplicated) — the one
     call every virtual-mesh entry point (conftest, gate, benches) should use."""
@@ -34,4 +72,4 @@ def virtual_mesh_flags(flags: str, n: int) -> str:
     for f in VIRTUAL_MESH_STABILITY_FLAGS:
         if f.split("=")[0] not in out:
             out += " " + f
-    return out
+    return drop_unsupported_flags(out)

@@ -89,6 +89,8 @@ _SMOKE = (
     "test_comm.py::TestTopology",
     "test_inference_v2.py::TestStateManager",
     "test_inference_v2.py::TestPagedKV::test_block_allocator_lifecycle",
+    "test_prefix_cache.py::TestBlockManagerInvariants",
+    "test_prefix_cache.py::test_shared_prefix_serve_smoke",
     "test_offload.py::TestSplit",
     "test_zero_init_utils.py",
     "test_aio.py",

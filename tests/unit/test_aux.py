@@ -193,7 +193,10 @@ class TestActivationCheckpointing:
         x = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
         g1 = jax.grad(f)(x)
         g2 = jax.grad(lambda x: ac.checkpoint(f, x))(x)
-        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+        # atol floor: XLA versions fuse tanh-grad slightly differently; the
+        # remat'd graph may differ from plain by one float32 ulp
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6,
+                                   atol=2e-7)
 
     def test_rng_tracker_fork(self):
         from deepspeed_tpu.runtime.activation_checkpointing import (

@@ -7,7 +7,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map  # jax >= 0.7 top-level export
+except ImportError:  # older jax: the function lives under experimental
+    from jax.experimental.shard_map import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.comm.comms_logging import calc_bw_log
@@ -135,7 +138,7 @@ def test_eager_all_reduce_replicated_product():
 def test_inprog_all_reduce_product():
     topo = initialize_topology(data=8)
     x = jnp.full((8,), 2.0)
-    f = jax.shard_map(
+    f = shard_map(
         lambda s: dist.inprog_all_reduce(s, "data", op="prod"),
         mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
     )

@@ -460,3 +460,13 @@ def test_legacy_curriculum_truncates_tuple_batches():
     assert out[0].shape == (2, 16)
     out2 = engine._inject_train_kwargs(ids)
     assert out2.shape == (2, 16)
+    # NamedTuple batches rebuild via positional fields — type(batch)(gen)
+    # would stuff the generator into the first field (or raise)
+    import collections
+
+    Batch = collections.namedtuple("Batch", ["input_ids", "labels", "meta"])
+    nt = Batch(input_ids=ids, labels=ids, meta="keep")
+    out3 = engine._inject_train_kwargs(nt)
+    assert isinstance(out3, Batch)
+    assert out3.input_ids.shape == (2, 16) and out3.labels.shape == (2, 16)
+    assert out3.meta == "keep"

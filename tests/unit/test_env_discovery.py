@@ -65,11 +65,25 @@ class TestMpiDiscovery:
         assert os.environ["COORDINATOR_ADDRESS"] == "10.0.0.5:12345"
 
     def test_pmi_env_fallback(self):
-        os.environ.update({"PMI_RANK": "2", "PMI_SIZE": "4"})
+        os.environ.update({"PMI_RANK": "2", "PMI_SIZE": "4",
+                           "MASTER_ADDR": "10.0.0.9"})
         mpi_discovery(verbose=False)
         assert os.environ["DSTPU_PROCESS_ID"] == "2"
         assert os.environ["DSTPU_NUM_PROCESSES"] == "4"
         assert os.environ["LOCAL_RANK"] == "0"
+
+    def test_multirank_without_master_addr_raises(self):
+        """No mpi4py hostname broadcast + world > 1 + no MASTER_ADDR: the old
+        loopback default made every node rendezvous with itself and hang —
+        now it raises with the fix spelled out."""
+        os.environ.update({"PMI_RANK": "2", "PMI_SIZE": "4"})
+        with pytest.raises(RuntimeError, match="MASTER_ADDR"):
+            mpi_discovery(verbose=False)
+
+    def test_single_rank_defaults_to_loopback(self):
+        os.environ.update({"PMI_RANK": "0", "PMI_SIZE": "1"})
+        mpi_discovery(distributed_port=23456, verbose=False)
+        assert os.environ["COORDINATOR_ADDRESS"] == "127.0.0.1:23456"
 
     def test_not_an_mpi_launch_raises(self):
         with pytest.raises(RuntimeError, match="not an MPI launch"):

@@ -132,8 +132,43 @@ class TestLayerMath:
         assert float(jnp.max(jnp.abs(g["qkvw"]))) > 0
 
     def test_seeded_weight_import(self):
-        """initial_weights/biases seed qkv+output projections from existing
-        (torch-layout) weights — the reference's HF-BERT injection path."""
+        """initial_weights/biases seed ALL layer params from existing
+        (torch-layout) weights — the reference's HF-BERT injection path
+        consumes the full 8-tuple and zeroes the fused qkv bias."""
+        cfg = _cfg()
+        rng = np.random.default_rng(4)
+        H, I = 64, cfg.intermediate_size
+        ws = [rng.standard_normal((H, H)).astype(np.float32) for _ in range(4)]
+        bs = [rng.standard_normal((H,)).astype(np.float32) for _ in range(4)]
+        # indices 4-7: attn_nw (H,), inter_w (I,H torch), output_w (H,I torch),
+        # norm_w (H,) + matching biases
+        ws += [rng.standard_normal((H,)).astype(np.float32),
+               rng.standard_normal((I, H)).astype(np.float32),
+               rng.standard_normal((H, I)).astype(np.float32),
+               rng.standard_normal((H,)).astype(np.float32)]
+        bs += [rng.standard_normal((H,)).astype(np.float32),
+               rng.standard_normal((I,)).astype(np.float32),
+               rng.standard_normal((H,)).astype(np.float32),
+               rng.standard_normal((H,)).astype(np.float32)]
+        layer = DeepSpeedTransformerLayer(cfg, initial_weights=ws,
+                                          initial_biases=bs)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(p["qkvw"][:, :H]), ws[0].T)
+        np.testing.assert_allclose(np.asarray(p["attn_ow"]), ws[3].T)
+        np.testing.assert_array_equal(np.asarray(p["qkvb"]),
+                                      np.zeros((3 * H,), np.float32))
+        np.testing.assert_allclose(np.asarray(p["attn_nw"]), ws[4])
+        np.testing.assert_allclose(np.asarray(p["attn_nb"]), bs[4])
+        np.testing.assert_allclose(np.asarray(p["inter_w"]), ws[5].T)
+        np.testing.assert_allclose(np.asarray(p["inter_b"]), bs[5])
+        np.testing.assert_allclose(np.asarray(p["output_w"]), ws[6].T)
+        np.testing.assert_allclose(np.asarray(p["output_b"]), bs[6])
+        np.testing.assert_allclose(np.asarray(p["norm_w"]), ws[7])
+        np.testing.assert_allclose(np.asarray(p["norm_b"]), bs[7])
+
+    def test_seeded_weight_import_wrong_length_raises(self):
+        """A partial tuple (the pre-reference 4-entry form) must raise rather
+        than silently leave layer norms and MLP weights random."""
         cfg = _cfg()
         rng = np.random.default_rng(4)
         H = 64
@@ -141,10 +176,8 @@ class TestLayerMath:
         bs = [rng.standard_normal((H,)).astype(np.float32) for _ in range(4)]
         layer = DeepSpeedTransformerLayer(cfg, initial_weights=ws,
                                           initial_biases=bs)
-        p = layer.init_params(jax.random.PRNGKey(0))
-        np.testing.assert_allclose(np.asarray(p["qkvw"][:, :H]), ws[0].T)
-        np.testing.assert_allclose(np.asarray(p["attn_ow"]), ws[3].T)
-        np.testing.assert_allclose(np.asarray(p["qkvb"][H:2 * H]), bs[1])
+        with pytest.raises(ValueError, match="exactly 8"):
+            layer.init_params(jax.random.PRNGKey(0))
 
     def test_dropout_train_vs_eval(self):
         cfg = _cfg(attn_dropout_ratio=0.5, hidden_dropout_ratio=0.5)
