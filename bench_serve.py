@@ -5,9 +5,10 @@ sustained mixed workload (Poisson arrivals, prompts + decodes interleaved),
 reporting effective throughput and per-token latency percentiles.
 
 Per run: requests arrive by a Poisson process; each brings a random-length
-prompt and decodes a random number of tokens (greedy). Finished sequences are
-flushed (eviction) and queued requests admitted when ``can_schedule`` says so
-(readmission). Two measurement phases per configuration:
+prompt and decodes a random number of tokens (greedy). The load is driven
+through ``deepspeed_tpu.serve.ContinuousBatchScheduler`` — the production
+admission/preemption/streaming path (docs/SERVING.md) — not a bench-private
+loop. Two measurement phases per configuration:
 
 - throughput: no per-step host sync — steps pipeline; tokens/s = all generated
   tokens / wall.
@@ -23,12 +24,15 @@ The ``shared_prefix`` rows bench block-level prefix caching
 the paged engine is run with the cache on and off (``prefix_cache=False``);
 hit-rate and skipped-prefill-token counters are reported per row along with
 the cache-on/cache-off speedup.
+
+The ``priority_mix`` row benches the scheduler itself: mixed priorities over
+a deliberately undersized block pool, reporting preemption and TTFT counters
+(every preempted request re-admits through the prefix cache).
 """
 
 import json
 import os
 import time
-from typing import Dict, List
 
 import numpy as np
 
@@ -42,12 +46,22 @@ install_transfer_guard()
 
 def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False,
-             shared_prefix=None):
-    """Drive the engine with Poisson arrivals until all requests finish.
+             shared_prefix=None, priorities=None):
+    """Drive the engine with Poisson arrivals until all requests finish —
+    through ``ContinuousBatchScheduler``, so the bench exercises the
+    production admit/preempt/decode path (docs/SERVING.md), not a private
+    loop. The scheduler's queue is a bounded ``collections.deque``; this
+    function is O(n) in requests where the old inline list/``pop(0)`` loop
+    was O(n²).
 
     ``shared_prefix``: token list prepended to EVERY prompt — the
-    system-prompt / few-shot serving shape the prefix cache targets."""
+    system-prompt / few-shot serving shape the prefix cache targets.
+    ``priorities``: optional per-request priority array (the priority-mix
+    workload); with an undersized block pool this exercises SLA preemption.
+    """
     import jax
+
+    from deepspeed_tpu.serve import ContinuousBatchScheduler
 
     vocab = engine.cfg.vocab_size
     base = list(shared_prefix) if shared_prefix else []
@@ -56,63 +70,42 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
                                    rng.integers(prompt_lo, prompt_hi + 1)).tolist()
                for _ in range(n_requests)]
     gen_targets = rng.integers(gen_lo, gen_hi + 1, n_requests)
+    prios = priorities if priorities is not None else np.zeros(n_requests, int)
 
-    queued: List[int] = list(range(n_requests))
-    live: Dict[int, int] = {}      # uid -> tokens still to generate
-    next_tok: Dict[int, int] = {}  # uid -> sampled token to feed next
-    generated = 0
-    step_lat: List[float] = []
-    step_sizes: List[int] = []
+    # scheduling clock = wall time since start plus a fast-forward offset:
+    # when nothing is live the clock jumps to the next arrival, so the run
+    # is not wall-clock-bound by the simulated arrival process
     t_start = time.perf_counter()
-    sim_clock = 0.0
+    offset = [0.0]
 
-    def admit():
-        while queued:
-            uid = queued[0]
-            if arrivals[uid] > sim_clock:
-                break
-            if not engine.can_schedule(1):
-                break
-            queued.pop(0)
-            lg = engine.put([uid], [prompts[uid]], greedy=engine.paged)
-            if uid in lg:
-                next_tok[uid] = int(lg[uid]) if engine.paged else int(np.argmax(lg[uid]))
-                live[uid] = int(gen_targets[uid])
+    def clock() -> float:
+        return time.perf_counter() - t_start + offset[0]
 
-    while queued or live:
-        sim_clock = time.perf_counter() - t_start
-        # admit everything whose arrival time has passed (plus fast-forward
-        # when idle so the run is not wall-clock-bound by the arrival process)
-        if not live and queued:
-            sim_clock = max(sim_clock, arrivals[queued[0]])
-        admit()
-        if not live:
-            continue
-        t0 = time.perf_counter()
-        toks = {uid: next_tok[uid] for uid in live}
-        greedy = engine.paged  # on-device argmax: ship tokens, not logit rows
-        lgs = engine.decode_step(toks, greedy=greedy)
-        if sync_each_step:
-            step_lat.append(time.perf_counter() - t0)
-            step_sizes.append(len(toks))
-        for uid, lg in lgs.items():
-            next_tok[uid] = int(lg) if greedy else int(np.argmax(lg))
-            generated += 1
-            live[uid] -= 1
-            if live[uid] <= 0:
-                del live[uid]
-                del next_tok[uid]
-                engine.flush(uid)
+    sched = ContinuousBatchScheduler(engine, max_queue=n_requests, clock=clock)
+    for i in range(n_requests):
+        sched.submit(prompts[i], max_new_tokens=int(gen_targets[i]),
+                     priority=int(prios[i]), arrival_time=float(arrivals[i]))
+    while sched.step():
+        if sched.live_count == 0 and sched.queue_depth:
+            nxt = sched.next_arrival()
+            if nxt is not None and nxt > clock():
+                offset[0] += nxt - clock()
     # drain async work before stopping the clock
     jax.block_until_ready(engine.kv)
     wall = time.perf_counter() - t_start
-    out = {"generated_tokens": int(generated), "wall_s": round(wall, 2),
-           "tokens_per_s": round(generated / wall, 1)}
-    if step_lat:
-        per_tok = np.array(step_lat)  # decode-step latency == per-token latency
-        out["p50_token_ms"] = round(float(np.percentile(per_tok, 50)) * 1000, 2)
-        out["p95_token_ms"] = round(float(np.percentile(per_tok, 95)) * 1000, 2)
-        out["mean_batch"] = round(float(np.mean(step_sizes)), 1)
+    m = sched.metrics.summary()
+    generated = int(m["tokens_generated"])
+    out = {"generated_tokens": generated, "wall_s": round(wall, 2),
+           "tokens_per_s": round(generated / wall, 1),
+           "ttft_p50_ms": m["ttft_p50_ms"], "ttft_p95_ms": m["ttft_p95_ms"],
+           "preemptions": int(m["preemptions"]),
+           "preempted_blocks_reclaimed": int(m["preempted_blocks_reclaimed"])}
+    if sync_each_step:
+        # decode-step latency == per-token latency (keys predate the
+        # scheduler; sourced from its per-step samples now)
+        out["p50_token_ms"] = m["token_lat_p50_ms"]
+        out["p95_token_ms"] = m["token_lat_p95_ms"]
+        out["mean_batch"] = m.get("mean_batch", 0.0)
     return out
 
 
@@ -137,6 +130,11 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       prompt (4 full 64-token blocks) plus a U[32,128] unique tail — the
       serving shape prefix caching targets. ``prefix_cache=False`` benches the
       same workload with the cache disabled (the comparison baseline).
+    - ``priority_mix``: the mixed prompt distribution with per-request
+      priorities in {0,1,2} and a deliberately undersized block pool, so the
+      scheduler must preempt low-priority requests for high-priority
+      arrivals and re-admit them through the prefix cache — the SLA serving
+      shape. Reported with preemption/TTFT counters.
     """
     import logging
 
@@ -159,14 +157,17 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
     params = model.init_params(jax.random.PRNGKey(0))
     rng = np.random.default_rng(7)
     shared = workload == "shared_prefix"
+    prio_mix = workload == "priority_mix"
     # paged value proposition: the pool is sized for the WORKLOAD, not
     # max_seqs×max_ctx. mixed: ≤320 tokens/seq = 5 blocks (3.2× less KV
     # memory than the slot layout at the same max_seqs). shared_prefix:
     # ≤256+128+64 = 448 tokens/seq = 7 blocks — sized for the CACHE-OFF
     # baseline so both cache settings run the same pool (with the cache on,
     # the shared blocks make the pool effectively deeper, not the other way
-    # around).
-    blocks_per_seq = 7 if shared else 5
+    # around). priority_mix: 2 blocks/seq is BELOW the ~3-block average
+    # demand — deliberate overcommit so the scheduler's preemption path
+    # carries the load.
+    blocks_per_seq = 7 if shared else (2 if prio_mix else 5)
     eng = InferenceEngineV2(
         model, params, max_seqs=max_seqs, max_seq_len=1024,
         prefill_chunk=256, dtype=jnp.bfloat16, paged=(mode == "paged"),
@@ -177,6 +178,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
     load_kw = dict(shared_prefix=prefix)
     if shared:
         load_kw.update(prompt_lo=32, prompt_hi=128)
+    if prio_mix:
+        load_kw.update(priorities=rng.integers(0, 3, n_req))
     # phase 1: pipelined throughput
     tput = run_load(eng, n_requests=n_req, arrival_rate=200.0, rng=rng,
                     **load_kw)
@@ -195,7 +198,10 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
             "workload": (
                 "Poisson arrivals, 256-tok shared system prompt + tails "
                 "U[32,128], gen U[16,64]" if shared else
-                "Poisson arrivals, prompts U[32,256], gen U[16,64]"),
+                ("Poisson arrivals, prompts U[32,256], gen U[16,64], "
+                 "priorities U{0,1,2}, pool overcommitted 2 blocks/seq"
+                 if prio_mix else
+                 "Poisson arrivals, prompts U[32,256], gen U[16,64]")),
             "prefix_cache": bool(prefix_cache and mode == "paged"),
             "throughput": tput, "latency": lat,
             "compiled_programs": (
@@ -220,6 +226,7 @@ CONFIGS = (
     ("slot", 32, "mixed", True),
     ("paged", 32, "shared_prefix", True),
     ("paged", 32, "shared_prefix", False),
+    ("paged", 32, "priority_mix", True),
 )
 
 

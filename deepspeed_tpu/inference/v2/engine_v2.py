@@ -84,6 +84,7 @@ class InferenceEngineV2:
 
         self.params = jax.tree_util.tree_map_with_path(cast, params)
         self.state = DSStateManager(max_seqs, self.max_seq_len)
+        self.flush_noops = 0  # idempotent-flush debug counter (see flush())
         self._prefill_fns = {}
         self._decode_fn = None
         self._cow_fn = None
@@ -469,9 +470,34 @@ class InferenceEngineV2:
                 for slot, uid in by_slot.items()}
 
     def flush(self, uid: int):
-        if self.paged and uid in self.state.seqs:
+        """Release a sequence's slot and (paged) KV blocks. Explicitly
+        idempotent: flushing an unknown uid is a counted no-op — scheduler
+        cancel/preempt/complete races must never double-free blocks (a
+        second ``block_mgr.free`` of the same descriptor would corrupt
+        refcounts)."""
+        if uid not in self.state.seqs:
+            self.flush_noops += 1
+            log_dist(f"flush({uid}): unknown uid (no-op #{self.flush_noops})",
+                     ranks=[0], level=10)  # DEBUG
+            return
+        if self.paged:
             self.block_mgr.free(self.state.seqs[uid])
         self.state.flush_sequence(uid)
+
+    def preempt(self, uid: int) -> int:
+        """Evict a live sequence under pool pressure, reclaiming its KV
+        blocks; returns how many blocks were held (scheduler metrics). With
+        the prefix cache on, the victim's full blocks stay indexed (parked
+        in the LRU by ``free``), so a re-admitted victim replaying its
+        prompt + generated tokens maps them straight back — preemption cost
+        is one tail re-prefill, not the whole prompt."""
+        freed = self._blocks_held(uid)
+        self.flush(uid)
+        return freed
+
+    def _blocks_held(self, uid: int) -> int:
+        desc = self.state.seqs.get(uid)
+        return len(desc.blocks) if (desc is not None and self.paged) else 0
 
     # reference ``query``/``can_schedule`` surface
     def query(self) -> Tuple[int, int]:

@@ -6,6 +6,7 @@ are ``(label, value, step)``.
 """
 
 import os
+import re
 from typing import List, Optional, Tuple
 
 import jax
@@ -14,6 +15,10 @@ from ..utils.logging import logger
 
 Event = Tuple[str, float, int]
 
+#: filename-safe label charset; anything else becomes ``_`` (labels such as
+#: ``serve/ttft_p50_ms`` or ones carrying ``:``/spaces must map to sane files)
+_UNSAFE_LABEL_CHARS = re.compile(r"[^A-Za-z0-9._-]")
+
 
 class Monitor:
     def __init__(self, config):
@@ -21,6 +26,9 @@ class Monitor:
 
     def write_events(self, events: List[Event]):
         raise NotImplementedError
+
+    def close(self):
+        """Release sink resources (open files, writers); idempotent."""
 
     # -- optional richer surfaces (reference TB/WandB depth) ---------------
     def write_scalars(self, scalars, step: int):
@@ -58,7 +66,7 @@ class csvMonitor(Monitor):
         if label not in self._files:
             d = os.path.join(self.output_path, self.job_name)
             os.makedirs(d, exist_ok=True)
-            safe = label.replace("/", "_")
+            safe = _UNSAFE_LABEL_CHARS.sub("_", label)
             f = open(os.path.join(d, f"{safe}.csv"), "a")
             self._files[label] = f
         return self._files[label]
@@ -70,6 +78,14 @@ class csvMonitor(Monitor):
             f = self._file(label)
             f.write(f"{step},{float(value)}\n")
             f.flush()
+
+    def close(self):
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files = {}
 
 
 class TensorBoardMonitor(Monitor):
@@ -101,6 +117,11 @@ class TensorBoardMonitor(Monitor):
 
         self.writer.add_histogram(label, _np.asarray(values), step)
         self.writer.flush()
+
+    def close(self):
+        if self.writer is not None:
+            self.writer.close()
+            self.writer = None
 
 
 class WandbMonitor(Monitor):
@@ -136,6 +157,15 @@ class WandbMonitor(Monitor):
 
         self._wandb.log({label: self._wandb.Histogram(_np.asarray(values))},
                         step=step)
+
+    def close(self):
+        if getattr(self, "_wandb", None) is not None:
+            try:
+                self._wandb.finish()
+            except Exception:  # pragma: no cover - wandb teardown is noisy
+                pass
+            self._wandb = None
+            self.enabled = False
 
 
 class MonitorMaster(Monitor):
@@ -182,6 +212,12 @@ class MonitorMaster(Monitor):
 
     def write_histogram(self, label: str, values, step: int):
         self._fan_out("write_histogram", label, values, step)
+
+    def close(self):
+        """Close every sink (open CSV files, TB writer, wandb run) — serving
+        drains call this next to ``ContinuousBatchScheduler.close``."""
+        for m in (self.csv_monitor, self.tb_monitor, self.wandb_monitor):
+            m.close()
 
 
 class _Empty:

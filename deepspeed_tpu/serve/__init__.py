@@ -1,0 +1,11 @@
+"""``deepspeed_tpu.serve`` — production serving layer over the v2 engine.
+
+Request lifecycle, SLA-aware continuous-batching scheduler (admission,
+preemption, streaming, graceful drain), and the serving metrics surface.
+See ``docs/SERVING.md``.
+"""
+
+from .metrics import ServeMetrics  # noqa: F401
+from .request import Request, RequestState  # noqa: F401
+from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
+                        SchedulerClosedError)

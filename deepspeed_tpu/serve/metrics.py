@@ -1,0 +1,79 @@
+"""Serving metrics surface.
+
+Counters and latency distributions the scheduler maintains per step, exported
+as ``(label, value, step)`` events under the ``serve/`` prefix so they fan
+into ``deepspeed_tpu.monitor.MonitorMaster.write_events`` alongside the
+engine's ``inference/prefix_cache/*`` counters — one dashboard for the whole
+serving path.
+
+Decode-step latencies are wall-clock (``time.perf_counter``) even when the
+scheduler runs on a virtual clock; TTFT is ``first_token - arrival`` in the
+scheduler's clock domain, so simulated arrival processes report meaningful
+queueing delay.
+"""
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+Event = Tuple[str, float, int]
+
+
+class ServeMetrics:
+    """Aggregated serving counters + latency samples."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.preemptions = 0
+        self.preempted_blocks_reclaimed = 0
+        self.admission_rejects = 0   # bounded-queue backpressure
+        self.deadline_cancels = 0    # expired while QUEUED
+        self.tokens_generated = 0
+        self.queue_depth = 0         # gauge, refreshed each step
+        self.live = 0                # gauge, refreshed each step
+        self.queue_peak = 0
+        self.ttft_s: List[float] = []        # admission-arrival -> first token
+        self.step_lat_s: List[float] = []    # decode-step wall time
+        self.step_batch: List[int] = []      # decode-step batch size
+
+    def observe_step(self, latency_s: float, batch: int) -> None:
+        self.step_lat_s.append(latency_s)
+        self.step_batch.append(batch)
+
+    def observe_gauges(self, queue_depth: int, live: int) -> None:
+        self.queue_depth = queue_depth
+        self.live = live
+        self.queue_peak = max(self.queue_peak, queue_depth)
+
+    @staticmethod
+    def _pct(samples: List[float], q: float) -> float:
+        return float(np.percentile(np.asarray(samples), q)) if samples else 0.0
+
+    def summary(self) -> Dict[str, float]:
+        """Flat counter/percentile dict (the bench row + event payload)."""
+        s = {
+            "submitted": self.submitted, "admitted": self.admitted,
+            "completed": self.completed, "cancelled": self.cancelled,
+            "preemptions": self.preemptions,
+            "preempted_blocks_reclaimed": self.preempted_blocks_reclaimed,
+            "admission_rejects": self.admission_rejects,
+            "deadline_cancels": self.deadline_cancels,
+            "tokens_generated": self.tokens_generated,
+            "queue_depth": self.queue_depth, "live": self.live,
+            "queue_peak": self.queue_peak,
+            "ttft_p50_ms": round(self._pct(self.ttft_s, 50) * 1000, 2),
+            "ttft_p95_ms": round(self._pct(self.ttft_s, 95) * 1000, 2),
+            "token_lat_p50_ms": round(self._pct(self.step_lat_s, 50) * 1000, 2),
+            "token_lat_p95_ms": round(self._pct(self.step_lat_s, 95) * 1000, 2),
+        }
+        if self.step_batch:
+            s["mean_batch"] = round(float(np.mean(self.step_batch)), 1)
+        return s
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``(label, value, step)`` tuples for ``MonitorMaster.write_events``."""
+        return [(f"serve/{k}", float(v), step)
+                for k, v in sorted(self.summary().items())]

@@ -52,6 +52,33 @@ class TestMonitorSinks:
         assert (tmp_path / "j" / "A_x.csv").exists()
         assert (tmp_path / "j" / "B_y.csv").exists()
 
+    def test_csv_label_sanitization_and_close(self, tmp_path):
+        """Labels with any non-[A-Za-z0-9._-] char (``:``, space, ``/``) must
+        map to safe filenames, and ``close()`` (fanned out from
+        ``MonitorMaster``) must release every open file handle."""
+        from deepspeed_tpu.monitor.monitor import MonitorMaster, csvMonitor
+        from deepspeed_tpu.runtime.config import MonitorSinkConfig
+
+        cfg = MonitorSinkConfig.from_dict(
+            {"enabled": True, "output_path": str(tmp_path), "job_name": "j"})
+        mon = csvMonitor(cfg)
+        mon.write_events([("serve/ttft p50:ms", 1.0, 0),
+                          ("inference/prefix_cache/hit_rate", 0.5, 0)])
+        assert (tmp_path / "j" / "serve_ttft_p50_ms.csv").exists()
+        assert (tmp_path / "j" / "inference_prefix_cache_hit_rate.csv").exists()
+        handles = list(mon._files.values())
+        assert handles and not any(f.closed for f in handles)
+        mon.close()
+        assert all(f.closed for f in handles) and not mon._files
+        mon.close()  # idempotent
+        # master fan-out closes every sink
+        master = MonitorMaster({"csv_monitor": cfg})
+        master.write_events([("x:y z", 2.0, 1)])
+        fh = list(master.csv_monitor._files.values())
+        master.close()
+        assert all(f.closed for f in fh)
+        assert (tmp_path / "j" / "x_y_z.csv").exists()
+
 
 class TestFlopsProfiler:
     def test_analyze_fn_counts_matmul_flops(self):

@@ -1,0 +1,288 @@
+"""``deepspeed_tpu.serve`` scheduler tests (docs/SERVING.md): request
+lifecycle + streaming, SLA admission (priority-plus-age, deadlines,
+backpressure), preemption under block-pool pressure with bitwise-lossless
+re-admission through the prefix cache, graceful drain, the fixed-shape
+regression bound under preemption-heavy load, and the engine's idempotent
+``flush`` hook."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, QueueFullError,
+                                 RequestState, SchedulerClosedError)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _run_solo(m, params, prompt, max_new_tokens):
+    """Uncontended reference: one request, ample pool, greedy tokens."""
+    eng = _engine(m, params, num_blocks=64)
+    sched = ContinuousBatchScheduler(eng)
+    req = sched.submit(prompt, max_new_tokens=max_new_tokens)
+    sched.run_until_complete()
+    assert req.state is RequestState.DONE
+    return list(req.tokens)
+
+
+class TestLifecycleAndStreaming:
+    def test_smoke_submit_stream_drain(self, setup):
+        """Tier-1 smoke: two requests end-to-end — callback streaming, pull
+        streaming, lifecycle states, metrics, and the monitor fan-in."""
+        m, params = setup
+        eng = _engine(m, params)
+        rng = np.random.default_rng(0)
+        seen = []
+        with ContinuousBatchScheduler(eng) as sched:
+            r1 = sched.submit(rng.integers(0, 128, 20).tolist(),
+                              max_new_tokens=6,
+                              on_token=lambda r, t: seen.append((r.uid, t)))
+            r2 = sched.submit(rng.integers(0, 128, 12).tolist(),
+                              max_new_tokens=4, priority=1)
+            streamed = list(sched.stream(r1))
+        assert r1.state is RequestState.DONE and r2.state is RequestState.DONE
+        assert len(r1.tokens) == 6 and len(r2.tokens) == 4
+        assert streamed == r1.tokens
+        assert [t for (u, t) in seen if u == r1.uid] == r1.tokens
+        assert r1.first_token_time is not None
+        assert not eng.state.seqs  # drained: no live sequences
+        s = sched.metrics.summary()
+        assert s["completed"] == 2 and s["tokens_generated"] == 10
+        events = sched.monitor_events(step=3)
+        labels = {e[0] for e in events}
+        assert "serve/preemptions" in labels and "serve/ttft_p50_ms" in labels
+        assert "inference/prefix_cache/hit_rate" in labels  # engine fan-in
+        assert all(isinstance(v, float) and st == 3 for _, v, st in events)
+        from deepspeed_tpu.monitor import MonitorMaster
+
+        mm = MonitorMaster({})
+        mm.write_events(events)  # all sinks disabled: no-op
+        mm.close()
+
+    def test_backpressure_and_submit_validation(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng, max_queue=2)
+        sched.submit([1, 2, 3], arrival_time=99.0)
+        sched.submit([4, 5], arrival_time=99.0)
+        with pytest.raises(QueueFullError):
+            sched.submit([6, 7], arrival_time=99.0)
+        assert sched.metrics.admission_rejects == 1
+        with pytest.raises(ValueError):  # prompt + gen must fit the context
+            sched.submit([1] * 100, max_new_tokens=100)
+        with pytest.raises(ValueError):
+            sched.submit([])
+
+    def test_deadline_expiry_and_cancel(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        vt = [0.0]
+        sched = ContinuousBatchScheduler(eng, clock=lambda: vt[0])
+        # deadline passes while QUEUED (arrival in the future blocks admission)
+        dead = sched.submit([1, 2, 3], deadline=1.0, arrival_time=5.0)
+        live = sched.submit([4, 5, 6], max_new_tokens=2)
+        vt[0] = 2.0
+        sched.step()
+        assert dead.state is RequestState.CANCELLED
+        assert dead.cancel_reason == "deadline"
+        assert sched.metrics.deadline_cancels == 1
+        assert live.state in (RequestState.DECODE, RequestState.DONE)
+        assert sched.cancel(live.uid) is (not live.finished)
+        assert not eng.state.seqs
+        sched.run_until_complete()
+
+
+class TestPreemption:
+    def test_preempt_readmit_bitwise_and_cache_replay(self, setup):
+        """The acceptance scenario: an undersized pool forces the scheduler
+        to preempt a low-priority request for a high-priority arrival; the
+        victim re-admits through the prefix cache (its surviving full blocks
+        map straight back) and BOTH requests finish with greedy tokens
+        bitwise-identical to uncontended runs."""
+        m, params = setup
+        rng = np.random.default_rng(1)
+        pA = rng.integers(0, 128, 48).tolist()
+        pB = rng.integers(0, 128, 48).tolist()
+        refA = _run_solo(m, params, pA, 24)
+        refB = _run_solo(m, params, pB, 8)
+        # 6 usable blocks; A peaks at 5, B at 4 — they cannot coexist
+        eng = _engine(m, params, num_blocks=7)
+        sched = ContinuousBatchScheduler(eng)
+        rA = sched.submit(pA, max_new_tokens=24, priority=0)
+        for _ in range(4):
+            sched.step()
+        rB = sched.submit(pB, max_new_tokens=8, priority=5)
+        sched.run_until_complete()
+        assert rA.state is RequestState.DONE and rB.state is RequestState.DONE
+        assert sched.metrics.preemptions > 0 and rA.preemptions > 0
+        assert sched.metrics.preempted_blocks_reclaimed > 0
+        assert rA.tokens == refA and rB.tokens == refB  # bitwise, greedy
+        stats = eng.prefix_cache_stats()
+        assert stats["hits"] > 0  # re-admission replayed cached blocks
+        assert stats["skipped_prefill_tokens"] > 0
+        assert not eng.state.seqs
+        eng.block_mgr.check_invariants([])
+
+    def test_trace_bound_under_preemption_heavy_load(self, setup):
+        """REGRESSION: preemption/re-admission churn is host-side bookkeeping
+        and must add ZERO compiled ragged programs (``ragged_cache_size <=
+        4``; this all-greedy load stays <= 2)."""
+        m, params = setup
+        rng = np.random.default_rng(2)
+        eng = _engine(m, params, num_blocks=11, token_budget=32)
+        sched = ContinuousBatchScheduler(eng)
+        reqs = []
+        for i in range(8):
+            reqs.append(sched.submit(
+                rng.integers(0, 128, int(rng.integers(8, 40))).tolist(),
+                max_new_tokens=int(rng.integers(4, 12)),
+                priority=int(rng.integers(0, 3))))
+            sched.step()
+        sched.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert sched.metrics.preemptions > 0  # the pool really was tight
+        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert not eng.state.seqs
+        eng.block_mgr.check_invariants([])
+
+
+class TestAdmissionPolicy:
+    def test_aged_low_priority_is_not_starved(self, setup):
+        """Priority-plus-age admission: a steady stream of later-arriving
+        high-priority requests cannot starve an old low-priority one — once
+        ``age_weight * age_gap`` exceeds the priority gap, the old request
+        wins the admission race."""
+        m, params = setup
+        eng = _engine(m, params, max_seqs=1)
+        vt = [0.0]
+        sched = ContinuousBatchScheduler(eng, age_weight=1.0,
+                                         clock=lambda: vt[0])
+        rng = np.random.default_rng(3)
+        low = sched.submit(rng.integers(0, 128, 8).tolist(), priority=0,
+                           max_new_tokens=2, arrival_time=0.0)
+        highs = [sched.submit(rng.integers(0, 128, 8).tolist(), priority=3,
+                              max_new_tokens=2,
+                              arrival_time=0.0 if i == 0 else i - 0.5)
+                 for i in range(6)]
+        # one admission+completion per step (max_seqs=1, 2 tokens each)
+        for t in range(10):
+            vt[0] = float(t)
+            sched.step()
+        sched.run_until_complete()
+        assert low.state is RequestState.DONE
+        # low (score t) overtakes the high arriving at 3.5 (score 3 + t-3.5)
+        # at t=4: highs 0..3 go first, low beats highs 4 and 5
+        assert low.admitted_time == 4.0
+        later = [h for h in highs if h.admitted_time > low.admitted_time]
+        assert len(later) == 2
+
+
+class TestDrain:
+    def test_close_finishes_live_rejects_queued(self, setup):
+        m, params = setup
+        eng = _engine(m, params, max_seqs=1)
+        sched = ContinuousBatchScheduler(eng)
+        rng = np.random.default_rng(4)
+        live = sched.submit(rng.integers(0, 128, 10).tolist(), max_new_tokens=8)
+        queued = [sched.submit(rng.integers(0, 128, 10).tolist())
+                  for _ in range(2)]
+        sched.step()  # admit `live` only (max_seqs=1)
+        assert live.state is RequestState.DECODE
+        sched.close()
+        assert live.state is RequestState.DONE and len(live.tokens) == 8
+        assert all(q.state is RequestState.CANCELLED and
+                   q.cancel_reason == "drain" for q in queued)
+        assert not eng.state.seqs  # drain leaves no live sequences
+        with pytest.raises(SchedulerClosedError):
+            sched.submit([1, 2])
+        sched.close()  # idempotent
+
+    def test_close_finishes_preempted_requests(self, setup):
+        """A preempted request waiting in the queue for re-admission was
+        STARTED — drain must finish it, not reject it."""
+        m, params = setup
+        eng = _engine(m, params, num_blocks=7)
+        sched = ContinuousBatchScheduler(eng)
+        rng = np.random.default_rng(5)
+        a = sched.submit(rng.integers(0, 128, 48).tolist(),
+                         max_new_tokens=20, priority=0)
+        for _ in range(3):
+            sched.step()
+        b = sched.submit(rng.integers(0, 128, 48).tolist(),
+                         max_new_tokens=6, priority=5)
+        sched.step()  # B's prefill evicts A under pool pressure
+        sched.close()
+        assert sched.metrics.preemptions > 0 and a.preemptions > 0
+        assert a.state is RequestState.DONE and len(a.tokens) == 20
+        assert b.state is RequestState.DONE and len(b.tokens) == 6
+        assert not eng.state.seqs
+        eng.block_mgr.check_invariants([])
+
+
+class TestEngineHooks:
+    def test_double_flush_is_idempotent_no_double_free(self, setup):
+        """Scheduler cancel/preempt races flush twice; the second must be a
+        counted no-op, never a double-free of KV blocks."""
+        m, params = setup
+        eng = _engine(m, params)
+        eng.put([1], [[5, 6, 7, 8, 9]], greedy=True)
+        held = list(eng.state.seqs[1].blocks)
+        assert held
+        eng.flush(1)
+        assert eng.flush_noops == 0
+        eng.flush(1)  # double flush: no-op + debug counter
+        assert eng.flush_noops == 1
+        eng.flush(2)  # never-admitted uid: same discipline
+        assert eng.flush_noops == 2
+        eng.block_mgr.check_invariants([])
+        assert all(eng.block_mgr.refcount(b) == 0 for b in held)
+        assert eng.preempt(3) == 0  # unknown uid preempt: 0 blocks, no raise
+        assert eng.flush_noops == 3
+
+
+@pytest.mark.slow
+def test_priority_mix_load_mirrors_bench():
+    """Bench-derived (slow): the priority-mix workload from bench_serve.py on
+    a tiny model — overcommitted pool, mixed priorities, Poisson arrivals.
+    Every request must finish, preemption must actually occur, and the
+    fixed-shape bound must hold."""
+    import bench_serve
+
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=256)
+    params = m.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(11)
+    eng = InferenceEngineV2(m, params, paged=True, max_seqs=8, max_seq_len=256,
+                            prefill_chunk=32, block_size=16, token_budget=32,
+                            num_blocks=1 + 8 * 2)  # ~2 blocks/seq: overcommit
+    out = bench_serve.run_load(
+        eng, n_requests=24, arrival_rate=500.0,
+        rng=np.random.default_rng(12), prompt_lo=16, prompt_hi=40,
+        gen_lo=4, gen_hi=8, sync_each_step=True,
+        priorities=rng.integers(0, 3, 24))
+    assert out["preemptions"] > 0
+    assert out["generated_tokens"] > 0 and out["p50_token_ms"] >= 0
+    assert out["ttft_p95_ms"] >= out["ttft_p50_ms"] >= 0
+    assert eng.ragged_cache_size <= 4
+    assert not eng.state.seqs
+    eng.block_mgr.check_invariants([])
