@@ -46,7 +46,9 @@ install_transfer_guard()
 
 def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False,
-             shared_prefix=None, priorities=None):
+             shared_prefix=None, priorities=None, fault_injector=None,
+             breaker=None, retry=None, watchdog=None, on_submitted=None,
+             collect_tokens=False):
     """Drive the engine with Poisson arrivals until all requests finish —
     through ``ContinuousBatchScheduler``, so the bench exercises the
     production admit/preempt/decode path (docs/SERVING.md), not a private
@@ -58,6 +60,12 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     system-prompt / few-shot serving shape the prefix cache targets.
     ``priorities``: optional per-request priority array (the priority-mix
     workload); with an undersized block pool this exercises SLA preemption.
+    ``fault_injector`` / ``breaker`` / ``retry`` / ``watchdog``: resilience
+    layer for the chaos workload (docs/RESILIENCE.md) — the injector wraps
+    the engine, the rest parameterize the scheduler. ``on_submitted(sched,
+    reqs)`` runs after all submits (uid-dependent fault specs install here).
+    ``collect_tokens`` returns per-request token streams for bitwise
+    fault-free-vs-faulted comparison.
     """
     import jax
 
@@ -81,10 +89,18 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     def clock() -> float:
         return time.perf_counter() - t_start + offset[0]
 
-    sched = ContinuousBatchScheduler(engine, max_queue=n_requests, clock=clock)
+    driven = engine if fault_injector is None else fault_injector.wrap(engine)
+    kw = {k: v for k, v in (("breaker", breaker), ("retry", retry),
+                            ("watchdog", watchdog)) if v is not None}
+    sched = ContinuousBatchScheduler(driven, max_queue=n_requests,
+                                     clock=clock, **kw)
+    reqs = []
     for i in range(n_requests):
-        sched.submit(prompts[i], max_new_tokens=int(gen_targets[i]),
-                     priority=int(prios[i]), arrival_time=float(arrivals[i]))
+        reqs.append(sched.submit(
+            prompts[i], max_new_tokens=int(gen_targets[i]),
+            priority=int(prios[i]), arrival_time=float(arrivals[i])))
+    if on_submitted is not None:
+        on_submitted(sched, reqs)
     while sched.step():
         if sched.live_count == 0 and sched.queue_depth:
             nxt = sched.next_arrival()
@@ -106,7 +122,75 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
         out["p50_token_ms"] = m["token_lat_p50_ms"]
         out["p95_token_ms"] = m["token_lat_p95_ms"]
         out["mean_batch"] = m.get("mean_batch", 0.0)
+    if fault_injector is not None:
+        out["failed_requests"] = int(m["failed"])
+        out["faults"] = {k: float(v) for k, v in sched.metrics.faults.items()}
+        out["injected"] = dict(fault_injector.fired)
+        out["breaker_transitions"] = [s for _, s in sched.breaker.transitions]
+    if collect_tokens:
+        out["request_tokens"] = [list(r.tokens) for r in reqs]
+        out["request_states"] = [r.state.value for r in reqs]
     return out
+
+
+def run_chaos(eng, n_req: int) -> dict:
+    """The fault-injection workload (docs/RESILIENCE.md): one fault-free
+    reference pass, then the SAME workload under a seeded fault plan —
+    transient put/decode bursts (enough consecutive failures to open the
+    circuit breaker), one latency spike, and one persistent per-request
+    fault. Reports goodput degradation, breaker recovery
+    (open -> half_open -> closed), and bitwise token integrity: every
+    non-failed request must produce exactly the fault-free tokens (greedy) —
+    faults may slow the fleet down, never corrupt or duplicate output."""
+    from deepspeed_tpu.resilience import (CircuitBreaker, FaultInjector,
+                                          RetryPolicy, StepWatchdog)
+
+    def fresh_rng():
+        return np.random.default_rng(21)
+
+    base = run_load(eng, n_requests=n_req, arrival_rate=200.0,
+                    rng=fresh_rng(), collect_tokens=True)
+    for uid in list(eng.state.seqs):
+        eng.flush(uid)
+    injector = FaultInjector(seed=13)
+    injector.inject(site="put", kind="transient", nth=3, count=2)
+    injector.inject(site="decode_step", kind="transient", nth=10, count=3)
+    injector.inject(site="decode_step", kind="latency", nth=25,
+                    latency_s=0.02)
+    culpable_idx = n_req // 4
+
+    def arm_persistent(sched, reqs):
+        injector.inject(site="decode_step", kind="persistent",
+                        uid=reqs[culpable_idx].uid)
+
+    faulted = run_load(
+        eng, n_requests=n_req, arrival_rate=200.0, rng=fresh_rng(),
+        collect_tokens=True, fault_injector=injector,
+        breaker=CircuitBreaker(failure_threshold=3, cooldown_s=0.5,
+                               shed_priority_floor=1),
+        retry=RetryPolicy(max_attempts=5, base_s=0.005, cap_s=0.05, seed=7),
+        watchdog=StepWatchdog(), on_submitted=arm_persistent)
+    ref_toks = base.pop("request_tokens")
+    base.pop("request_states")
+    toks = faulted.pop("request_tokens")
+    states = faulted.pop("request_states")
+    bitwise = all(states[i] != "done" or toks[i] == ref_toks[i]
+                  for i in range(n_req))
+    trans = faulted["breaker_transitions"]
+    recovered = False  # open -> half_open -> closed observed, in order
+    for j in range(len(trans) - 2):
+        if trans[j:j + 3] == ["open", "half_open", "closed"]:
+            recovered = True
+    return {
+        "fault_free": base, "faulted": faulted,
+        "failed_requests": faulted["failed_requests"],
+        "failed_index": culpable_idx,
+        "tokens_bitwise_identical": bitwise,
+        "breaker_recovered": recovered,
+        "goodput_ratio": round(
+            faulted["tokens_per_s"] / base["tokens_per_s"], 3)
+        if base["tokens_per_s"] else None,
+    }
 
 
 def _metric_name(mode: str, max_seqs: int, workload: str,
@@ -135,6 +219,11 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       scheduler must preempt low-priority requests for high-priority
       arrivals and re-admit them through the prefix cache — the SLA serving
       shape. Reported with preemption/TTFT counters.
+    - ``chaos`` (``--faults``): the mixed workload under a seeded fault plan
+      (transient bursts, a latency spike, one persistent per-request fault)
+      vs its own fault-free reference — goodput must degrade gracefully, the
+      breaker must recover, and no token may be lost or duplicated
+      (docs/RESILIENCE.md).
     """
     import logging
 
@@ -174,6 +263,26 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         block_size=64, token_budget=256 if mode == "paged" else 0,
         num_blocks=(1 + max_seqs * blocks_per_seq) if mode == "paged" else None,
         prefix_cache=prefix_cache)
+    if workload == "chaos":
+        chaos = run_chaos(eng, n_req)
+        row = {
+            "metric": _metric_name(mode, max_seqs, workload, prefix_cache),
+            "value": chaos["faulted"]["tokens_per_s"], "unit": "tokens/s",
+            "vs_baseline": chaos["goodput_ratio"],
+            "detail": {
+                "mode": mode, "max_seqs": max_seqs, "model": (
+                    f"gpt2-{size} bf16" + (f" {overrides}" if overrides
+                                           else "")),
+                "workload": ("Poisson arrivals, prompts U[32,256], gen "
+                             "U[16,64], seeded fault plan: transient "
+                             "put/decode bursts + latency spike + one "
+                             "persistent per-request fault"),
+                "chaos": chaos,
+                "compiled_programs": eng.ragged_cache_size,
+            },
+        }
+        assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
+        return row
     prefix = (rng.integers(0, cfg.vocab_size, 256).tolist() if shared else None)
     load_kw = dict(shared_prefix=prefix)
     if shared:
@@ -230,16 +339,17 @@ CONFIGS = (
 )
 
 
-def main():
+def main(faults: bool = False):
     # one subprocess per configuration: device-memory frees are asynchronous
     # through remote-device transports, so sequential engines in ONE process
     # can OOM on buffers that are already logically freed
     import subprocess
     import sys
 
+    configs = CONFIGS + ((("paged", 32, "chaos", True),) if faults else ())
     results = []
     rows = {}
-    for mode, max_seqs, workload, cache in CONFIGS:
+    for mode, max_seqs, workload, cache in configs:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode, str(max_seqs),
              workload, str(int(cache))],
@@ -270,10 +380,13 @@ def main():
 if __name__ == "__main__":
     import sys
 
-    if len(sys.argv) >= 3:
+    argv = [a for a in sys.argv[1:] if a != "--faults"]
+    if len(argv) >= 2:
         print(json.dumps(run_config(
-            sys.argv[1], int(sys.argv[2]),
-            sys.argv[3] if len(sys.argv) > 3 else "mixed",
-            bool(int(sys.argv[4])) if len(sys.argv) > 4 else True)))
+            argv[0], int(argv[1]),
+            argv[2] if len(argv) > 2 else "mixed",
+            bool(int(argv[3])) if len(argv) > 3 else True)))
     else:
-        main()
+        # --faults appends the chaos (fault-injection) row to the standard
+        # suite; baseline rows must stay within noise of a fault-free run
+        main(faults="--faults" in sys.argv)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...resilience.errors import ContextOverflowError
 from ...utils.logging import log_dist
 from ..config import DeepSpeedInferenceConfig
 from .ragged_manager import DSStateManager
@@ -272,9 +273,10 @@ class InferenceEngineV2:
                     break
                 take = min(d.in_flight, self.prefill_chunk, T - used)
                 if d.seen_tokens + take > self.max_seq_len:
-                    raise RuntimeError(
+                    raise ContextOverflowError(
                         f"uid {d.uid}: prompt exceeds context "
-                        f"({d.seen_tokens}+{take} > {self.max_seq_len})")
+                        f"({d.seen_tokens}+{take} > {self.max_seq_len})",
+                        uid=d.uid)
                 plan.append((d, take))
                 used += take
             # allocate blocks for the WHOLE step before mutating any sequence
@@ -387,10 +389,10 @@ class InferenceEngineV2:
                 take = min(self.prefill_chunk, d.in_flight)
                 room = self.max_seq_len - d.seen_tokens
                 if room < take:
-                    raise RuntimeError(
+                    raise ContextOverflowError(
                         f"uid {d.uid}: prompt exceeds slot context "
-                        f"({d.seen_tokens}+{take} > {self.max_seq_len})"
-                    )
+                        f"({d.seen_tokens}+{take} > {self.max_seq_len})",
+                        uid=d.uid)
                 seg = min(_bucket(take), room)
                 groups.setdefault(seg, []).append(d)
             for S, grp in groups.items():
@@ -430,10 +432,10 @@ class InferenceEngineV2:
             for uid in tokens:
                 d = self.state.seqs[uid]
                 if d.seen_tokens + d.in_flight >= self.max_seq_len:
-                    raise RuntimeError(
+                    raise ContextOverflowError(
                         f"uid {uid}: context full ({d.seen_tokens} >= "
                         f"{self.max_seq_len}); flush the sequence or raise "
-                        "max_seq_len")
+                        "max_seq_len", uid=uid)
             for uid in tokens:
                 d = self.state.seqs[uid]
                 self.block_mgr.ensure(d, d.seen_tokens + d.in_flight + 1)
@@ -450,10 +452,9 @@ class InferenceEngineV2:
         for uid in tokens:
             d = self.state.seqs[uid]
             if d.seen_tokens >= self.max_seq_len:
-                raise RuntimeError(
+                raise ContextOverflowError(
                     f"uid {uid}: context full ({d.seen_tokens} >= {self.max_seq_len}); "
-                    "flush the sequence or raise max_seq_len"
-                )
+                    "flush the sequence or raise max_seq_len", uid=uid)
         for uid, tok in tokens.items():
             d = self.state.seqs[uid]
             toks[d.slot] = tok

@@ -28,6 +28,8 @@ from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
 
+from ...resilience.errors import PoolExhaustedError
+
 #: chain root sentinel for the content index (block ids are >= 0)
 _ROOT = -1
 
@@ -159,9 +161,11 @@ class BlockedKVCache:
     def _allocate(self, uid: int) -> int:
         while not self._free:
             if not self._evict_one():
-                raise RuntimeError(
+                # typed capacity signal (message kept for compat): the
+                # scheduler dispatches on the type, not the string
+                raise PoolExhaustedError(
                     f"KV block pool exhausted (uid {uid}; "
-                    f"{self.num_blocks - 1} usable blocks)")
+                    f"{self.num_blocks - 1} usable blocks)", uid=uid)
         b = self._free.pop()
         self._ref[b] = 1
         return b
@@ -326,7 +330,9 @@ class DSStateManager:
         if uid in self.seqs:
             return self.seqs[uid]
         if not self._free:
-            raise RuntimeError(f"no free KV slots for uid {uid} (max_seqs={self.max_seqs})")
+            raise PoolExhaustedError(
+                f"no free KV slots for uid {uid} (max_seqs={self.max_seqs})",
+                uid=uid)
         slot = self._free.pop()
         desc = SequenceDescriptor(uid=uid, slot=slot)
         self.seqs[uid] = desc

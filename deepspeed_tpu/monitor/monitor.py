@@ -196,13 +196,34 @@ class MonitorMaster(Monitor):
         self.wandb_monitor = WandbMonitor(sink("wandb"))
         self.enabled = any(m.enabled for m in
                            (self.csv_monitor, self.tb_monitor, self.wandb_monitor))
+        #: per-sink consecutive write failures; at the threshold the sink is
+        #: disabled — observability must never take down the serving loop
+        self.sink_failures = {}
+        self.sink_failure_threshold = 3
 
     def _fan_out(self, method: str, *args):
         if jax.process_index() != 0 or not self.enabled:
             return
         for m in (self.csv_monitor, self.tb_monitor, self.wandb_monitor):
-            if m.enabled:
+            if not m.enabled:
+                continue
+            # failure containment (docs/RESILIENCE.md): a flaky sink (full
+            # disk, dead wandb socket) is logged and, after consecutive
+            # failures, disabled — never propagated into the caller's loop
+            name = type(m).__name__
+            try:
                 getattr(m, method)(*args)
+            except Exception as e:
+                n = self.sink_failures.get(name, 0) + 1
+                self.sink_failures[name] = n
+                logger.warning("monitor sink %s.%s failed (%d consecutive): "
+                               "%s", name, method, n, e)
+                if n >= self.sink_failure_threshold:
+                    logger.warning("monitor sink %s disabled after %d "
+                                   "consecutive failures", name, n)
+                    m.enabled = False
+            else:
+                self.sink_failures[name] = 0
 
     def write_events(self, events: List[Event]):
         self._fan_out("write_events", events)

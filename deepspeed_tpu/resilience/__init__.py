@@ -1,0 +1,16 @@
+"""``deepspeed_tpu.resilience`` — fault tolerance for the serving stack.
+
+Typed fault taxonomy, deterministic seeded fault injection, bounded
+retry/backoff, circuit breaking with load shedding, and step watchdogs.
+The scheduler (``deepspeed_tpu.serve``) composes these into failure
+containment; the engine raises the typed capacity errors. See
+``docs/RESILIENCE.md``."""
+
+from .breaker import BreakerState, CircuitBreaker  # noqa: F401
+from .errors import (ContextOverflowError, PoolExhaustedError,  # noqa: F401
+                     RequestFailedError, SheddingError,
+                     TransientEngineError, WatchdogTimeoutError)
+from .faults import (SITES, FaultInjector, FaultSpec,  # noqa: F401
+                     InjectedEngine)
+from .retry import RetryPolicy  # noqa: F401
+from .watchdog import StepWatchdog  # noqa: F401

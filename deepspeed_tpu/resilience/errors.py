@@ -1,0 +1,80 @@
+"""Typed fault taxonomy for the serving stack (docs/RESILIENCE.md).
+
+Every error the engine or the fault layer can surface into
+``ContinuousBatchScheduler.step()`` is a named type here, so the scheduler
+dispatches on ``isinstance`` instead of string-matching messages. The split
+that matters operationally:
+
+- **capacity signals** (:class:`PoolExhaustedError`,
+  :class:`ContextOverflowError`): normal pressure, handled by preemption /
+  per-request quarantine — never a breaker failure.
+- **transient faults** (:class:`TransientEngineError`): the call may succeed
+  if simply retried — bounded exponential backoff with deterministic jitter
+  (``resilience.retry.RetryPolicy``); each occurrence feeds the circuit
+  breaker.
+- **persistent per-request faults** (:class:`RequestFailedError`): retrying
+  cannot help and exactly one request is culpable — it is quarantined into
+  the terminal ``FAILED`` state while uninvolved live requests are preempted
+  and re-admitted losslessly.
+- **shedding** (:class:`SheddingError`): the breaker is open and the
+  submission's priority is below the shed floor — the caller is told to back
+  off, typed, at admission time.
+
+All subclass ``RuntimeError`` so pre-taxonomy callers catching
+``RuntimeError`` keep working, and message texts are unchanged from the
+string-era raises (compat)."""
+
+from typing import Optional
+
+
+class PoolExhaustedError(RuntimeError):
+    """A shared pool (KV block pool or sequence-slot pool) has no capacity
+    left for this allocation. Recoverable by preemption: evicting a victim
+    frees capacity and the call can be retried verbatim.
+
+    ``uid`` (when known) is the request whose allocation hit the wall — NOT
+    a culprit; any resident sequence may be holding the capacity."""
+
+    def __init__(self, message: str, uid: Optional[int] = None):
+        super().__init__(message)
+        self.uid = uid
+
+
+class ContextOverflowError(RuntimeError):
+    """A single sequence ran past its maximum context length. Per-request
+    and permanent: preemption cannot help, only failing (or flushing) the
+    culpable ``uid`` can."""
+
+    def __init__(self, message: str, uid: Optional[int] = None):
+        super().__init__(message)
+        self.uid = uid
+
+
+class TransientEngineError(RuntimeError):
+    """An engine call failed in a way that a bounded retry may fix
+    (runtime hiccup, transport blip, injected transient fault). The fault
+    layer guarantees the engine's host-side state was NOT mutated by the
+    failed call, so the retry passes the same arguments."""
+
+
+class RequestFailedError(RuntimeError):
+    """A persistent failure attributable to exactly one request. The
+    scheduler quarantines ``uid`` (terminal ``FAILED`` state, blocks
+    flushed, streaming consumers unblocked with this error) and contains
+    the blast radius by preempting + re-admitting uninvolved requests."""
+
+    def __init__(self, uid: int, message: str = ""):
+        super().__init__(message or f"persistent engine fault on uid {uid}")
+        self.uid = uid
+
+
+class SheddingError(RuntimeError):
+    """Load shed at admission: the circuit breaker is open and the request's
+    priority is below the shed floor. Retry after the breaker's cooldown, or
+    resubmit at a priority at or above the floor."""
+
+
+class WatchdogTimeoutError(RuntimeError):
+    """A step (or the close() drain) exceeded its wall-clock budget past the
+    point of escalation. Raised only where there is no in-band way to keep
+    going; ordinary breaches are counted and escalated to the breaker."""

@@ -1,0 +1,192 @@
+"""Deterministic, seeded fault injection for the serving stack.
+
+At TPU-fleet scale faults are the steady state; a serving loop that has only
+ever seen healthy engines is untested where it matters. ``FaultInjector``
+injects faults at the four engine call sites the scheduler uses — ``put``,
+``decode_step``, ``flush``, ``preempt`` — through :class:`InjectedEngine`, a
+transparent proxy the scheduler cannot distinguish from the real engine.
+
+**Contract: faults fire BEFORE the wrapped call delegates.** The real
+engine's host state is never mutated by a faulted call, so a retried call
+passes identical arguments and containment (preempt + re-admit uninvolved
+requests) starts from consistent state. This mirrors the engine's own
+all-or-nothing validation discipline (a raise leaves every descriptor
+intact).
+
+**Zero overhead when disabled:** injection only exists if you wrap the
+engine. An unwrapped engine has no injector code on its call path at all; a
+wrapped injector with an empty plan is a counter increment per call.
+
+A fault **plan** is a list of :class:`FaultSpec`:
+
+- ``kind="transient"``: raise ``TransientEngineError`` on calls
+  ``nth .. nth+count-1`` to ``site`` (1-based, counted per site).
+- ``kind="latency"``: sleep ``latency_s`` before delegating on those calls —
+  the watchdog sees the spike as a genuine slow step.
+- ``kind="persistent"``: raise ``RequestFailedError(uid)`` whenever ``uid``
+  appears in a ``put``/``decode_step`` call — *every* time, which is what
+  makes it persistent: retries keep failing until the scheduler quarantines
+  the request. Restricted to the request-processing sites so a teardown path
+  (``flush``/``preempt``) can always reclaim the quarantined blocks.
+
+``seed`` drives :meth:`FaultInjector.random_plan` (the randomized soak
+test); explicit plans are deterministic by construction."""
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .errors import RequestFailedError, TransientEngineError
+
+#: the engine surface the scheduler drives (and therefore the fault surface)
+SITES = ("put", "decode_step", "flush", "preempt")
+_PERSISTENT_SITES = ("put", "decode_step")
+
+
+@dataclass
+class FaultSpec:
+    """One planned fault. ``site`` is one of :data:`SITES` or ``"*"``."""
+
+    site: str
+    kind: str = "transient"          # transient | persistent | latency
+    nth: Optional[int] = None        # 1-based per-site call index
+    count: int = 1                   # consecutive calls affected from nth
+    uid: Optional[int] = None        # persistent: the culpable request
+    latency_s: float = 0.0
+    message: str = ""
+    fired: int = field(default=0, compare=False)  # runtime hit counter
+
+    def __post_init__(self):
+        if self.site != "*" and self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"expected one of {SITES} or '*'")
+        if self.kind == "persistent":
+            if self.uid is None:
+                raise ValueError("persistent fault needs a culpable uid")
+            if self.site not in _PERSISTENT_SITES:
+                raise ValueError(
+                    "persistent faults are restricted to request-processing "
+                    f"sites {_PERSISTENT_SITES} (a faulted flush/preempt "
+                    "would leak the quarantined request's blocks)")
+        elif self.kind in ("transient", "latency"):
+            if self.nth is None:
+                raise ValueError(f"{self.kind} fault needs nth (1-based "
+                                 "per-site call index)")
+        else:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultInjector:
+    """Holds the plan, counts per-site calls, fires matching specs.
+
+    ``sleep`` is injectable so latency faults are testable without real
+    waiting. ``enabled`` can be flipped at runtime (a kill switch for live
+    chaos drills)."""
+
+    def __init__(self, plan: Sequence[Union[FaultSpec, dict]] = (),
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        self.specs: List[FaultSpec] = [
+            s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in plan]
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self.sleep = sleep
+        self.enabled = True
+        self.calls: Dict[str, int] = {s: 0 for s in SITES}
+        self.fired: Dict[str, int] = {"transient": 0, "persistent": 0,
+                                      "latency": 0}
+
+    def inject(self, **kw) -> FaultSpec:
+        """Append one spec to the live plan (uid-dependent specs are
+        installed after submission, when uids exist)."""
+        spec = FaultSpec(**kw)
+        self.specs.append(spec)
+        return spec
+
+    @classmethod
+    def random_plan(cls, seed: int, *, horizon: int, rate: float = 0.02,
+                    sites: Sequence[str] = ("put", "decode_step"),
+                    max_burst: int = 2, latency_s: float = 0.0,
+                    sleep: Callable[[float], None] = time.sleep
+                    ) -> "FaultInjector":
+        """Seeded randomized plan for soak testing: each site gets transient
+        bursts at ~``rate`` per call over ``horizon`` calls (and latency
+        spikes when ``latency_s > 0``). Same seed, same plan — the soak is
+        rerunnable bit-for-bit."""
+        rng = np.random.default_rng(seed)
+        specs: List[FaultSpec] = []
+        for site in sites:
+            for n in range(1, horizon + 1):
+                if rng.random() < rate:
+                    kind = ("latency" if latency_s > 0 and rng.random() < 0.3
+                            else "transient")
+                    specs.append(FaultSpec(
+                        site=site, kind=kind, nth=n,
+                        count=int(rng.integers(1, max_burst + 1)),
+                        latency_s=latency_s if kind == "latency" else 0.0))
+        return cls(specs, seed=seed, sleep=sleep)
+
+    def wrap(self, engine) -> "InjectedEngine":
+        return InjectedEngine(engine, self)
+
+    def on_call(self, site: str, uids: Sequence[int]) -> None:
+        """Fault gate, called by the proxy before delegating. Latency specs
+        sleep (several can stack); the first matching raising spec raises."""
+        self.calls[site] += 1
+        if not self.enabled or not self.specs:
+            return
+        n = self.calls[site]
+        for spec in self.specs:
+            if spec.site not in (site, "*"):
+                continue
+            if spec.kind == "persistent":
+                if spec.uid in uids:
+                    spec.fired += 1
+                    self.fired["persistent"] += 1
+                    raise RequestFailedError(
+                        spec.uid, spec.message or
+                        f"injected persistent fault on uid {spec.uid} "
+                        f"at {site} (call {n})")
+            elif spec.nth <= n < spec.nth + spec.count:
+                spec.fired += 1
+                if spec.kind == "latency":
+                    self.fired["latency"] += 1
+                    self.sleep(spec.latency_s)
+                else:
+                    self.fired["transient"] += 1
+                    raise TransientEngineError(
+                        spec.message or
+                        f"injected transient fault at {site} call {n}")
+
+
+class InjectedEngine:
+    """Fault-injecting proxy over an ``InferenceEngineV2`` (duck-typed).
+
+    Only the four scheduler-facing methods are intercepted; every other
+    attribute (``state``, ``kv``, ``paged``, ``query``, …) resolves straight
+    through to the inner engine, so the scheduler, the bench, and the tests
+    are oblivious to the wrapping."""
+
+    def __init__(self, engine, injector: FaultInjector):
+        self.inner = engine
+        self.injector = injector
+
+    def put(self, batch_uids, batch_tokens, *a, **kw):
+        self.injector.on_call("put", list(batch_uids))
+        return self.inner.put(batch_uids, batch_tokens, *a, **kw)
+
+    def decode_step(self, tokens, *a, **kw):
+        self.injector.on_call("decode_step", list(tokens))
+        return self.inner.decode_step(tokens, *a, **kw)
+
+    def flush(self, uid):
+        self.injector.on_call("flush", [uid])
+        return self.inner.flush(uid)
+
+    def preempt(self, uid):
+        self.injector.on_call("preempt", [uid])
+        return self.inner.preempt(uid)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

@@ -1,0 +1,52 @@
+"""Step watchdog: wall-clock budgets for engine steps and the drain.
+
+``StepWatchdog`` is pure accounting — the scheduler times each engine call
+(``perf_counter``, always wall time, even under a simulated scheduling
+clock) and reports it here. A step over ``step_budget_s`` is a **breach**
+(counted, per kind); ``escalate_after`` consecutive breaches is an
+**escalation** — the scheduler feeds escalations to the circuit breaker as
+failures, so a slow-but-not-crashing engine (the TPU tail-latency mode the
+Gemma/TPU serving comparisons treat as first-class) eventually opens the
+breaker just like a crashing one. A fast step resets the consecutive
+counter.
+
+``drain_budget_s`` bounds ``close()``: a drain that cannot finish inside
+the budget stops stepping and cancels the stragglers instead of hanging
+shutdown forever (breaches of this budget are the ``drain_aborts`` metric).
+
+Both budgets default to ``None`` = disabled: the watchdog is zero-cost until
+an operator opts in."""
+
+from typing import Dict, Optional, Tuple
+
+
+class StepWatchdog:
+    def __init__(self, step_budget_s: Optional[float] = None,
+                 escalate_after: int = 3,
+                 drain_budget_s: Optional[float] = None):
+        if escalate_after < 1:
+            raise ValueError(
+                f"escalate_after must be >= 1, got {escalate_after}")
+        self.step_budget_s = step_budget_s
+        self.escalate_after = escalate_after
+        self.drain_budget_s = drain_budget_s
+        self.breaches = 0
+        self.escalations = 0
+        self.worst_s = 0.0
+        self.breaches_by_kind: Dict[str, int] = {}
+        self._consecutive = 0
+
+    def observe(self, kind: str, duration_s: float) -> Tuple[bool, bool]:
+        """Record one step; returns ``(breached, escalated)``."""
+        self.worst_s = max(self.worst_s, duration_s)
+        if self.step_budget_s is None or duration_s <= self.step_budget_s:
+            self._consecutive = 0
+            return False, False
+        self.breaches += 1
+        self.breaches_by_kind[kind] = self.breaches_by_kind.get(kind, 0) + 1
+        self._consecutive += 1
+        if self._consecutive >= self.escalate_after:
+            self.escalations += 1
+            self._consecutive = 0  # escalation resets the streak
+            return True, True
+        return True, False

@@ -1,10 +1,16 @@
 """``deepspeed_tpu.serve`` — production serving layer over the v2 engine.
 
 Request lifecycle, SLA-aware continuous-batching scheduler (admission,
-preemption, streaming, graceful drain), and the serving metrics surface.
-See ``docs/SERVING.md``.
+preemption, streaming, graceful drain), failure containment over the
+``deepspeed_tpu.resilience`` layer (typed faults, retry, quarantine,
+watchdog, circuit-breaker load shedding), and the serving metrics surface.
+See ``docs/SERVING.md`` and ``docs/RESILIENCE.md``.
 """
 
+from ..resilience import (CircuitBreaker, FaultInjector,  # noqa: F401
+                          FaultSpec, PoolExhaustedError, RequestFailedError,
+                          RetryPolicy, SheddingError, StepWatchdog,
+                          TransientEngineError)
 from .metrics import ServeMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401

@@ -27,6 +27,7 @@ class ServeMetrics:
         self.admitted = 0
         self.completed = 0
         self.cancelled = 0
+        self.failed = 0              # terminal FAILED (quarantined requests)
         self.preemptions = 0
         self.preempted_blocks_reclaimed = 0
         self.admission_rejects = 0   # bounded-queue backpressure
@@ -38,6 +39,25 @@ class ServeMetrics:
         self.ttft_s: List[float] = []        # admission-arrival -> first token
         self.step_lat_s: List[float] = []    # decode-step wall time
         self.step_batch: List[int] = []      # decode-step batch size
+        #: resilience counters, exported under ``serve/faults/*``
+        #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
+        #: step, the rest are incremented by the scheduler as faults land
+        self.faults: Dict[str, float] = {
+            "transient_faults": 0,        # TransientEngineError occurrences
+            "transient_retries": 0,       # backoff retries performed
+            "retry_giveups": 0,           # retry budget exhausted
+            "persistent_faults": 0,       # RequestFailedError occurrences
+            "failed_requests": 0,         # requests quarantined to FAILED
+            "containment_preemptions": 0,  # uninvolved live reqs re-admitted
+            "watchdog_breaches": 0,
+            "watchdog_escalations": 0,
+            "shed": 0,                    # SheddingError admissions rejected
+            "drain_aborts": 0,            # close() hit its drain budget
+            "breaker_opens": 0,
+            "breaker_half_opens": 0,
+            "breaker_closes": 0,
+            "breaker_state": 0.0,         # gauge: 0 closed, 1 half, 2 open
+        }
 
     def observe_step(self, latency_s: float, batch: int) -> None:
         self.step_lat_s.append(latency_s)
@@ -48,6 +68,15 @@ class ServeMetrics:
         self.live = live
         self.queue_peak = max(self.queue_peak, queue_depth)
 
+    def observe_resilience(self, breaker, watchdog) -> None:
+        """Sync breaker/watchdog state into the fault counters (per step)."""
+        self.faults["breaker_opens"] = breaker.opens
+        self.faults["breaker_half_opens"] = breaker.half_opens
+        self.faults["breaker_closes"] = breaker.closes
+        self.faults["breaker_state"] = breaker.state_gauge
+        self.faults["watchdog_breaches"] = watchdog.breaches
+        self.faults["watchdog_escalations"] = watchdog.escalations
+
     @staticmethod
     def _pct(samples: List[float], q: float) -> float:
         return float(np.percentile(np.asarray(samples), q)) if samples else 0.0
@@ -57,6 +86,7 @@ class ServeMetrics:
         s = {
             "submitted": self.submitted, "admitted": self.admitted,
             "completed": self.completed, "cancelled": self.cancelled,
+            "failed": self.failed,
             "preemptions": self.preemptions,
             "preempted_blocks_reclaimed": self.preempted_blocks_reclaimed,
             "admission_rejects": self.admission_rejects,
@@ -74,6 +104,10 @@ class ServeMetrics:
         return s
 
     def events(self, step: int = 0) -> List[Event]:
-        """``(label, value, step)`` tuples for ``MonitorMaster.write_events``."""
-        return [(f"serve/{k}", float(v), step)
-                for k, v in sorted(self.summary().items())]
+        """``(label, value, step)`` tuples for ``MonitorMaster.write_events``
+        — serving counters under ``serve/``, resilience counters under
+        ``serve/faults/``."""
+        return ([(f"serve/{k}", float(v), step)
+                 for k, v in sorted(self.summary().items())]
+                + [(f"serve/faults/{k}", float(v), step)
+                   for k, v in sorted(self.faults.items())])

@@ -2,7 +2,7 @@
 
 A :class:`Request` is the unit of work the scheduler moves through
 
-``QUEUED -> PREFILL -> DECODE -> {DONE, CANCELLED}``
+``QUEUED -> PREFILL -> DECODE -> {DONE, CANCELLED, FAILED}``
 with ``PREEMPTED -> QUEUED`` as the eviction edge: a preempted request
 re-enters the queue carrying its already-generated tokens appended to the
 prompt, so re-admission replays the whole committed history through
@@ -29,10 +29,12 @@ class RequestState(enum.Enum):
     PREEMPTED = "preempted"  # transient: evicted under pressure, re-queued
     DONE = "done"            # max_new_tokens generated
     CANCELLED = "cancelled"  # user cancel / expired deadline / drain reject
+    FAILED = "failed"        # quarantined: persistent per-request fault
 
     @property
     def finished(self) -> bool:
-        return self in (RequestState.DONE, RequestState.CANCELLED)
+        return self in (RequestState.DONE, RequestState.CANCELLED,
+                        RequestState.FAILED)
 
 
 @dataclass
@@ -61,6 +63,9 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     cancel_reason: Optional[str] = None
+    #: terminal FAILED only: the persistent fault that quarantined this
+    #: request — ``stream()`` re-raises it to unblock pull consumers
+    error: Optional[BaseException] = None
     _cursor: int = 0  # streaming iterator position into ``tokens``
 
     @property

@@ -21,6 +21,19 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   the block table at near-zero cost. Greedy decoding makes the round trip
   bitwise-lossless: the re-admitted request continues with exactly the
   tokens an unpreempted run would have produced.
+- **failure containment** (docs/RESILIENCE.md): engine faults are typed
+  (``deepspeed_tpu.resilience.errors``) and no longer unwind the whole
+  serving loop. Transient faults are retried with bounded exponential
+  backoff + deterministic jitter; persistent per-request faults quarantine
+  ONLY the culpable request into the terminal ``FAILED`` state (blocks
+  flushed, streaming consumers unblocked with the error) while uninvolved
+  live requests are preempted and re-admitted through the prefix cache —
+  bitwise-lossless under greedy decoding. A step watchdog counts wall-clock
+  budget breaches and escalates sustained slowness to the circuit breaker;
+  the breaker sheds low-priority admissions (``SheddingError``) while open
+  and restores service through a half-open probe. Capacity signals
+  (``PoolExhaustedError``) stay what they were: preemption pressure, never
+  breaker failures.
 - **streaming**: per-token callbacks (``Request.on_token``) and a pull
   iterator (:meth:`stream`) that drives the loop.
 - **graceful drain**: :meth:`close` rejects new admits, cancels
@@ -28,7 +41,8 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   (including preempted requests awaiting re-admission), and blocks on
   outstanding device work before returning — the r4 transfer-guard
   discipline (``deepspeed_tpu/utils/transfer.py``): never abandon queued
-  transfers.
+  transfers. With a watchdog ``drain_budget_s`` the drain is bounded:
+  stragglers are cancelled rather than hanging shutdown forever.
 
 Everything here is host-side bookkeeping; the fixed-shape contract of the
 paged engine is untouched (``ragged_cache_size <= 4`` under any schedule).
@@ -40,6 +54,12 @@ from typing import Callable, Deque, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.errors import (ContextOverflowError, PoolExhaustedError,
+                                 RequestFailedError, SheddingError,
+                                 TransientEngineError)
+from ..resilience.retry import RetryPolicy
+from ..resilience.watchdog import StepWatchdog
 from ..utils.logging import logger
 from .metrics import Event, ServeMetrics
 from .request import Request, RequestState
@@ -53,29 +73,40 @@ class SchedulerClosedError(RuntimeError):
     """``submit`` after ``close()`` — the scheduler is draining or drained."""
 
 
-def _is_pool_exhausted(err: RuntimeError) -> bool:
-    return "exhausted" in str(err)
-
-
 class ContinuousBatchScheduler:
     """SLA-aware admit/decode loop owning one :class:`InferenceEngineV2`.
 
     ``clock`` is the *scheduling* time source (arrivals, aging, deadlines,
-    TTFT) and is injectable for deterministic tests / simulated arrival
-    processes; decode-step latency is always measured with
-    ``time.perf_counter``. Sampling is greedy (argmax) — the property the
-    preemption round trip's bitwise guarantee rests on.
+    TTFT, breaker cooldowns) and is injectable for deterministic tests /
+    simulated arrival processes; decode-step latency and watchdog budgets
+    are always measured with ``time.perf_counter``. Sampling is greedy
+    (argmax) — the property the preemption round trip's bitwise guarantee
+    rests on.
+
+    ``retry`` / ``breaker`` / ``watchdog`` default to always-on instances
+    whose thresholds only matter once faults actually occur (the watchdog
+    defaults to no budget), so a healthy engine sees zero behavior change.
+    ``sleep`` is the backoff sleeper — injectable so chaos tests don't wait
+    out real backoff.
     """
 
     def __init__(self, engine, *, max_queue: int = 256, age_weight: float = 1.0,
                  deadline_weight: float = 1.0, preemption: bool = True,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog: Optional[StepWatchdog] = None,
+                 sleep: Callable[[float], None] = time.sleep):
         self.engine = engine
         self.max_queue = max_queue
         self.age_weight = age_weight
         self.deadline_weight = deadline_weight
         self.preemption = preemption
         self._clock = clock
+        self.retry = retry or RetryPolicy()
+        self.breaker = breaker or CircuitBreaker()
+        self.watchdog = watchdog or StepWatchdog()
+        self._sleep = sleep
         self.metrics = ServeMetrics()
         self._queue: Deque[Request] = deque()
         self._live: Dict[int, Request] = {}
@@ -92,10 +123,17 @@ class ContinuousBatchScheduler:
                deadline: Optional[float] = None,
                arrival_time: Optional[float] = None,
                on_token=None, uid: Optional[int] = None) -> Request:
-        """Enqueue a request; raises :class:`QueueFullError` on backpressure
-        and :class:`SchedulerClosedError` after :meth:`close`."""
+        """Enqueue a request; raises :class:`QueueFullError` on backpressure,
+        :class:`SheddingError` while the circuit breaker sheds load, and
+        :class:`SchedulerClosedError` after :meth:`close`."""
         if self._closed:
             raise SchedulerClosedError("scheduler is closed to new admits")
+        if self.breaker.should_shed(priority, self._clock()):
+            self.metrics.faults["shed"] += 1
+            raise SheddingError(
+                f"circuit breaker open: shedding priority {priority} "
+                f"(< floor {self.breaker.shed_priority_floor}); retry after "
+                f"cooldown or resubmit at or above the floor")
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -129,12 +167,104 @@ class ContinuousBatchScheduler:
         if req in self._queue:
             self._queue.remove(req)
         self._live.pop(uid, None)
-        self.engine.flush(uid)  # no-op when not resident (idempotent)
+        self._engine_flush(uid)  # no-op when not resident (idempotent)
         req.state = RequestState.CANCELLED
         req.cancel_reason = reason
         req.finish_time = self._clock()
         self.metrics.cancelled += 1
         return True
+
+    # ------------------------------------------------------------------
+    # fault handling primitives (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _retry_transient(self, site: str, attempt: int,
+                         err: TransientEngineError) -> bool:
+        """Account one transient fault; True if the caller should back off
+        and retry, False when the retry budget is spent (caller re-raises).
+        Every occurrence is a breaker failure — a retried-away fault still
+        happened."""
+        now = self._clock()
+        self.metrics.faults["transient_faults"] += 1
+        self.breaker.on_failure(now)
+        if attempt + 1 >= self.retry.max_attempts:
+            self.metrics.faults["retry_giveups"] += 1
+            logger.warning("serve: transient fault at %s, retries exhausted "
+                           "(%d attempts): %s", site, attempt + 1, err)
+            return False
+        self.metrics.faults["transient_retries"] += 1
+        self._sleep(self.retry.delay(attempt + 1, key=site))
+        return True
+
+    def _engine_flush(self, uid: int) -> None:
+        """``engine.flush`` with transient-fault retry (flush must not fail
+        a cancel/finish path on a runtime hiccup; it is idempotent, so the
+        retry is always safe)."""
+        attempt = 0
+        while True:
+            try:
+                return self.engine.flush(uid)
+            except TransientEngineError as e:
+                if not self._retry_transient("flush", attempt, e):
+                    raise
+                attempt += 1
+
+    def _engine_preempt(self, uid: int) -> int:
+        attempt = 0
+        while True:
+            try:
+                return self.engine.preempt(uid)
+            except TransientEngineError as e:
+                if not self._retry_transient("preempt", attempt, e):
+                    raise
+                attempt += 1
+
+    def _observe_engine_ok(self, kind: str, duration_s: float) -> None:
+        """A successful engine call: feed the watchdog; a budget breach is
+        NOT a success for the breaker (a slow-but-alive engine must be able
+        to open it), and an escalation counts as a failure outright."""
+        now = self._clock()
+        breached, escalated = self.watchdog.observe(kind, duration_s)
+        if not breached:
+            self.breaker.on_success(now)
+        elif escalated:
+            self.breaker.on_failure(now)
+
+    def _fail(self, req: Request, exc: BaseException, now: float) -> None:
+        """Quarantine ``req``: terminal FAILED, blocks flushed, streaming
+        consumers unblocked with the error (``stream`` re-raises it)."""
+        self._live.pop(req.uid, None)
+        if req in self._queue:
+            self._queue.remove(req)
+        self._engine_flush(req.uid)
+        req.state = RequestState.FAILED
+        req.error = exc
+        req.finish_time = now
+        self.metrics.failed += 1
+        self.metrics.faults["failed_requests"] += 1
+        logger.warning("serve: quarantined uid %d after persistent fault: %s",
+                       req.uid, exc)
+
+    def _contain(self, culpable_uid: int, exc: BaseException,
+                 now: float) -> None:
+        """Persistent per-request failure: fail the culpable request, then
+        preempt every uninvolved live request so it re-admits through the
+        prefix cache from known-good state — bitwise-lossless under greedy
+        decoding. The fault layer raises before the engine mutates state, so
+        the survivors' committed history is intact."""
+        self.metrics.faults["persistent_faults"] += 1
+        self.breaker.on_failure(now)
+        req = self._all.get(culpable_uid)
+        if req is not None and not req.finished:
+            self._fail(req, exc, now)
+        else:  # culprit unknown to us: flush engine-side residue anyway
+            self._engine_flush(culpable_uid)
+        for other in [r for r in list(self._live.values())
+                      if r.state in (RequestState.PREFILL,
+                                     RequestState.DECODE)]:
+            self._preempt(other)
+            self.metrics.faults["containment_preemptions"] += 1
+        self._stalled = any(
+            d.in_flight for d in self.engine.state.seqs.values())
 
     # ------------------------------------------------------------------
     # scheduling policy
@@ -165,7 +295,7 @@ class ContinuousBatchScheduler:
                                          len(r.tokens)))
 
     def _preempt(self, req: Request) -> None:
-        freed = self.engine.preempt(req.uid)
+        freed = self._engine_preempt(req.uid)
         self._live.pop(req.uid, None)
         req.state = RequestState.PREEMPTED
         req.preemptions += 1
@@ -183,6 +313,16 @@ class ContinuousBatchScheduler:
                     if r.deadline is not None and r.deadline <= now]:
             self.cancel(req.uid, reason="deadline")
             self.metrics.deadline_cancels += 1
+        # live PREFILL/DECODE requests past their deadline are cancelled too
+        # (blocks flushed) — finishing a missed SLA spends pool capacity the
+        # queued requests behind it could use
+        for req in [r for r in self._live.values()
+                    if r.deadline is not None and r.deadline <= now]:
+            self.cancel(req.uid, reason="deadline")
+            self.metrics.deadline_cancels += 1
+            # a stale _stalled flag after cancelling a mid-prefill request
+            # self-heals: the drain put([], []) recomputes it from engine
+            # state before the next admission
 
     def _admit(self, now: float) -> None:
         while self._queue and not self._stalled:
@@ -218,27 +358,48 @@ class ContinuousBatchScheduler:
 
     def _engine_put(self, uids: List[int], token_lists: List[List[int]]
                     ) -> Dict[int, np.ndarray]:
-        """``engine.put`` with pool-pressure handling: on exhaustion, evict a
-        strictly-lower-priority victim and retry (pending tokens already sit
-        inside the engine, so the retry passes no new work). With no eligible
-        victim the prefill stalls until live decodes complete and free
-        blocks; if nothing is decoding either, the pool cannot hold this
-        request at all and the error propagates."""
+        """``engine.put`` with full fault handling.
+
+        - pool pressure: on exhaustion, evict a strictly-lower-priority
+          victim and retry (pending tokens already sit inside the engine, so
+          the retry passes no new work). With no eligible victim the prefill
+          stalls until live decodes complete and free blocks; if nothing is
+          decoding either, the pool cannot hold this request at all and the
+          error propagates.
+        - transient faults: bounded backoff retry with the SAME arguments
+          (the fault layer raises before the engine mutates state).
+        - persistent per-request faults: quarantine the culpable uid and
+          containment-preempt the rest (see :meth:`_contain`)."""
         # the priority the eviction check compares against: the request(s)
         # being prefilled — on a pure drain retry, the stalled PREFILL ones
         prios = [self._all[u].priority for u in uids] + [
             r.priority for r in self._live.values()
             if r.state is RequestState.PREFILL]
         prio = max(prios) if prios else None
+        attempt = 0
         while True:
             try:
+                t0 = time.perf_counter()
                 out = self.engine.put(uids, token_lists,
                                       greedy=self.engine.paged)
+                self._observe_engine_ok("prefill", time.perf_counter() - t0)
                 self._stalled = any(
                     d.in_flight for d in self.engine.state.seqs.values())
                 return out
-            except RuntimeError as e:
-                if not (_is_pool_exhausted(e) and self.preemption):
+            except TransientEngineError as e:
+                if not self._retry_transient("put", attempt, e):
+                    raise
+                attempt += 1
+            except RequestFailedError as e:
+                self._contain(e.uid, e, self._clock())
+                keep = [(u, t) for u, t in zip(uids, token_lists)
+                        if u != e.uid]
+                uids = [u for u, _ in keep]
+                token_lists = [t for _, t in keep]
+                if not uids:
+                    return {}
+            except PoolExhaustedError:
+                if not self.preemption:
                     raise
                 victim = self._pick_victim(below_priority=prio)
                 if victim is None:
@@ -259,7 +420,7 @@ class ContinuousBatchScheduler:
         for uid, val in out.items():
             req = self._live.get(uid)
             if req is None:  # cancelled between dispatch and absorb
-                self.engine.flush(uid)
+                self._engine_flush(uid)
                 continue
             tok = int(val) if self.engine.paged else int(np.argmax(val))
             if req.first_token_time is None:
@@ -272,7 +433,7 @@ class ContinuousBatchScheduler:
                 self._finish(req, now)
 
     def _finish(self, req: Request, now: float) -> None:
-        self.engine.flush(req.uid)
+        self._engine_flush(req.uid)
         self._live.pop(req.uid, None)
         req.state = RequestState.DONE
         req.finish_time = now
@@ -283,36 +444,55 @@ class ContinuousBatchScheduler:
                 if r.state is RequestState.DECODE}
         if not feed:
             return
-        t0 = time.perf_counter()
-        try:
-            out = self.engine.decode_step(feed, greedy=True)
-        except RuntimeError as e:
-            if not (_is_pool_exhausted(e) and self.preemption):
-                raise
-            # decode-time pool pressure: SOMEONE must yield or no sequence
-            # can progress (and nothing would ever free) — eviction here is
-            # unconditional on priority, lowest first
-            victim = self._pick_victim()
-            if victim is None:
-                raise
-            self._preempt(victim)
-            return  # retry next step with the shrunken batch
-        self.metrics.observe_step(time.perf_counter() - t0, len(feed))
+        attempt = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                out = self.engine.decode_step(feed, greedy=True)
+                break
+            except TransientEngineError as e:
+                if not self._retry_transient("decode_step", attempt, e):
+                    raise
+                attempt += 1
+            except (RequestFailedError, ContextOverflowError) as e:
+                # persistent and attributable: quarantine the culpable
+                # request, containment-preempt the rest, retry next step
+                if e.uid is None or e.uid not in self._all:
+                    raise
+                self._contain(e.uid, e, now)
+                return
+            except PoolExhaustedError:
+                if not self.preemption:
+                    raise
+                # decode-time pool pressure: SOMEONE must yield or no
+                # sequence can progress (and nothing would ever free) —
+                # eviction here is unconditional on priority, lowest first
+                victim = self._pick_victim()
+                if victim is None:
+                    raise
+                self._preempt(victim)
+                return  # retry next step with the shrunken batch
+        dt = time.perf_counter() - t0
+        self._observe_engine_ok("decode", dt)
+        self.metrics.observe_step(dt, len(feed))
         self._absorb(out, now)
 
     # ------------------------------------------------------------------
     # driving surface
     # ------------------------------------------------------------------
     def step(self) -> bool:
-        """One scheduler iteration: expire deadlines, admit, drain stalled
-        prefills, run one decode round. Returns True while work remains."""
+        """One scheduler iteration: poll the breaker, expire deadlines,
+        admit, drain stalled prefills, run one decode round. Returns True
+        while work remains."""
         now = self._clock()
+        self.breaker.poll(now)
         self._expire_deadlines(now)
         self._admit(now)
         if self._stalled:
             self._absorb(self._engine_put([], []), now)
         self._decode_once(now)
         self.metrics.observe_gauges(len(self._queue), len(self._live))
+        self.metrics.observe_resilience(self.breaker, self.watchdog)
         return bool(self._queue or self._live)
 
     def run_until_complete(self) -> None:
@@ -320,11 +500,15 @@ class ContinuousBatchScheduler:
             pass
 
     def stream(self, req: Request) -> Iterator[int]:
-        """Yield ``req``'s tokens as they are generated, driving the loop."""
+        """Yield ``req``'s tokens as they are generated, driving the loop.
+        A quarantined request unblocks its consumer by re-raising the fault
+        that failed it (after yielding every token generated before it)."""
         while True:
             for tok in req.new_tokens():
                 yield tok
             if req.finished:
+                if req.state is RequestState.FAILED and req.error is not None:
+                    raise req.error
                 return
             self.step()
 
@@ -333,15 +517,32 @@ class ContinuousBatchScheduler:
         requests, finish everything that was started — including preempted
         requests waiting in the queue for re-admission — then block on
         outstanding device work (transfer discipline: exiting with transfers
-        queued is the r4 wedge)."""
+        queued is the r4 wedge). With ``watchdog.drain_budget_s`` set the
+        drain is bounded: past the budget, stragglers are cancelled
+        (``reason="drain_timeout"``, counted in ``drain_aborts``) so a sick
+        engine cannot hang shutdown forever."""
         if self._closed:
             return
         self._closed = True
         for req in list(self._queue):
             if req.admitted_time is None:
                 self.cancel(req.uid, reason="drain")
+        budget = self.watchdog.drain_budget_s
+        deadline = None if budget is None else time.perf_counter() + budget
         while self._live or self._queue:
             self.step()
+            if deadline is not None and time.perf_counter() > deadline and (
+                    self._live or self._queue):
+                self.metrics.faults["drain_aborts"] += 1
+                logger.warning(
+                    "serve: drain budget %.3fs exceeded; cancelling %d live "
+                    "+ %d queued stragglers", budget, len(self._live),
+                    len(self._queue))
+                for uid in list(self._live):
+                    self.cancel(uid, reason="drain_timeout")
+                for req in list(self._queue):
+                    self.cancel(req.uid, reason="drain_timeout")
+                break
         import jax
 
         jax.block_until_ready(self.engine.kv)
@@ -369,6 +570,7 @@ class ContinuousBatchScheduler:
         return min((r.arrival_time for r in self._queue), default=None)
 
     def monitor_events(self, step: int = 0) -> List[Event]:
-        """Serving counters plus the engine's prefix-cache counters as one
-        event list for ``MonitorMaster.write_events``."""
+        """Serving counters (``serve/*`` and ``serve/faults/*``) plus the
+        engine's prefix-cache counters as one event list for
+        ``MonitorMaster.write_events``."""
         return self.metrics.events(step) + self.engine.monitor_events(step)

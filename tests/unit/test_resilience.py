@@ -1,0 +1,453 @@
+"""Fault-tolerant serving tests (docs/RESILIENCE.md): the typed fault
+taxonomy, deterministic seeded fault injection, retry/backoff, the circuit
+breaker state machine with load shedding, the step watchdog, scheduler
+failure containment (quarantine to FAILED, containment preemption,
+bitwise-lossless survivors), live-deadline expiry, block-pool accounting
+under every failure path, monitor-sink containment, and a randomized
+(seeded, ``slow``) soak."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience import (BreakerState, CircuitBreaker,
+                                      ContextOverflowError, FaultInjector,
+                                      FaultSpec, PoolExhaustedError,
+                                      RequestFailedError, RetryPolicy,
+                                      SheddingError, StepWatchdog,
+                                      TransientEngineError)
+from deepspeed_tpu.serve import ContinuousBatchScheduler, RequestState
+
+NO_SLEEP = staticmethod(lambda s: None)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _assert_pool_restored(eng):
+    """The satellite invariant: after any failure-path sequence the engine
+    reports the FULL free pool and the fixed-shape bound still holds."""
+    assert not eng.state.seqs
+    assert eng.block_mgr.free_blocks == eng.block_mgr.num_blocks - 1
+    assert eng.query() == (eng.max_seqs,
+                           min(eng.max_seq_len,
+                               eng.block_mgr.free_blocks
+                               * eng.block_mgr.block_size))
+    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+    eng.block_mgr.check_invariants([])
+
+
+class TestTaxonomy:
+    def test_pool_exhaustion_is_typed_with_compat_message(self, setup):
+        """Satellite: the string-matched RuntimeError became
+        PoolExhaustedError at the engine's exhaustion sites, message kept."""
+        m, params = setup
+        eng = _engine(m, params, num_blocks=3, prefix_cache=False)
+        with pytest.raises(PoolExhaustedError, match="exhausted") as ei:
+            eng.put([1], [list(range(40))], greedy=True)
+        assert isinstance(ei.value, RuntimeError)  # compat: old catches work
+        eng.flush(1)
+        # slot-pool exhaustion is typed the same way (message kept)
+        eng2 = _engine(m, params, max_seqs=1)
+        eng2.put([1], [[5, 6, 7]], greedy=True)
+        with pytest.raises(PoolExhaustedError, match="no free KV slots"):
+            eng2.put([2], [[8, 9]], greedy=True)
+        eng2.flush(1)
+
+    def test_context_overflow_is_typed_and_attributed(self, setup):
+        m, params = setup
+        eng = _engine(m, params, num_blocks=64)
+        eng.put([1], [list(range(100))], greedy=True)
+        eng.state.seqs[1].seen_tokens = eng.max_seq_len  # force the wall
+        with pytest.raises(ContextOverflowError) as ei:
+            eng.decode_step({1: 7}, greedy=True)
+        assert ei.value.uid == 1 and isinstance(ei.value, RuntimeError)
+        eng.flush(1)
+
+
+class TestRetryPolicy:
+    def test_deterministic_jitter_and_bounds(self):
+        a = [RetryPolicy(seed=3).delay(k, "put") for k in (1, 2, 3, 4, 5)]
+        b = [RetryPolicy(seed=3).delay(k, "put") for k in (1, 2, 3, 4, 5)]
+        assert a == b  # same seed, same site -> identical backoff schedule
+        assert a != [RetryPolicy(seed=4).delay(k, "put") for k in (1, 2, 3, 4, 5)]
+        base = RetryPolicy(seed=3, jitter=0.0)
+        assert [base.delay(k) for k in (1, 2, 3)] == [0.01, 0.02, 0.04]
+        assert base.delay(9) == base.cap_s  # bounded
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy().delay(0)
+
+
+class TestCircuitBreaker:
+    def test_state_machine_and_shedding(self):
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                            shed_priority_floor=2)
+        t = 0.0
+        assert br.poll(t) is BreakerState.CLOSED
+        br.on_failure(t); br.on_failure(t)
+        assert br.state is BreakerState.CLOSED  # below threshold
+        br.on_success(t)  # resets the consecutive counter
+        br.on_failure(t); br.on_failure(t); br.on_failure(t)
+        assert br.state is BreakerState.OPEN and br.opens == 1
+        assert br.should_shed(priority=0, now=t)
+        assert br.should_shed(priority=1, now=t)
+        assert not br.should_shed(priority=2, now=t)  # at the floor: rides
+        br.on_success(t + 1)  # success during OPEN must NOT close it
+        assert br.state is BreakerState.OPEN
+        assert br.poll(t + 10.0) is BreakerState.HALF_OPEN
+        br.on_failure(t + 10.5)  # failed probe re-arms the cooldown
+        assert br.state is BreakerState.OPEN and br.opens == 2
+        assert br.poll(t + 20.5) is BreakerState.HALF_OPEN
+        br.on_success(t + 21.0)
+        assert br.state is BreakerState.CLOSED and br.closes == 1
+        assert [s for _, s in br.transitions] == [
+            "open", "half_open", "open", "half_open", "closed"]
+        assert not br.should_shed(priority=0, now=t + 22.0)
+
+
+class TestWatchdog:
+    def test_breach_counting_and_escalation(self):
+        wd = StepWatchdog(step_budget_s=0.1, escalate_after=2)
+        assert wd.observe("decode", 0.05) == (False, False)
+        assert wd.observe("decode", 0.2) == (True, False)
+        assert wd.observe("prefill", 0.2) == (True, True)  # 2 consecutive
+        assert wd.observe("decode", 0.2) == (True, False)  # streak reset
+        assert wd.observe("decode", 0.01) == (False, False)
+        assert wd.observe("decode", 0.2) == (True, False)  # fresh streak
+        assert wd.breaches == 4 and wd.escalations == 1
+        assert wd.breaches_by_kind == {"decode": 3, "prefill": 1}
+        assert wd.worst_s == 0.2
+        disabled = StepWatchdog()  # no budget: never breaches
+        assert disabled.observe("decode", 1e9) == (False, False)
+
+
+class TestFaultInjector:
+    def test_plan_validation(self):
+        with pytest.raises(ValueError):
+            FaultSpec(site="bogus", nth=1)
+        with pytest.raises(ValueError):
+            FaultSpec(site="put", kind="transient")  # nth required
+        with pytest.raises(ValueError):
+            FaultSpec(site="put", kind="persistent")  # uid required
+        with pytest.raises(ValueError):  # teardown sites can't be persistent
+            FaultSpec(site="flush", kind="persistent", uid=1)
+
+    def test_deterministic_firing_and_passthrough(self):
+        class Dummy:
+            paged = True
+
+            def put(self, uids, toks, **kw):
+                return {"put": uids}
+
+            def decode_step(self, toks, **kw):
+                return dict(toks)
+
+            def flush(self, uid):
+                return None
+
+            def preempt(self, uid):
+                return 0
+
+        slept = []
+        inj = FaultInjector([
+            dict(site="put", kind="transient", nth=2, count=2),
+            dict(site="decode_step", kind="latency", nth=1, latency_s=0.5),
+            dict(site="decode_step", kind="persistent", uid=9),
+        ], sleep=slept.append)
+        eng = inj.wrap(Dummy())
+        assert eng.paged is True  # non-intercepted attrs pass through
+        assert eng.put([1], [[2]]) == {"put": [1]}  # call 1: clean
+        for _ in range(2):  # calls 2 and 3: the transient burst
+            with pytest.raises(TransientEngineError):
+                eng.put([1], [[2]])
+        assert eng.put([1], [[2]]) == {"put": [1]}  # call 4: clean again
+        assert eng.decode_step({3: 7}) == {3: 7}  # latency, not an error
+        assert slept == [0.5]
+        with pytest.raises(RequestFailedError) as ei:
+            eng.decode_step({9: 1, 3: 2})  # persistent: fires on uid match
+        assert ei.value.uid == 9
+        assert eng.flush(9) is None and eng.preempt(9) == 0
+        assert inj.fired == {"transient": 2, "persistent": 1, "latency": 1}
+        inj.enabled = False  # kill switch
+        eng.decode_step({9: 1})
+        assert inj.fired["persistent"] == 1
+
+    def test_random_plan_is_seeded(self):
+        a = FaultInjector.random_plan(5, horizon=100, rate=0.1).specs
+        b = FaultInjector.random_plan(5, horizon=100, rate=0.1).specs
+        assert a == b and len(a) > 0
+        assert a != FaultInjector.random_plan(6, horizon=100, rate=0.1).specs
+
+
+def _run_workload(m, params, n_req, *, injector=None, breaker=None,
+                  persistent_index=None, seed=17, **sched_kw):
+    """Submit ``n_req`` seeded requests, run to completion, return
+    (scheduler, engine, requests in submission order)."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 128, int(rng.integers(8, 25))).tolist()
+               for _ in range(n_req)]
+    gens = [int(rng.integers(3, 7)) for _ in range(n_req)]
+    eng = _engine(m, params)
+    driven = eng if injector is None else injector.wrap(eng)
+    sched = ContinuousBatchScheduler(
+        driven, breaker=breaker or CircuitBreaker(),
+        retry=RetryPolicy(max_attempts=5), sleep=lambda s: None, **sched_kw)
+    reqs = [sched.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    if persistent_index is not None:
+        injector.inject(site="decode_step", kind="persistent",
+                        uid=reqs[persistent_index].uid)
+    sched.run_until_complete()
+    return sched, eng, reqs
+
+
+@pytest.mark.chaos
+class TestChaosContainment:
+    def test_chaos_30_requests_bitwise_with_one_quarantine(self, setup):
+        """The acceptance scenario: transient put/decode faults plus one
+        persistent per-request fault into a 30-request load. All non-failed
+        requests finish with tokens bitwise-identical to a fault-free run,
+        exactly one request ends FAILED, the pool returns to full, and the
+        breaker walks open -> half_open -> closed."""
+        m, params = setup
+        n = 30
+        _, ref_eng, ref = _run_workload(m, params, n)
+        assert all(r.state is RequestState.DONE for r in ref)
+        _assert_pool_restored(ref_eng)
+
+        inj = FaultInjector([
+            dict(site="put", kind="transient", nth=2, count=2),
+            dict(site="decode_step", kind="transient", nth=5, count=3),
+        ])
+        # cooldown 0: OPEN -> HALF_OPEN on the next poll, the probe is the
+        # next engine call — the recovery walk is deterministic
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=0.0,
+                            shed_priority_floor=1)
+        sched, eng, reqs = _run_workload(m, params, n, injector=inj,
+                                         breaker=br, persistent_index=7)
+        failed = [r for r in reqs if r.state is RequestState.FAILED]
+        assert [reqs.index(f) for f in failed] == [7]  # exactly one FAILED
+        assert isinstance(failed[0].error, RequestFailedError)
+        for i, r in enumerate(reqs):
+            if i == 7:
+                continue
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[i].tokens, f"request {i} diverged"
+        # streaming consumers are unblocked WITH the error
+        with pytest.raises(RequestFailedError):
+            list(sched.stream(failed[0]))
+        assert sched.metrics.failed == 1
+        assert sched.metrics.faults["transient_faults"] == 5
+        assert sched.metrics.faults["persistent_faults"] == 1
+        assert sched.metrics.faults["containment_preemptions"] > 0
+        assert inj.fired == {"transient": 5, "persistent": 1, "latency": 0}
+        trans = [s for _, s in br.transitions]
+        assert trans[:1] == ["open"] and "half_open" in trans
+        assert trans[-1] == "closed"
+        _assert_pool_restored(eng)
+        # fault counters fan into the monitor surface
+        labels = {e[0] for e in sched.monitor_events(step=1)}
+        assert "serve/faults/failed_requests" in labels
+        assert "serve/faults/breaker_state" in labels
+
+    def test_pool_accounting_under_failure_paths(self, setup):
+        """Satellite: quarantine / cancel / preempt / double-flush in one
+        run, with and without injected faults — the pool must come back
+        whole every time."""
+        m, params = setup
+        for use_faults in (False, True):
+            inj = FaultInjector([dict(site="put", kind="transient", nth=3)]
+                                ) if use_faults else None
+            rng = np.random.default_rng(23)
+            eng = _engine(m, params)
+            driven = eng if inj is None else inj.wrap(eng)
+            sched = ContinuousBatchScheduler(
+                driven, retry=RetryPolicy(max_attempts=3),
+                sleep=lambda s: None)
+            reqs = [sched.submit(rng.integers(0, 128, 20).tolist(),
+                                 max_new_tokens=8) for _ in range(4)]
+            for _ in range(3):
+                sched.step()
+            if inj is not None:
+                inj.inject(site="decode_step", kind="persistent",
+                           uid=reqs[1].uid)
+            sched.cancel(reqs[0].uid)               # cancel a live request
+            live = [r for r in reqs[2:] if not r.finished
+                    and r.uid in sched._live]
+            if live:
+                sched._preempt(live[0])             # explicit preemption
+            eng.flush(reqs[0].uid)                  # double flush: no-op
+            sched.run_until_complete()
+            sched.close()
+            for r in reqs:
+                assert r.finished
+            if inj is not None:
+                assert reqs[1].state is RequestState.FAILED
+            _assert_pool_restored(eng)
+
+    def test_transient_giveup_propagates_after_bounded_retries(self, setup):
+        """An unbounded transient storm must NOT spin forever: after
+        max_attempts the typed error escapes step() (the supervisor's
+        problem), with every retry counted."""
+        m, params = setup
+        inj = FaultInjector([dict(site="put", kind="transient", nth=1,
+                                  count=10_000)])
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(
+            inj.wrap(eng), retry=RetryPolicy(max_attempts=3),
+            sleep=lambda s: None)
+        sched.submit([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(TransientEngineError):
+            sched.run_until_complete()
+        assert sched.metrics.faults["retry_giveups"] == 1
+        assert sched.metrics.faults["transient_retries"] == 2
+
+
+class TestSchedulerResilience:
+    def test_live_deadline_expiry_flushes_blocks(self, setup):
+        """Satellite: a LIVE request past its deadline is cancelled and its
+        blocks flushed — not just queued ones."""
+        m, params = setup
+        eng = _engine(m, params)
+        vt = [0.0]
+        sched = ContinuousBatchScheduler(eng, clock=lambda: vt[0])
+        req = sched.submit([1, 2, 3, 4], max_new_tokens=50, deadline=5.0)
+        sched.step()
+        assert req.state is RequestState.DECODE  # live, well before deadline
+        assert eng.state.seqs  # holding blocks
+        vt[0] = 6.0
+        sched.step()
+        assert req.state is RequestState.CANCELLED
+        assert req.cancel_reason == "deadline"
+        assert sched.metrics.deadline_cancels == 1
+        _assert_pool_restored(eng)
+
+    def test_breaker_sheds_below_floor_and_recovers(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        vt = [0.0]
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=10.0,
+                            shed_priority_floor=1)
+        sched = ContinuousBatchScheduler(eng, clock=lambda: vt[0], breaker=br)
+        br.on_failure(0.0); br.on_failure(0.0)  # open it
+        with pytest.raises(SheddingError):
+            sched.submit([1, 2], priority=0)
+        assert sched.metrics.faults["shed"] == 1
+        vip = sched.submit([1, 2], priority=1, max_new_tokens=2)  # at floor
+        vt[0] = 11.0  # past cooldown: half-open lets the probe through
+        low = sched.submit([3, 4], priority=0, max_new_tokens=2)
+        sched.run_until_complete()
+        assert vip.state is RequestState.DONE
+        assert low.state is RequestState.DONE
+        assert br.state is BreakerState.CLOSED  # probe succeeded
+        assert [s for _, s in br.transitions] == ["open", "half_open",
+                                                  "closed"]
+
+    def test_watchdog_escalates_slow_steps_to_breaker(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        wd = StepWatchdog(step_budget_s=1e-9, escalate_after=2)
+        br = CircuitBreaker(failure_threshold=2, cooldown_s=1e9)
+        sched = ContinuousBatchScheduler(eng, watchdog=wd, breaker=br)
+        r = sched.submit([5, 6, 7], max_new_tokens=6)
+        sched.run_until_complete()
+        assert r.state is RequestState.DONE  # slowness degrades, not fails
+        assert wd.breaches > 0 and wd.escalations > 0
+        assert br.state is BreakerState.OPEN  # sustained slowness opened it
+        assert sched.metrics.faults["watchdog_breaches"] == wd.breaches
+        _assert_pool_restored(eng)
+
+    def test_bounded_drain_cancels_stragglers(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        wd = StepWatchdog(drain_budget_s=0.0)
+        sched = ContinuousBatchScheduler(eng, watchdog=wd)
+        req = sched.submit([1, 2, 3], max_new_tokens=100)
+        queued = sched.submit([4, 5], max_new_tokens=100)
+        sched.step()
+        assert req.state is RequestState.DECODE
+        sched.close()  # budget 0: one step, then cancel the stragglers
+        assert req.state is RequestState.CANCELLED
+        assert req.cancel_reason == "drain_timeout"
+        assert queued.state is RequestState.CANCELLED
+        assert sched.metrics.faults["drain_aborts"] == 1
+        _assert_pool_restored(eng)
+
+
+class TestMonitorContainment:
+    def test_flaky_sink_is_contained_then_disabled(self):
+        from deepspeed_tpu.monitor import MonitorMaster
+
+        class FlakySink:
+            enabled = True
+            calls = 0
+
+            def write_events(self, events):
+                FlakySink.calls += 1
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        mm = MonitorMaster({})
+        mm.csv_monitor = FlakySink()
+        mm.enabled = True
+        for i in range(5):  # never raises into the serving loop
+            mm.write_events([("serve/faults/shed", 1.0, i)])
+        assert FlakySink.calls == mm.sink_failure_threshold  # then disabled
+        assert not mm.csv_monitor.enabled
+        mm.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_randomized_soak_is_lossless(setup):
+    """Seeded randomized soak: transient bursts sprayed over put/decode at
+    random call indices; with an outer supervisor retrying give-ups, every
+    request still finishes with fault-free-identical tokens and the pool
+    comes back whole."""
+    m, params = setup
+    n = 24
+    _, _, ref = _run_workload(m, params, n, seed=31)
+    inj = FaultInjector.random_plan(97, horizon=600, rate=0.04, max_burst=2,
+                                    sleep=lambda s: None)
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, 128, int(rng.integers(8, 25))).tolist()
+               for _ in range(n)]
+    gens = [int(rng.integers(3, 7)) for _ in range(n)]
+    eng = _engine(m, params)
+    sched = ContinuousBatchScheduler(inj.wrap(eng),
+                                     retry=RetryPolicy(max_attempts=4),
+                                     sleep=lambda s: None)
+    reqs = [sched.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    for _ in range(100_000):  # outer supervisor: ride out retry give-ups
+        try:
+            if not sched.step():
+                break
+        except TransientEngineError:
+            continue
+    else:
+        raise AssertionError("soak did not converge")
+    assert all(r.state is RequestState.DONE for r in reqs)
+    assert [r.tokens for r in reqs] == [r.tokens for r in ref]
+    assert inj.fired["transient"] > 0  # the storm actually happened
+    _assert_pool_restored(eng)
