@@ -51,7 +51,7 @@ class InferenceEngineV2:
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
                  num_blocks: Optional[int] = None, token_budget: int = 0,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, decode_horizon: int = 1):
         self.model = model
         self.cfg = model.config
         # default serving width: paged mode shares one block pool so 32 slots
@@ -70,6 +70,16 @@ class InferenceEngineV2:
         # Default: enough rows for a full decode round plus prefill headroom
         # (bench_serve.py load-tests at 256)
         self.token_budget = token_budget or max(max_seqs, min(prefill_chunk, 256))
+        # fused multi-token decode (docs/SERVING.md): the ONE extra horizon
+        # the engine may compile besides 1 — horizons are restricted to
+        # {1, decode_horizon} so the compiled-program bound grows by exactly
+        # one shape (fixed-shape trace discipline, see fused_cache_size)
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got {decode_horizon}")
+        if decode_horizon > 1 and not paged:
+            raise ValueError("decode_horizon > 1 is paged-mode only (the "
+                             "fused loop runs over the blocked pool)")
+        self.decode_horizon = decode_horizon
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
 
@@ -89,6 +99,14 @@ class InferenceEngineV2:
         self._prefill_fns = {}
         self._decode_fn = None
         self._cow_fn = None
+        self._fused_fn = None
+        # per-shape host scratch for the ragged/fused step inputs: reused
+        # (zeroed in place) instead of np.zeros every step — the steady-state
+        # decode loop must not pay a fresh allocation per dispatch. Safe to
+        # reuse even if jax aliases the host buffer: every step materializes
+        # its outputs (np.asarray) before the next step refills the scratch,
+        # so the previous dispatch has fully consumed its inputs.
+        self._scratch: Dict[Tuple, Tuple[np.ndarray, ...]] = {}
         self.prefix_cache = bool(prefix_cache) and paged
         if paged:
             # paged-block pool (reference BlockedKVCache): total KV memory is
@@ -107,6 +125,7 @@ class InferenceEngineV2:
                 f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
                 f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk} "
                 f"token_budget={self.token_budget} "
+                f"decode_horizon={self.decode_horizon} "
                 f"prefix_cache={'on' if self.prefix_cache else 'off'}",
                 ranks=[0],
             )
@@ -234,6 +253,43 @@ class InferenceEngineV2:
             self._cow_fn = jax.jit(cow, donate_argnums=(0,))
         return self._cow_fn
 
+    def _get_fused(self):
+        """THE fused decode program: one compiled ``lax.scan`` over
+        ``decode_horizon`` greedy rounds for the full ``max_seqs`` row batch
+        (inactive rows carry the all-zero table → trash block 0). Compiled
+        for exactly ONE horizon (the engine's ``decode_horizon``), so it adds
+        exactly one shape to the compiled-program bound."""
+        if self._fused_fn is None:
+            model = self.model
+            K = self.decode_horizon
+
+            def fused(params, pool, toks, tables, starts):
+                return model.decode_paged_multi(params, pool, toks, tables,
+                                                starts, K)
+
+            self._fused_fn = jax.jit(fused, donate_argnums=(1,))
+        return self._fused_fn
+
+    def _scratch_for(self, key: Tuple, shapes) -> Tuple[np.ndarray, ...]:
+        """Per-shape preallocated int32 host arrays, zeroed in place."""
+        bufs = self._scratch.get(key)
+        if bufs is None:
+            bufs = tuple(np.zeros(s, np.int32) for s in shapes)
+            self._scratch[key] = bufs
+        else:
+            for a in bufs:
+                a.fill(0)
+        return bufs
+
+    @property
+    def fused_cache_size(self) -> int:
+        """Number of compiled traces of the fused multi-step decode program.
+        Bounded at <= 1: the engine only ever compiles its own
+        ``decode_horizon`` (horizon 1 rides the ragged program). Together
+        with ``ragged_cache_size <= 4`` the paged engine's total step-program
+        bound is 5 — still O(1) in the load."""
+        return 0 if self._fused_fn is None else self._fused_fn._cache_size()
+
     @property
     def ragged_cache_size(self) -> int:
         """Number of compiled traces of the ragged-step program. Bounded at
@@ -299,10 +355,10 @@ class InferenceEngineV2:
                             src, dst = self.block_mgr.copy_on_write(d, j)
                             self.kv = self._get_cow()(
                                 self.kv, jnp.int32(src), jnp.int32(dst))
-            ids = np.zeros((T, 1), np.int32)
-            tables = np.zeros((T, self.block_mgr.max_blocks_per_seq), np.int32)
-            starts = np.zeros((T,), np.int32)
-            logit_rows = np.zeros((self.max_seqs,), np.int32)
+            ids, tables, starts, logit_rows = self._scratch_for(
+                ("ragged", T),
+                ((T, 1), (T, self.block_mgr.max_blocks_per_seq), (T,),
+                 (self.max_seqs,)))
             finals = []
             r = 0
             for d, take in plan:
@@ -469,6 +525,128 @@ class InferenceEngineV2:
         lg = np.asarray(lg)
         return {uid: (int(lg[slot]) if greedy else lg[slot])
                 for slot, uid in by_slot.items()}
+
+    def decode_multi(self, tokens: Dict[int, int],
+                     horizon: int) -> Dict[int, List[int]]:
+        """Fused multi-token greedy decode (docs/SERVING.md): feed each live
+        uid its last sampled token and advance ``horizon`` rounds in ONE
+        compiled dispatch — on-device argmax feeds each round's tokens back
+        as the next round's inputs, and a single ``(max_seqs, horizon)``
+        int32 transfer ships the results. Returns ``{uid: [t1..tK]}``; the
+        last token of each list is sampled but NOT yet written to the cache
+        (exactly the ``decode_step`` contract, K times over).
+
+        Horizons are restricted to ``{1, decode_horizon}``: 1 delegates to
+        the ragged decode round, ``decode_horizon`` runs the one fused
+        program — the compiled-program bound grows by exactly one shape.
+
+        Blocks for all ``horizon`` writes are pre-allocated up front and the
+        step's generated tokens are NOT registered in the prefix-cache
+        content index — :meth:`rollback` commits (and optionally truncates)
+        them once the scheduler knows which tokens are kept, so the index
+        never covers discarded overrun tokens. Validation is all-or-nothing:
+        a context/pool raise leaves every descriptor intact and the step can
+        be retried verbatim."""
+        if not self.paged:
+            raise ValueError("decode_multi is paged-mode only")
+        if horizon == 1:
+            return {u: [t] for u, t in
+                    self.decode_step(tokens, greedy=True).items()}
+        if horizon != self.decode_horizon:
+            raise ValueError(
+                f"horizon {horizon} not in {{1, {self.decode_horizon}}} — "
+                "fixed-shape discipline: the engine compiles exactly one "
+                "fused horizon (set decode_horizon at construction)")
+        if not tokens:
+            return {}
+        if len(tokens) > self.max_seqs:
+            raise RuntimeError(
+                f"batch of {len(tokens)} exceeds {self.max_seqs} slots")
+        K = horizon
+        for uid in tokens:
+            d = self.state.seqs[uid]  # unknown uid: loud KeyError
+            if d.in_flight:
+                raise RuntimeError(
+                    f"uid {uid}: {d.in_flight} pending prefill tokens — "
+                    "drain before fused decode")
+            if d.seen_tokens + K > self.max_seq_len:
+                raise ContextOverflowError(
+                    f"uid {uid}: fused horizon {K} exceeds context "
+                    f"({d.seen_tokens}+{K} > {self.max_seq_len}); collapse "
+                    "to horizon 1 or flush the sequence", uid=uid)
+        # pre-allocate the WHOLE horizon's blocks before dispatch (positions
+        # seen .. seen+K-1); a PoolExhaustedError here leaves seen_tokens/
+        # history untouched — allocated blocks are used by the retried step
+        for uid in tokens:
+            d = self.state.seqs[uid]
+            self.block_mgr.ensure(d, d.seen_tokens + K)
+        descs = sorted((self.state.seqs[u] for u in tokens),
+                       key=lambda d: d.slot)
+        if self.prefix_cache:
+            # copy-on-write for every block the K writes can land in —
+            # shared blocks are immutable (same discipline as _put_paged)
+            bs = self.block_mgr.block_size
+            for d in descs:
+                first = d.seen_tokens // bs
+                last = min((d.seen_tokens + K - 1) // bs, len(d.blocks) - 1)
+                for j in range(first, last + 1):
+                    if self.block_mgr.refcount(d.blocks[j]) > 1:
+                        src, dst = self.block_mgr.copy_on_write(d, j)
+                        self.kv = self._get_cow()(
+                            self.kv, jnp.int32(src), jnp.int32(dst))
+        B = self.max_seqs
+        toks, tables, starts = self._scratch_for(
+            ("fused", B), ((B,), (B, self.block_mgr.max_blocks_per_seq), (B,)))
+        for r, d in enumerate(descs):
+            toks[r] = tokens[d.uid]
+            tables[r] = self.block_mgr.table_row(d)
+            starts[r] = d.seen_tokens
+        ys, self.kv = self._get_fused()(
+            self.params, self.kv, jnp.asarray(toks), jnp.asarray(tables),
+            jnp.asarray(starts))
+        ys = np.asarray(ys)  # (max_seqs, K); one transfer per K tokens
+        out: Dict[int, List[int]] = {}
+        for r, d in enumerate(descs):
+            seq = [int(t) for t in ys[r]]
+            if self.prefix_cache:
+                # cache now holds the fed token plus the first K-1 samples
+                d.history.append(int(tokens[d.uid]))
+                d.history.extend(seq[:-1])
+            d.seen_tokens += K
+            out[d.uid] = seq
+        return out
+
+    def rollback(self, uid: int, n: int = 0) -> int:
+        """Truncate the last ``n`` cached tokens of a live sequence and
+        commit the rest — the scheduler's overrun path for fused decode
+        (tokens generated past EOS/max_new_tokens/deadline are discarded).
+        Truncation shrinks ``seen_tokens``/``history``, releases the
+        over-allocated tail blocks refcount-exactly, and only THEN registers
+        the kept full blocks in the prefix-cache content index — discarded
+        tokens are never indexed. ``n=0`` is the pure commit. Idempotent on
+        unknown uids (returns 0), like :meth:`flush`. Returns the number of
+        block references released."""
+        if not self.paged:
+            raise ValueError("rollback is paged-mode only")
+        d = self.state.seqs.get(uid)
+        if d is None:
+            return 0
+        freed = 0
+        if n:
+            if n < 0 or n >= d.seen_tokens:
+                raise ValueError(
+                    f"uid {uid}: cannot roll back {n} of {d.seen_tokens} "
+                    "cached tokens (at least one must remain)")
+            if d.in_flight:
+                raise RuntimeError(
+                    f"uid {uid}: rollback with {d.in_flight} pending tokens")
+            d.seen_tokens -= n
+            if self.prefix_cache:
+                del d.history[-n:]
+            freed = self.block_mgr.rollback(d, d.seen_tokens)
+        if self.prefix_cache:
+            self.block_mgr.register(d)
+        return freed
 
     def flush(self, uid: int):
         """Release a sequence's slot and (paged) KV blocks. Explicitly

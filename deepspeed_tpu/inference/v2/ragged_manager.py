@@ -188,6 +188,22 @@ class BlockedKVCache:
         row[: len(desc.blocks)] = desc.blocks
         return row
 
+    def rollback(self, desc: SequenceDescriptor, n_tokens: int) -> int:
+        """Release ``desc``'s trailing blocks past what ``n_tokens`` logical
+        positions need (the fused-decode overrun path: a K-step dispatch
+        pre-allocates K tokens of blocks; tokens past EOS/max_new_tokens are
+        then truncated). Refcount-exact for shared tails — a block mapped in
+        by a prefix-cache hit simply drops one reference (parking in the LRU
+        if it was the last), it is never force-freed. Returns the number of
+        references released."""
+        keep = self.blocks_needed(n_tokens)
+        freed = 0
+        while len(desc.blocks) > keep:
+            self._decref(desc.blocks.pop())
+            freed += 1
+        desc.n_indexed = min(desc.n_indexed, len(desc.blocks))
+        return freed
+
     def free(self, desc: SequenceDescriptor):
         for b in desc.blocks:
             self._decref(b)

@@ -1107,6 +1107,33 @@ class TransformerLM:
         lg = self._head(params, x_last[:, None])[:, 0]
         return lg, (nkp, nvp)
 
+    def decode_paged_multi(self, params, kv_pool, toks, tables, starts, k: int):
+        """Fused K-step greedy decode against the blocked pool: a single
+        ``lax.scan`` over ``k`` rounds, each running the length-1
+        ``forward_paged`` for all rows and feeding the on-device argmax back
+        as the next round's input — one dispatch and one (B, k) int32
+        transfer per k tokens instead of k of each (the per-token host
+        round-trip is steady-state serving's latency floor).
+
+        ``toks`` (B,) int32: each row's last sampled token, written at
+        position ``starts[r]`` in round 0. ``tables`` (B, MAXB) block tables
+        (all-zero rows = inactive padding, writes land in trash block 0) and
+        must already cover positions ``starts .. starts+k-1``. Returns
+        ``((B, k) sampled tokens, new pool)``. Each round computes exactly
+        what the ragged decode-round program computes per row (same S=1
+        ``forward_paged``, same argmax), so a k-step fused decode is bitwise
+        identical under greedy to k single steps."""
+
+        def round_(carry, _):
+            pool, t, pos = carry
+            lg, pool = self.forward_paged(params, t[:, None], pool, tables, pos)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (pool, nxt, pos + 1), nxt
+
+        (kv_pool, _, _), ys = jax.lax.scan(
+            round_, (kv_pool, toks, starts), None, length=int(k))
+        return ys.T, kv_pool  # (B, k)
+
     def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
         """Like ``forward_with_cache_all`` but projects only the LAST position
         (B, V) — the decode/prefill hot path skips the (S, V) logits matmul."""

@@ -2,9 +2,10 @@
 
 At TPU-fleet scale faults are the steady state; a serving loop that has only
 ever seen healthy engines is untested where it matters. ``FaultInjector``
-injects faults at the four engine call sites the scheduler uses — ``put``,
-``decode_step``, ``flush``, ``preempt`` — through :class:`InjectedEngine`, a
-transparent proxy the scheduler cannot distinguish from the real engine.
+injects faults at the engine call sites the scheduler uses — ``put``,
+``decode_step``, ``decode_multi``, ``flush``, ``preempt`` — through
+:class:`InjectedEngine`, a transparent proxy the scheduler cannot
+distinguish from the real engine.
 
 **Contract: faults fire BEFORE the wrapped call delegates.** The real
 engine's host state is never mutated by a faulted call, so a retried call
@@ -41,8 +42,8 @@ import numpy as np
 from .errors import RequestFailedError, TransientEngineError
 
 #: the engine surface the scheduler drives (and therefore the fault surface)
-SITES = ("put", "decode_step", "flush", "preempt")
-_PERSISTENT_SITES = ("put", "decode_step")
+SITES = ("put", "decode_step", "decode_multi", "flush", "preempt")
+_PERSISTENT_SITES = ("put", "decode_step", "decode_multi")
 
 
 @dataclass
@@ -163,7 +164,7 @@ class FaultInjector:
 class InjectedEngine:
     """Fault-injecting proxy over an ``InferenceEngineV2`` (duck-typed).
 
-    Only the four scheduler-facing methods are intercepted; every other
+    Only the scheduler-facing step/teardown methods are intercepted; every other
     attribute (``state``, ``kv``, ``paged``, ``query``, …) resolves straight
     through to the inner engine, so the scheduler, the bench, and the tests
     are oblivious to the wrapping."""
@@ -179,6 +180,12 @@ class InjectedEngine:
     def decode_step(self, tokens, *a, **kw):
         self.injector.on_call("decode_step", list(tokens))
         return self.inner.decode_step(tokens, *a, **kw)
+
+    def decode_multi(self, tokens, *a, **kw):
+        # fires BEFORE delegation like every site: a faulted fused step never
+        # half-advances the horizon — the retry re-runs the WHOLE step
+        self.injector.on_call("decode_multi", list(tokens))
+        return self.inner.decode_multi(tokens, *a, **kw)
 
     def flush(self, uid):
         self.injector.on_call("flush", [uid])

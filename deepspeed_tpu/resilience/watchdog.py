@@ -36,10 +36,18 @@ class StepWatchdog:
         self.breaches_by_kind: Dict[str, int] = {}
         self._consecutive = 0
 
-    def observe(self, kind: str, duration_s: float) -> Tuple[bool, bool]:
-        """Record one step; returns ``(breached, escalated)``."""
+    def observe(self, kind: str, duration_s: float,
+                scale: float = 1.0) -> Tuple[bool, bool]:
+        """Record one step; returns ``(breached, escalated)``.
+
+        ``scale`` multiplies the budget for this observation: a fused
+        K-step decode dispatch (docs/SERVING.md) legitimately takes ~K× the
+        wall clock of a single step, so the scheduler passes its horizon —
+        per-token slowness still breaches, amortized bulk work does not."""
         self.worst_s = max(self.worst_s, duration_s)
-        if self.step_budget_s is None or duration_s <= self.step_budget_s:
+        budget = (None if self.step_budget_s is None
+                  else self.step_budget_s * scale)
+        if budget is None or duration_s <= budget:
             self._consecutive = 0
             return False, False
         self.breaches += 1

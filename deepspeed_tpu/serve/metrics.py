@@ -37,8 +37,16 @@ class ServeMetrics:
         self.live = 0                # gauge, refreshed each step
         self.queue_peak = 0
         self.ttft_s: List[float] = []        # admission-arrival -> first token
-        self.step_lat_s: List[float] = []    # decode-step wall time
-        self.step_batch: List[int] = []      # decode-step batch size
+        self.step_lat_s: List[float] = []    # decode-dispatch wall time
+        self.step_batch: List[int] = []      # decode-dispatch batch × horizon
+        #: fused multi-token decode counters (docs/SERVING.md), exported
+        #: under ``serve/decode/*``: the horizon of the latest dispatch
+        #: (gauge — 1 whenever the adaptive horizon collapses), how many
+        #: dispatches ran fused, and how many overrun tokens (past EOS /
+        #: max_new_tokens) were rolled back. ``tokens_generated`` counts only
+        #: KEPT tokens — rolled-back tokens are never emitted.
+        self.decode: Dict[str, float] = {
+            "horizon": 1.0, "fused_steps": 0, "rollback_tokens": 0}
         #: resilience counters, exported under ``serve/faults/*``
         #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
         #: step, the rest are incremented by the scheduler as faults land
@@ -59,9 +67,20 @@ class ServeMetrics:
             "breaker_state": 0.0,         # gauge: 0 closed, 1 half, 2 open
         }
 
-    def observe_step(self, latency_s: float, batch: int) -> None:
+    def observe_step(self, latency_s: float, batch: int,
+                     horizon: int = 1) -> None:
+        """One decode dispatch: ``batch`` sequences advanced ``horizon``
+        tokens each — ``step_batch`` records tokens per dispatch."""
         self.step_lat_s.append(latency_s)
-        self.step_batch.append(batch)
+        self.step_batch.append(batch * horizon)
+
+    def observe_decode(self, horizon: int, fused: bool) -> None:
+        self.decode["horizon"] = float(horizon)
+        if fused:
+            self.decode["fused_steps"] += 1
+
+    def observe_rollback(self, n_tokens: int) -> None:
+        self.decode["rollback_tokens"] += n_tokens
 
     def observe_gauges(self, queue_depth: int, live: int) -> None:
         self.queue_depth = queue_depth
@@ -109,5 +128,7 @@ class ServeMetrics:
         ``serve/faults/``."""
         return ([(f"serve/{k}", float(v), step)
                  for k, v in sorted(self.summary().items())]
+                + [(f"serve/decode/{k}", float(v), step)
+                   for k, v in sorted(self.decode.items())]
                 + [(f"serve/faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])

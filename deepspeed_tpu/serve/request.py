@@ -51,6 +51,11 @@ class Request:
     priority: int = 0
     deadline: Optional[float] = None
     arrival_time: float = 0.0
+    #: stop token: generation finishes once this token is emitted (it IS
+    #: emitted — the consumer sees it). Under fused multi-token decode the
+    #: ≤K−1 tokens a horizon generates past it are rolled back
+    #: (docs/SERVING.md), so the output is identical to single-step decode.
+    eos_token: Optional[int] = None
     uid: int = field(default_factory=lambda: next(_uid_counter))
     #: streaming callback, invoked as ``on_token(request, token)`` per token
     on_token: Optional[Callable[["Request", int], None]] = None

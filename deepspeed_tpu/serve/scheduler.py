@@ -34,6 +34,16 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   and restores service through a half-open probe. Capacity signals
   (``PoolExhaustedError``) stay what they were: preemption pressure, never
   breaker failures.
+- **fused multi-token decode** (docs/SERVING.md): when the engine was built
+  with ``decode_horizon=K``, steady-state decode rounds run K tokens per
+  compiled dispatch (``engine.decode_multi``) instead of one — the per-token
+  host overhead (dispatch, transfer, scheduler iteration) is amortized K×.
+  An **adaptive horizon** collapses to 1 whenever fusing could hurt TTFT or
+  SLA behavior (pending admissions, stalled prefill, <K tokens remaining, a
+  deadline inside the horizon's wall-clock budget), and the ≤K−1 overrun
+  tokens a horizon generates past ``max_new_tokens``/EOS are **rolled
+  back** (``engine.rollback``) so output, block accounting, and the prefix
+  index are bitwise identical to single-step decode under greedy.
 - **streaming**: per-token callbacks (``Request.on_token``) and a pull
   iterator (:meth:`stream`) that drives the loop.
 - **graceful drain**: :meth:`close` rejects new admits, cancels
@@ -45,7 +55,8 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   stragglers are cancelled rather than hanging shutdown forever.
 
 Everything here is host-side bookkeeping; the fixed-shape contract of the
-paged engine is untouched (``ragged_cache_size <= 4`` under any schedule).
+paged engine is untouched (``ragged_cache_size <= 4`` plus at most ONE
+fused-horizon program, ``fused_cache_size <= 1``, under any schedule).
 """
 
 import time
@@ -96,8 +107,24 @@ class ContinuousBatchScheduler:
                  retry: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog: Optional[StepWatchdog] = None,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 decode_horizon: Optional[int] = None):
         self.engine = engine
+        # fused multi-token decode (docs/SERVING.md): the horizon K the
+        # decode loop MAY run at — defaults to the engine's compiled horizon.
+        # The adaptive policy (_effective_horizon) collapses to 1 whenever
+        # fusing could hurt TTFT or SLA behavior.
+        if decode_horizon is None:
+            decode_horizon = getattr(engine, "decode_horizon", 1)
+        elif decode_horizon != 1 and decode_horizon != getattr(
+                engine, "decode_horizon", 1):
+            raise ValueError(
+                f"decode_horizon {decode_horizon} does not match the "
+                f"engine's compiled horizon "
+                f"{getattr(engine, 'decode_horizon', 1)} (horizons are "
+                "restricted to {1, K} — the fixed-shape discipline)")
+        self.decode_horizon = decode_horizon
+        self._token_est_s = 0.0  # EMA per-token dispatch wall (deadline guard)
         self.max_queue = max_queue
         self.age_weight = age_weight
         self.deadline_weight = deadline_weight
@@ -122,7 +149,8 @@ class ContinuousBatchScheduler:
     def submit(self, prompt, *, max_new_tokens: int = 32, priority: int = 0,
                deadline: Optional[float] = None,
                arrival_time: Optional[float] = None,
-               on_token=None, uid: Optional[int] = None) -> Request:
+               on_token=None, uid: Optional[int] = None,
+               eos_token: Optional[int] = None) -> Request:
         """Enqueue a request; raises :class:`QueueFullError` on backpressure,
         :class:`SheddingError` while the circuit breaker sheds load, and
         :class:`SchedulerClosedError` after :meth:`close`."""
@@ -150,7 +178,7 @@ class ContinuousBatchScheduler:
                       priority=priority, deadline=deadline,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time),
-                      on_token=on_token, **kw)
+                      on_token=on_token, eos_token=eos_token, **kw)
         if req.uid in self._all and not self._all[req.uid].finished:
             raise ValueError(f"uid {req.uid} is already in flight")
         self._all[req.uid] = req
@@ -218,12 +246,15 @@ class ContinuousBatchScheduler:
                     raise
                 attempt += 1
 
-    def _observe_engine_ok(self, kind: str, duration_s: float) -> None:
+    def _observe_engine_ok(self, kind: str, duration_s: float,
+                           scale: float = 1.0) -> None:
         """A successful engine call: feed the watchdog; a budget breach is
         NOT a success for the breaker (a slow-but-alive engine must be able
-        to open it), and an escalation counts as a failure outright."""
+        to open it), and an escalation counts as a failure outright.
+        ``scale`` is the decode horizon: a K-step fused dispatch gets K× the
+        step budget (its wall clock is ~K single steps of legitimate work)."""
         now = self._clock()
-        breached, escalated = self.watchdog.observe(kind, duration_s)
+        breached, escalated = self.watchdog.observe(kind, duration_s, scale)
         if not breached:
             self.breaker.on_success(now)
         elif escalated:
@@ -416,6 +447,18 @@ class ContinuousBatchScheduler:
                 self._preempt(victim)
                 uids, token_lists = [], []  # drain engine-held pending
 
+    def _emit_token(self, req: Request, tok: int, now: float) -> bool:
+        """Deliver one kept token; True when it finishes the request
+        (max_new_tokens reached, or the stop token was emitted)."""
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.metrics.ttft_s.append(now - req.arrival_time)
+        req.state = RequestState.DECODE
+        req._emit(tok)
+        self.metrics.tokens_generated += 1
+        return req.remaining == 0 or (req.eos_token is not None
+                                      and tok == req.eos_token)
+
     def _absorb(self, out: Dict[int, np.ndarray], now: float) -> None:
         for uid, val in out.items():
             req = self._live.get(uid)
@@ -423,13 +466,35 @@ class ContinuousBatchScheduler:
                 self._engine_flush(uid)
                 continue
             tok = int(val) if self.engine.paged else int(np.argmax(val))
-            if req.first_token_time is None:
-                req.first_token_time = now
-                self.metrics.ttft_s.append(now - req.arrival_time)
-            req.state = RequestState.DECODE
-            req._emit(tok)
-            self.metrics.tokens_generated += 1
-            if req.remaining == 0:
+            if self._emit_token(req, tok, now):
+                self._finish(req, now)
+
+    def _absorb_multi(self, out: Dict[int, List[int]], now: float) -> None:
+        """Absorb a fused dispatch: emit each row's tokens in order until a
+        stop condition (max_new_tokens / EOS) fires, then ROLL BACK the ≤K−1
+        overrun tokens — ``engine.rollback`` truncates ``seen_tokens`` and
+        history, frees the over-allocated blocks, and registers only the
+        kept tokens' full blocks in the prefix index. The rollback runs
+        BEFORE the finishing flush so the content index never covers
+        discarded tokens; for surviving requests ``rollback(uid, 0)`` is the
+        registration commit the single-step path does inline."""
+        for uid, toks in out.items():
+            req = self._live.get(uid)
+            if req is None:  # cancelled between dispatch and absorb
+                self._engine_flush(uid)
+                continue
+            kept = 0
+            finished = False
+            for tok in toks:
+                kept += 1
+                if self._emit_token(req, tok, now):
+                    finished = True
+                    break
+            overrun = len(toks) - kept
+            if overrun:
+                self.metrics.observe_rollback(overrun)
+            self.engine.rollback(uid, overrun)
+            if finished:
                 self._finish(req, now)
 
     def _finish(self, req: Request, now: float) -> None:
@@ -439,16 +504,53 @@ class ContinuousBatchScheduler:
         req.finish_time = now
         self.metrics.completed += 1
 
+    def _effective_horizon(self, now: float, feed: Dict[int, int]) -> int:
+        """The horizon this decode round actually runs at. Collapses to 1 —
+        single-step decode, unchanged TTFT/SLA behavior — whenever:
+
+        - admissions are queued (an arrived request is waiting; a K-step
+          dispatch would delay its admission by K token times),
+        - a stalled prefill is draining (its tokens interleave per step),
+        - a live request has fewer than K tokens remaining (don't generate
+          guaranteed overrun) or fewer than K context positions left,
+        - a live deadline falls inside the horizon's wall-clock budget
+          (K × the EMA per-token dispatch time) — the fused step must not
+          blow through an SLA the single-step loop would have honored.
+        """
+        K = self.decode_horizon
+        if K <= 1 or not getattr(self.engine, "paged", False):
+            return 1
+        if self._stalled:
+            return 1
+        if any(r.arrival_time <= now for r in self._queue):
+            return 1
+        for uid in feed:
+            req = self._live[uid]
+            if req.remaining < K:
+                return 1
+            d = self.engine.state.seqs.get(uid)
+            if d is not None and d.seen_tokens + K > self.engine.max_seq_len:
+                return 1
+        budget = K * self._token_est_s
+        for r in self._live.values():
+            if r.deadline is not None and r.deadline - now < budget:
+                return 1
+        return K
+
     def _decode_once(self, now: float) -> None:
         feed = {uid: r.tokens[-1] for uid, r in self._live.items()
                 if r.state is RequestState.DECODE}
         if not feed:
             return
+        horizon = self._effective_horizon(now, feed)
         attempt = 0
         while True:
             t0 = time.perf_counter()
             try:
-                out = self.engine.decode_step(feed, greedy=True)
+                if horizon > 1:
+                    out = self.engine.decode_multi(feed, horizon=horizon)
+                else:
+                    out = self.engine.decode_step(feed, greedy=True)
                 break
             except TransientEngineError as e:
                 if not self._retry_transient("decode_step", attempt, e):
@@ -473,9 +575,16 @@ class ContinuousBatchScheduler:
                 self._preempt(victim)
                 return  # retry next step with the shrunken batch
         dt = time.perf_counter() - t0
-        self._observe_engine_ok("decode", dt)
-        self.metrics.observe_step(dt, len(feed))
-        self._absorb(out, now)
+        self._observe_engine_ok("decode", dt, scale=horizon)
+        self.metrics.observe_step(dt, len(feed), horizon=horizon)
+        self.metrics.observe_decode(horizon, fused=horizon > 1)
+        per_tok = dt / horizon
+        self._token_est_s = (per_tok if self._token_est_s == 0.0
+                             else 0.5 * self._token_est_s + 0.5 * per_tok)
+        if horizon > 1:
+            self._absorb_multi(out, now)
+        else:
+            self._absorb(out, now)
 
     # ------------------------------------------------------------------
     # driving surface

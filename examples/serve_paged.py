@@ -46,6 +46,21 @@ def main():
     free, ctx = engine.query()
     print(f"free slots {free}, max context {ctx}")
 
+    # fused multi-token decode (docs/SERVING.md): one compiled K-step
+    # dispatch per K tokens, driven through the production scheduler
+    from deepspeed_tpu.serve import ContinuousBatchScheduler
+
+    fused = InferenceEngineV2(model, params, max_seqs=8, max_seq_len=512,
+                              prefill_chunk=128, paged=True, block_size=32,
+                              token_budget=128, decode_horizon=4)
+    with ContinuousBatchScheduler(fused) as sched:
+        req = sched.submit(rng.integers(0, 32000, (48,)).tolist(),
+                           max_new_tokens=24)
+        sched.run_until_complete()
+    print(f"decode_horizon=4: {len(req.tokens)} tokens in "
+          f"{int(sched.metrics.decode['fused_steps'])} fused dispatches "
+          f"(+ adaptive single-step tail)")
+
 
 if __name__ == "__main__":
     main()
