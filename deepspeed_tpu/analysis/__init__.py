@@ -1,0 +1,27 @@
+"""``deepspeed_tpu.analysis`` — TPU-hazard linter + runtime sanitizer
+(docs/ANALYSIS.md).
+
+Static side: ``python -m deepspeed_tpu.analysis deepspeed_tpu/`` (or the
+``dstpu-lint`` console script) runs five AST rule families — host syncs
+and fresh allocations in serving hot paths (DSTPU001/002), untyped raises
+and string-matched dispatch (DSTPU003), retrace hazards in jitted code
+(DSTPU004), nondeterministic scheduler decisions (DSTPU005) — against a
+checked-in suppression baseline; tier-1 asserts zero unsuppressed
+findings.
+
+Runtime side: ``DSTPU_SANITIZE=1`` arms checked mode — the engine builds
+a self-verifying KV block cache, every ``Request.state`` assignment is
+validated against the lifecycle graph, and the scheduler's ``close()``
+runs a pool-leak check. Off by default and zero-cost when off.
+"""
+
+from .baseline import apply as apply_baseline  # noqa: F401
+from .baseline import default_path as default_baseline_path  # noqa: F401
+from .baseline import load as load_baseline  # noqa: F401
+from .baseline import save as save_baseline  # noqa: F401
+from .lint import Finding, lint_file, lint_paths, lint_source  # noqa: F401
+from .rules import ALL_RULE_IDS, HOT_FUNCTIONS, RULES, Rule  # noqa: F401
+from .sanitizer import (IllegalTransitionError,  # noqa: F401
+                        LEGAL_TRANSITIONS, SanitizerError, check_drained,
+                        check_transition, checked_cache_cls,
+                        sanitize_enabled)

@@ -1,0 +1,121 @@
+"""``python -m deepspeed_tpu.analysis`` / ``dstpu-lint`` — the hazard
+linter CLI (docs/ANALYSIS.md).
+
+Exit codes: 0 clean (every finding suppressed by pragma or baseline),
+1 unsuppressed findings, 2 usage error. ``--write-baseline`` accepts the
+current findings as intentional and rewrites the baseline file.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from .lint import lint_paths
+from .rules import ALL_RULE_IDS, RULES
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu-lint",
+        description="DeepSpeed-TPU hazard linter: host syncs and fresh "
+                    "allocations in serving hot paths, untyped raises, "
+                    "retrace hazards in jitted code, nondeterministic "
+                    "scheduler decisions. See docs/ANALYSIS.md.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint "
+                        "(default: ./deepspeed_tpu)")
+    p.add_argument("--rules", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run "
+                        f"(default: all of {','.join(ALL_RULE_IDS)})")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppression baseline file (default: the packaged "
+                        "analysis/baseline.txt; 'none' disables)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept current findings as intentional: rewrite "
+                        "the baseline and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as a JSON array")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid in ALL_RULE_IDS:
+            r = RULES[rid]
+            scope = "/".join(r.scope) if r.scope else "whole tree"
+            print(f"{rid}  {r.title}  [scope: {scope}]")
+        return 0
+
+    paths = args.paths or (["deepspeed_tpu"]
+                           if os.path.isdir("deepspeed_tpu") else [])
+    if not paths:
+        print("dstpu-lint: no paths given and no ./deepspeed_tpu here",
+              file=sys.stderr)
+        return 2
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"dstpu-lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",")
+                    if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"dstpu-lint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(ALL_RULE_IDS)})", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, rule_ids)
+
+    baseline_path = args.baseline or baseline_mod.default_path()
+    if args.write_baseline:
+        if args.baseline == "none":
+            print("dstpu-lint: --write-baseline needs a real baseline path "
+                  "(got 'none')", file=sys.stderr)
+            return 2
+        n = baseline_mod.save(baseline_path, findings)
+        if not args.quiet:
+            print(f"dstpu-lint: wrote {n} baseline entr"
+                  f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    if args.baseline == "none":
+        unsuppressed, stale = findings, set()
+    else:
+        keys = baseline_mod.load(baseline_path)
+        unsuppressed, stale = baseline_mod.apply(findings, keys)
+
+    if args.as_json:
+        print(json.dumps([{
+            "path": f.path, "line": f.line, "col": f.col, "rule": f.rule,
+            "message": f.message, "hint": f.hint, "qualname": f.qualname,
+        } for f in unsuppressed], indent=2))
+    else:
+        for f in unsuppressed:
+            print(f.render())
+
+    if not args.quiet and not args.as_json:
+        bits = [f"{len(unsuppressed)} finding"
+                f"{'' if len(unsuppressed) == 1 else 's'}",
+                f"{len(findings) - len(unsuppressed)} suppressed"]
+        if stale:
+            bits.append(f"{len(stale)} stale baseline entr"
+                        f"{'y' if len(stale) == 1 else 'ies'} "
+                        "(prune with --write-baseline)")
+        print(f"dstpu-lint: {', '.join(bits)}")
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,502 @@
+"""AST linter for TPU serving hazards (docs/ANALYSIS.md).
+
+Pure static analysis — no jax import, no execution of the linted code.
+``lint_paths`` walks ``.py`` files, parses each once, and runs five rule
+families over the tree:
+
+- **DSTPU001** host-device syncs (``block_until_ready`` / ``device_get`` /
+  ``np.asarray`` / ``.item()``) inside the serving hot functions.
+- **DSTPU002** fresh host array construction (``np.zeros`` & friends) in
+  those same steady-state step functions.
+- **DSTPU003** untyped ``raise RuntimeError``-style raises and
+  string-matched exception dispatch (``"..." in str(e)``) in the
+  serve/inference/resilience layers — the typed taxonomy
+  (``resilience.errors``) is mandatory there.
+- **DSTPU004** retrace/concretization hazards inside functions that are
+  jitted (decorated with ``jax.jit``, passed to ``jax.jit``/``pjit``/
+  ``pmap`` by name, or used as a ``lax.scan`` body): Python branches on
+  traced parameters (``static_argnums``/``static_argnames`` are parsed
+  and exempted), f-strings built at trace time, and ``int()``/``float()``/
+  ``bool()`` concretization of traced values.
+- **DSTPU005** nondeterminism in scheduler/resilience decision logic:
+  ``time.time()``, unseeded ``random.*`` / global ``np.random.*`` state,
+  and direct iteration over sets.
+
+Suppression is two-tier: an inline ``# dstpu-lint: ignore[DSTPU00X]``
+pragma on the flagged line for sites whose justification belongs in the
+code, and a checked-in baseline file (``analysis/baseline.txt``) for the
+inventory of intentional sites — keyed on (rule, path, qualname, source
+text) so ordinary line drift never invalidates it.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .rules import (ALLOC_NAMES, ARRAY_ROOTS, HOT_FUNCTIONS, RULES,
+                    SEEDED_RNG, SYNC_ATTRS, SYNC_DOTTED, UNTYPED_RAISES)
+
+_PRAGMA = re.compile(r"#\s*dstpu-lint:\s*ignore(?:\[([A-Za-z0-9,\s]+)\])?")
+
+
+@dataclass
+class Finding:
+    """One lint hit: location, rule, message, and remediation hint."""
+
+    path: str           # path as scanned (absolute or as given)
+    norm_path: str      # location-independent path used for baseline keys
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str
+    qualname: str       # enclosing Class.function chain or <module>
+    line_text: str      # stripped source of the flagged line
+    suppressed_inline: bool = field(default=False)
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Baseline identity — survives line-number drift."""
+        return (self.rule, self.norm_path, self.qualname, self.line_text)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}\n    hint: {self.hint}")
+
+
+def _norm_path(path: str) -> str:
+    """Key paths on the ``deepspeed_tpu/...`` suffix when present (stable
+    across checkouts and CWDs); fall back to the basename for loose files
+    (test fixtures)."""
+    parts = path.replace(os.sep, "/").split("/")
+    if "deepspeed_tpu" in parts:
+        return "/".join(parts[parts.index("deepspeed_tpu"):])
+    return parts[-1]
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _in_scope(path_parts: Sequence[str], scope: Sequence[str]) -> bool:
+    return not scope or any(p in path_parts for p in scope)
+
+
+# ---------------------------------------------------------------------------
+# jit-context discovery (rule DSTPU004)
+# ---------------------------------------------------------------------------
+
+_JIT_CALL_LASTS = {"jit", "pjit", "pmap"}
+_SCAN_DOTTED = {"lax.scan", "jax.lax.scan", "scan"}
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _static_names(fn: ast.AST, call: Optional[ast.Call]) -> Set[str]:
+    """Resolve ``static_argnums``/``static_argnames`` keyword literals of a
+    ``jax.jit`` call (or decorator) into parameter names of ``fn``."""
+    names: Set[str] = set()
+    if call is None:
+        return names
+    params = _param_names(fn)
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for item in items:
+            if isinstance(item, bool):
+                continue
+            if isinstance(item, int) and -len(params) <= item < len(params):
+                names.add(params[item])
+            elif isinstance(item, str):
+                names.add(item)
+    return names
+
+
+def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
+    """Map FunctionDef nodes that become traced code → their *static*
+    parameter names. Covers ``@jax.jit`` decoration (bare, called, and via
+    ``functools.partial``), by-name ``jax.jit(f, ...)`` / ``pjit`` /
+    ``pmap`` calls, and ``lax.scan(f, ...)`` bodies."""
+    parent: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def scope_chain(node: ast.AST) -> List[ast.AST]:
+        chain, cur = [], node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Module)):
+                chain.append(cur)
+            cur = parent.get(cur)
+        return chain
+
+    defs: Dict[str, List[ast.AST]] = {}
+    targets: Dict[ast.AST, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                fn_ref = call.func if call else dec
+                d = _dotted(fn_ref) or ""
+                if d.split(".")[-1] == "partial" and call and call.args:
+                    inner = _dotted(call.args[0]) or ""
+                    if inner.split(".")[-1] in _JIT_CALL_LASTS:
+                        targets[node] = _static_names(node, call)
+                        break
+                if d.split(".")[-1] in _JIT_CALL_LASTS:
+                    targets[node] = _static_names(node, call)
+                    break
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        d = _dotted(node.func) or ""
+        last = d.split(".")[-1]
+        is_jit = last in _JIT_CALL_LASTS
+        is_scan = d in _SCAN_DOTTED and last == "scan"
+        if not (is_jit or is_scan):
+            continue
+        arg0 = node.args[0]
+        if not isinstance(arg0, ast.Name):
+            continue
+        chain = scope_chain(node)
+        for fn in defs.get(arg0.id, ()):
+            # the def must live in a scope enclosing the jit call (same
+            # local function, same class body, or module level) — a
+            # same-named def elsewhere in the file is not this target
+            if parent.get(fn) in chain or isinstance(parent.get(fn),
+                                                     ast.Module):
+                statics = (_static_names(fn, node) if is_jit else set())
+                targets[fn] = targets.get(fn, set()) | statics
+    return targets
+
+
+# ---------------------------------------------------------------------------
+# the per-file visitor
+# ---------------------------------------------------------------------------
+
+class _FileLint(ast.NodeVisitor):
+    def __init__(self, path: str, lines: List[str], rule_ids: Set[str],
+                 jit_targets: Dict[ast.AST, Set[str]]):
+        self.path = path
+        self.norm = _norm_path(path)
+        self.parts = self.norm.split("/")
+        self.lines = lines
+        self.rule_ids = rule_ids
+        self.jit_targets = jit_targets
+        self.findings: List[Finding] = []
+        self._funcs: List[ast.AST] = []
+        self._names: List[str] = []       # Class/function qualname stack
+        self._except_depth = 0
+
+    # -- helpers ---------------------------------------------------------
+    def _enabled(self, rule: str) -> bool:
+        return (rule in self.rule_ids
+                and _in_scope(self.parts, RULES[rule].scope))
+
+    def _qualname(self) -> str:
+        return ".".join(self._names) or "<module>"
+
+    def _line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _pragma_rules(self, lineno: int) -> Optional[Set[str]]:
+        """Rules suppressed by an inline pragma on ``lineno`` (empty set =
+        all rules), or None when there is no pragma."""
+        m = _PRAGMA.search(self._line_text(lineno))
+        if not m:
+            return None
+        if not m.group(1):
+            return set()
+        return {r.strip().upper() for r in m.group(1).split(",")}
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        pragma = self._pragma_rules(node.lineno)
+        self.findings.append(Finding(
+            path=self.path, norm_path=self.norm, line=node.lineno,
+            col=node.col_offset, rule=rule, message=message,
+            hint=RULES[rule].hint, qualname=self._qualname(),
+            line_text=self._line_text(node.lineno),
+            suppressed_inline=(pragma is not None
+                               and (not pragma or rule in pragma)),
+        ))
+
+    def _in_hot_function(self) -> bool:
+        return any(getattr(f, "name", "") in HOT_FUNCTIONS
+                   for f in self._funcs)
+
+    def _trace_statics(self) -> Optional[Set[str]]:
+        """When inside a jitted function: the union of its (and any
+        enclosing traced function's) *traced* parameter names. None when
+        not inside traced code."""
+        roots = [f for f in self._funcs if f in self.jit_targets]
+        if not roots:
+            return None
+        traced: Set[str] = set()
+        seen_root = False
+        for f in self._funcs:
+            if f in self.jit_targets:
+                seen_root = True
+                traced |= set(_param_names(f)) - self.jit_targets[f]
+            elif seen_root:  # helper nested inside traced code
+                traced |= set(_param_names(f))
+        return traced
+
+    # -- structure -------------------------------------------------------
+    def _visit_func(self, node: ast.AST) -> None:
+        self._funcs.append(node)
+        self._names.append(node.name)
+        self.generic_visit(node)
+        self._names.pop()
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._names.append(node.name)
+        self.generic_visit(node)
+        self._names.pop()
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._except_depth += 1
+        self.generic_visit(node)
+        self._except_depth -= 1
+
+    # -- rule checks -----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        d = _dotted(node.func)
+        attr = (node.func.attr if isinstance(node.func, ast.Attribute)
+                else None)
+        hot = self._in_hot_function()
+
+        if self._enabled("DSTPU001") and hot:
+            if (d in SYNC_DOTTED or attr in SYNC_ATTRS
+                    or (attr == "item" and not node.args)):
+                self._emit(node, "DSTPU001",
+                           f"host sync `{d or attr}(...)` inside hot "
+                           f"function `{self._qualname()}` — this blocks "
+                           "the dispatch pipeline once per step")
+
+        if self._enabled("DSTPU002") and hot and d is not None:
+            root, _, leaf = d.partition(".")
+            if root in ARRAY_ROOTS and leaf in ALLOC_NAMES:
+                self._emit(node, "DSTPU002",
+                           f"fresh array `{d}(...)` allocated every "
+                           f"iteration of hot function "
+                           f"`{self._qualname()}`")
+
+        if self._enabled("DSTPU004"):
+            traced = self._trace_statics()
+            if (traced and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float", "bool")):
+                used = {n.id for a in node.args
+                        for n in ast.walk(a) if isinstance(n, ast.Name)}
+                bad = used & traced
+                if bad:
+                    self._emit(node, "DSTPU004",
+                               f"`{node.func.id}()` concretizes traced "
+                               f"value(s) {sorted(bad)} inside jitted "
+                               f"`{self._qualname()}` — fails or forces a "
+                               "host sync at trace time")
+
+        if self._enabled("DSTPU005") and d is not None:
+            if d == "time.time":
+                self._emit(node, "DSTPU005",
+                           "wall-clock `time.time()` in decision logic — "
+                           "not injectable, not monotonic")
+            elif d.startswith("random."):
+                self._emit(node, "DSTPU005",
+                           f"unseeded stdlib RNG `{d}(...)` — decisions "
+                           "must replay from a seed")
+            elif (d.startswith(("np.random.", "numpy.random."))
+                  and d.split(".")[-1] not in SEEDED_RNG):
+                self._emit(node, "DSTPU005",
+                           f"global-state RNG `{d}(...)` — use a seeded "
+                           "np.random.default_rng instance")
+        self.generic_visit(node)
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if self._enabled("DSTPU003") and node.exc is not None:
+            target = node.exc.func if isinstance(node.exc, ast.Call) \
+                else node.exc
+            d = _dotted(target)
+            if d in UNTYPED_RAISES:
+                self._emit(node, "DSTPU003",
+                           f"untyped `raise {d}` — the scheduler cannot "
+                           "dispatch on this without string matching")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if (self._enabled("DSTPU003") and self._except_depth > 0
+                and any(isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops)):
+            for side in (node.left, *node.comparators):
+                if (isinstance(side, ast.Call)
+                        and _dotted(side.func) == "str"):
+                    self._emit(node, "DSTPU003",
+                               "string-matched exception dispatch "
+                               "(`... in str(e)`) — match the type, not "
+                               "the message")
+                    break
+        self.generic_visit(node)
+
+    def _branch_check(self, node: ast.AST, kind: str) -> None:
+        if not self._enabled("DSTPU004"):
+            return
+        traced = self._trace_statics()
+        if not traced:
+            return
+        test = node.test
+        # identity tests (`x is None`) never concretize a tracer
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return
+        names: Set[str] = set()
+        static_only: Set[str] = set()
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                # shape/dtype introspection is static under tracing
+                for inner in ast.walk(n.value):
+                    if isinstance(inner, ast.Name):
+                        static_only.add(inner.id)
+            elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                  and n.func.id in ("isinstance", "len", "type", "hasattr",
+                                    "callable")):
+                # so is container/type introspection (isinstance(x, dict)
+                # picks a trace-time branch, it never reads the values)
+                for arg in n.args:
+                    for inner in ast.walk(arg):
+                        if isinstance(inner, ast.Name):
+                            static_only.add(inner.id)
+            elif isinstance(n, ast.Name):
+                names.add(n.id)
+        bad = (names & traced) - static_only
+        if bad:
+            self._emit(node, "DSTPU004",
+                       f"Python `{kind}` on traced value(s) {sorted(bad)} "
+                       f"inside jitted `{self._qualname()}` — retraces per "
+                       "value or raises TracerBoolConversionError")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._branch_check(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._branch_check(node, "while")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if self._enabled("DSTPU004") and self._trace_statics() is not None:
+            self._emit(node, "DSTPU004",
+                       f"f-string built at trace time inside jitted "
+                       f"`{self._qualname()}` — trace-time Python runs "
+                       "once per compile, and embedding a tracer fails")
+        self.generic_visit(node)
+
+    def _set_iter_check(self, it: ast.AST) -> None:
+        if (isinstance(it, ast.Set)
+                or (isinstance(it, ast.Call)
+                    and _dotted(it.func) == "set")):
+            self._emit(it, "DSTPU005",
+                       "iteration over a set — ordering is "
+                       "hash-randomized across runs; sort it or use a "
+                       "list/dict")
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._enabled("DSTPU005"):
+            self._set_iter_check(node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        if self._enabled("DSTPU005"):
+            for gen in node.generators:
+                self._set_iter_check(gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str,
+                rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint one file's source text. Inline-pragma'd findings are returned
+    with ``suppressed_inline=True`` (callers filter); a syntax error
+    yields a single DSTPU000 finding so broken files fail gates loudly."""
+    ids = set(rule_ids) if rule_ids is not None else set(RULES)
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            path=path, norm_path=_norm_path(path), line=e.lineno or 0,
+            col=e.offset or 0, rule="DSTPU000",
+            message=f"file does not parse: {e.msg}",
+            hint="fix the syntax error", qualname="<module>",
+            line_text="")]
+    visitor = _FileLint(path, lines, ids,
+                        _collect_jit_targets(tree)
+                        if "DSTPU004" in ids else {})
+    visitor.visit(tree)
+    visitor.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return visitor.findings
+
+
+def lint_file(path: str,
+              rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path, rule_ids)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def lint_paths(paths: Iterable[str],
+               rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directory trees).
+    Inline-suppressed findings are dropped here; baseline suppression is
+    the caller's second tier (``baseline.apply``)."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(x for x in lint_file(f, rule_ids)
+                        if not x.suppressed_inline)
+    return findings

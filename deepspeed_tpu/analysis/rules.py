@@ -1,0 +1,123 @@
+"""Rule catalog for the DSTPU hazard linter (docs/ANALYSIS.md).
+
+Each rule mechanizes an invariant the serving/perf PRs enforce by hand —
+the host-overhead and dispatch-discipline walls that the TPU concurrency
+scaling work identifies as the bottleneck class (PAPERS.md): one silent
+``np.zeros`` per decode step or one stray ``block_until_ready`` in the
+token loop erases a fused-decode speedup, and it only surfaces weeks
+later as bench noise. The linter makes the regression a CI failure with
+a file:line and a fix hint instead.
+
+Scopes are path-based (directory parts of the file under lint), so the
+hot-path rules fire only where hot paths live today; extending them to
+the training step (``runtime/``, ``zero/``) is a tracked ROADMAP item.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Tuple
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    #: one-line remediation appended to every finding of this rule
+    hint: str
+    #: directory parts a file must contain for the rule to apply;
+    #: empty = whole tree
+    scope: Tuple[str, ...] = ()
+
+
+#: functions whose bodies are the steady-state serving hot path: one
+#: iteration ≈ one generated token. Host syncs and fresh allocations in
+#: here multiply by tokens/second. (``step``/``_absorb*``/``_decode_once``
+#: are the scheduler's per-token loop; the rest are the engine's.)
+HOT_FUNCTIONS: FrozenSet[str] = frozenset({
+    "decode_step", "decode_multi", "_put_paged",
+    "_decode_once", "_absorb", "_absorb_multi", "step",
+})
+
+#: where the hot-path rules (001/002) apply
+HOT_SCOPE = ("serve", "inference")
+#: where the typed-error rule (003) applies — the taxonomy's home turf
+TAXONOMY_SCOPE = ("serve", "inference", "resilience")
+#: where the determinism rule (005) applies — scheduling/containment
+#: decisions must be replayable (seeded faults, injectable clocks)
+DECISION_SCOPE = ("serve", "resilience")
+
+#: device-sync call names (attribute or dotted) flagged by DSTPU001
+SYNC_ATTRS: FrozenSet[str] = frozenset({"block_until_ready", "device_get"})
+SYNC_DOTTED: FrozenSet[str] = frozenset({
+    "np.asarray", "numpy.asarray", "jax.device_get",
+    "jax.block_until_ready",
+})
+
+#: fresh-array constructors flagged by DSTPU002 when called as
+#: ``np.<name>`` / ``numpy.<name>`` / ``jnp.<name>`` in a hot function.
+#: ``asarray`` is deliberately absent: wrapping an existing buffer for
+#: dispatch is the transfer itself, not a fresh allocation (it is DSTPU001
+#: that polices host-side ``np.asarray`` syncs).
+ALLOC_NAMES: FrozenSet[str] = frozenset({
+    "zeros", "ones", "empty", "full", "array", "arange",
+    "zeros_like", "ones_like", "empty_like", "full_like",
+})
+ARRAY_ROOTS: FrozenSet[str] = frozenset({"np", "numpy", "jnp"})
+
+#: exception types whose raw ``raise`` DSTPU003 flags in taxonomy scope.
+#: ``ValueError`` on argument validation is allowed (it is typed and
+#: caller-attributable); ``AssertionError`` belongs to invariant checks.
+UNTYPED_RAISES: FrozenSet[str] = frozenset({
+    "RuntimeError", "Exception", "BaseException",
+})
+
+#: seeded/injectable RNG constructors exempt from DSTPU005 under
+#: ``np.random.`` / ``numpy.random.``
+SEEDED_RNG: FrozenSet[str] = frozenset({
+    "default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
+})
+
+RULES: Dict[str, Rule] = {r.id: r for r in (
+    Rule(
+        id="DSTPU001",
+        title="host-device sync in a serving hot path",
+        hint="batch the transfer (one np.asarray per step) or move it off "
+             "the per-token loop; suppress only the step's single designed "
+             "transfer (docs/ANALYSIS.md#dstpu001)",
+        scope=HOT_SCOPE,
+    ),
+    Rule(
+        id="DSTPU002",
+        title="fresh host allocation in a steady-state step function",
+        hint="reuse a per-shape preallocated scratch buffer zeroed in "
+             "place (see InferenceEngineV2._scratch_for) instead of "
+             "allocating per dispatch (docs/ANALYSIS.md#dstpu002)",
+        scope=HOT_SCOPE,
+    ),
+    Rule(
+        id="DSTPU003",
+        title="untyped raise / string-matched exception dispatch",
+        hint="raise a type from deepspeed_tpu.resilience.errors (or a "
+             "named subclass) and dispatch on isinstance, never on str(e) "
+             "(docs/ANALYSIS.md#dstpu003)",
+        scope=TAXONOMY_SCOPE,
+    ),
+    Rule(
+        id="DSTPU004",
+        title="retrace/concretization hazard inside a jitted function",
+        hint="branch with lax.cond/jnp.where, mark config args "
+             "static_argnums, and keep trace-time Python (f-strings, "
+             "int()/float() on traced values) out of compiled code "
+             "(docs/ANALYSIS.md#dstpu004)",
+        scope=(),
+    ),
+    Rule(
+        id="DSTPU005",
+        title="nondeterminism in scheduler/resilience decision logic",
+        hint="use the injectable clock (time.monotonic default), a seeded "
+             "np.random.default_rng, and ordered containers — decisions "
+             "must replay bit-for-bit (docs/ANALYSIS.md#dstpu005)",
+        scope=DECISION_SCOPE,
+    ),
+)}
+
+ALL_RULE_IDS = tuple(sorted(RULES))

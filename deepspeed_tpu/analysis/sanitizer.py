@@ -1,0 +1,266 @@
+"""Runtime sanitizer — "checked mode" for the serving stack
+(docs/ANALYSIS.md).
+
+``DSTPU_SANITIZE=1`` arms three mechanized invariant checkers that PRs
+1–4 enforced by hand-written test assertions only:
+
+- :func:`checked_cache_cls` — a :class:`BlockedKVCache` subclass that
+  re-verifies refcount conservation, COW exclusivity, use-after-free /
+  double-free, rollback exactness, and prefix-index↔pool consistency
+  after **every** allocator operation (the engine constructs it instead
+  of the plain cache when sanitize mode is on).
+- :func:`check_transition` — validates every ``Request.state`` assignment
+  against the legal lifecycle graph
+  ``QUEUED→PREFILL→DECODE→{DONE,CANCELLED,FAILED}``, ``PREEMPTED→QUEUED``
+  (plus the eviction/cancel/quarantine edges out of every live state).
+- :func:`check_drained` — the pool-leak check the scheduler runs at the
+  end of ``close()``: a drained engine must hold zero sequences and zero
+  outstanding block references.
+
+Violations raise :class:`SanitizerError` (an ``AssertionError`` subclass,
+so it can never be swallowed by the serving loop's typed ``RuntimeError``
+fault handling). With the env var unset everything here is dormant: the
+engine builds the plain cache, and the per-assignment state check is one
+dict lookup that short-circuits — BENCH_SERVE baselines stay within noise.
+
+This module imports nothing heavy at import time (no jax, no engine);
+the cache subclass is built lazily on first request so ``serve.request``
+can import it without dragging in the inference stack.
+"""
+
+import os
+from typing import Callable, Dict, FrozenSet, Iterable, List, Optional
+
+_ENV = "DSTPU_SANITIZE"
+_OFF = ("", "0", "false", "off", "no")
+
+
+def sanitize_enabled() -> bool:
+    """True when checked mode is armed (``DSTPU_SANITIZE=1``). Read from
+    the environment on every call so tests can flip it per-case; the
+    lookup is a few hundred nanoseconds — invisible next to a dispatch."""
+    return os.environ.get(_ENV, "").strip().lower() not in _OFF
+
+
+class SanitizerError(AssertionError):
+    """A mechanized invariant was violated. Subclasses ``AssertionError``
+    (not ``RuntimeError``): the resilience layer's containment paths catch
+    typed ``RuntimeError``s, and a sanitizer finding must never be retried,
+    quarantined, or shed — it must stop the test."""
+
+
+class IllegalTransitionError(SanitizerError):
+    """A ``Request.state`` assignment off the legal lifecycle graph."""
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle graph
+# ---------------------------------------------------------------------------
+
+#: legal edges, keyed on ``RequestState.value`` strings so this module
+#: never imports the serve layer (which imports *us*). Self-transitions
+#: are always legal (the decode loop re-asserts DECODE per token).
+LEGAL_TRANSITIONS: Dict[str, FrozenSet[str]] = {
+    "queued": frozenset({"prefill", "cancelled", "failed"}),
+    "prefill": frozenset({"decode", "preempted", "cancelled", "failed"}),
+    "decode": frozenset({"done", "preempted", "cancelled", "failed"}),
+    "preempted": frozenset({"queued", "cancelled", "failed"}),
+    "done": frozenset(),
+    "cancelled": frozenset(),
+    "failed": frozenset(),
+}
+
+
+def check_transition(uid: object, old, new) -> None:
+    """Validate one ``Request.state`` assignment. ``old is None`` is the
+    dataclass's initial assignment and always legal; terminal states have
+    no out-edges."""
+    if old is None or old is new:
+        return
+    legal = LEGAL_TRANSITIONS.get(getattr(old, "value", str(old)))
+    if legal is None:  # unknown state object: nothing to validate against
+        return
+    if getattr(new, "value", str(new)) not in legal:
+        raise IllegalTransitionError(
+            f"[sanitizer] illegal request state transition uid={uid}: "
+            f"{old} -> {new} (legal from {old}: "
+            f"{sorted(legal) or 'none — terminal state'})")
+
+
+# ---------------------------------------------------------------------------
+# checked KV cache
+# ---------------------------------------------------------------------------
+
+_checked_cls = None
+
+
+def checked_cache_cls():
+    """The :class:`CheckedBlockedKVCache` class, built on first use (lazy
+    so importing this module never pulls in the inference stack)."""
+    global _checked_cls
+    if _checked_cls is not None:
+        return _checked_cls
+
+    from ..inference.v2.ragged_manager import BlockedKVCache
+
+    class CheckedBlockedKVCache(BlockedKVCache):
+        """Drop-in ``BlockedKVCache`` that re-verifies the allocator's
+        invariants after every operation.
+
+        ``descs`` is a zero-arg callable yielding every live
+        :class:`SequenceDescriptor` (the engine passes its state table);
+        without it the wrapper falls back to the descriptors it has seen,
+        which is enough for standalone allocator tests. Checks are
+        O(live blocks) pure-host work per op — negligible next to the
+        compiled dispatch each op brackets, but still debug-mode-only."""
+
+        def __init__(self, *args,
+                     descs: Optional[Callable[[], Iterable]] = None, **kw):
+            super().__init__(*args, **kw)
+            self._descs_provider = descs
+            self._seen: Dict[int, object] = {}
+
+        # -- plumbing ----------------------------------------------------
+        def _descs(self) -> List:
+            if self._descs_provider is not None:
+                return list(self._descs_provider())
+            return list(self._seen.values())
+
+        def _track(self, desc) -> None:
+            self._seen[desc.uid] = desc
+
+        def verify(self, op: str = "verify") -> None:
+            """All invariants, loudly: base ``check_invariants`` (pool
+            partitioning, index/meta/children consistency, refcount
+            conservation against live descriptors) plus explicit
+            use-after-free scans for better diagnostics."""
+            descs = self._descs()
+            free = set(self._free)
+            for d in descs:
+                for b in d.blocks:
+                    if b in free:
+                        raise SanitizerError(
+                            f"[sanitizer] use-after-free after {op}: uid "
+                            f"{d.uid} still maps block {b}, which is on "
+                            "the free list")
+                    if self.refcount(b) < 1:
+                        raise SanitizerError(
+                            f"[sanitizer] use-after-free after {op}: uid "
+                            f"{d.uid} maps block {b} with refcount 0")
+            try:
+                self.check_invariants(descs)
+            except AssertionError as e:
+                if isinstance(e, SanitizerError):
+                    raise
+                raise SanitizerError(
+                    f"[sanitizer] KV-cache invariant broken after {op}: "
+                    f"{e}") from e
+
+        # -- checked operations ------------------------------------------
+        def ensure(self, desc, n_tokens):
+            self._track(desc)
+            super().ensure(desc, n_tokens)
+            self.verify(f"ensure(uid={desc.uid}, n={n_tokens})")
+
+        def lookup(self, desc, tokens):
+            self._track(desc)
+            skipped = super().lookup(desc, tokens)
+            if skipped > len(tokens) - 1:
+                raise SanitizerError(
+                    f"[sanitizer] prefix lookup for uid {desc.uid} skipped "
+                    f"{skipped} of {len(tokens)} tokens — at least the "
+                    "final prompt token must run to produce logits")
+            self.verify(f"lookup(uid={desc.uid})")
+            return skipped
+
+        def copy_on_write(self, desc, j):
+            self._track(desc)
+            src_before = desc.blocks[j]
+            refs_before = self.refcount(src_before)
+            src, dst = super().copy_on_write(desc, j)
+            # COW exclusivity: the writer must own the replacement block
+            # alone, and exactly one reference must come off the source
+            if self.refcount(dst) != 1:
+                raise SanitizerError(
+                    f"[sanitizer] COW exclusivity: dst block {dst} has "
+                    f"refcount {self.refcount(dst)} != 1 after "
+                    f"copy_on_write(uid={desc.uid}, j={j})")
+            if desc.blocks[j] != dst or src != src_before:
+                raise SanitizerError(
+                    f"[sanitizer] COW repoint: uid {desc.uid} slot {j} "
+                    f"maps {desc.blocks[j]}, expected dst {dst} "
+                    f"(src {src} vs {src_before})")
+            if self.refcount(src) != refs_before - 1:
+                raise SanitizerError(
+                    f"[sanitizer] COW released {refs_before - self.refcount(src)} "
+                    f"references on src block {src}, expected exactly 1")
+            self.verify(f"copy_on_write(uid={desc.uid}, j={j})")
+            return src, dst
+
+        def register(self, desc):
+            self._track(desc)
+            super().register(desc)
+            self.verify(f"register(uid={desc.uid})")
+
+        def rollback(self, desc, n_tokens):
+            self._track(desc)
+            before = len(desc.blocks)
+            keep = min(before, self.blocks_needed(n_tokens))
+            freed = super().rollback(desc, n_tokens)
+            # rollback exactness: exactly the over-allocated tail comes
+            # back, one reference per block, never more, never fewer
+            if freed != before - keep or len(desc.blocks) != keep:
+                raise SanitizerError(
+                    f"[sanitizer] rollback exactness: uid {desc.uid} freed "
+                    f"{freed} blocks to keep {len(desc.blocks)}, expected "
+                    f"to free {before - keep} and keep {keep}")
+            self.verify(f"rollback(uid={desc.uid}, n={n_tokens})")
+            return freed
+
+        def free(self, desc):
+            # double-free scan BEFORE mutating: a stale descriptor (a
+            # scheduler race re-freeing flushed blocks) must be caught
+            # here, not corrupt refcounts of whoever owns the block now
+            for b in desc.blocks:
+                if self.refcount(b) < 1:
+                    raise SanitizerError(
+                        f"[sanitizer] double free: uid {desc.uid} frees "
+                        f"block {b} which has no outstanding reference")
+            super().free(desc)
+            self._seen.pop(desc.uid, None)
+            self.verify(f"free(uid={desc.uid})")
+
+        def flush_cache(self):
+            super().flush_cache()
+            self.verify("flush_cache")
+
+    _checked_cls = CheckedBlockedKVCache
+    return _checked_cls
+
+
+# ---------------------------------------------------------------------------
+# drain leak check
+# ---------------------------------------------------------------------------
+
+def check_drained(engine) -> None:
+    """After a scheduler ``close()`` drain the engine must be empty: no
+    resident sequences, no outstanding block references, and the block
+    pool fully allocatable (free + cached-evictable == usable). Cached
+    LRU blocks are fine — they are reclaimable prefix state, not leaks."""
+    problems: List[str] = []
+    state = getattr(engine, "state", None)
+    if state is not None and getattr(state, "n_active", 0):
+        problems.append(f"{state.n_active} sequence(s) still resident "
+                        f"(uids {sorted(state.seqs)})")
+    mgr = getattr(engine, "block_mgr", None)
+    if mgr is not None:
+        refs = getattr(mgr, "_ref", None)
+        if refs:
+            problems.append(f"outstanding block references {dict(refs)}")
+        usable = mgr.num_blocks - 1  # block 0 is the reserved trash block
+        if mgr.free_blocks != usable:
+            problems.append(f"pool accounting: free+cached "
+                            f"{mgr.free_blocks} != usable {usable}")
+    if problems:
+        raise SanitizerError("[sanitizer] pool leak at close() drain: "
+                             + "; ".join(problems))

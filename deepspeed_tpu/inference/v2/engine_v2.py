@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...resilience.errors import ContextOverflowError
+from ...analysis.sanitizer import checked_cache_cls, sanitize_enabled
+from ...resilience.errors import ContextOverflowError, EngineUsageError
 from ...utils.logging import log_dist
 from ..config import DeepSpeedInferenceConfig
 from .ragged_manager import DSStateManager
@@ -117,9 +118,18 @@ class InferenceEngineV2:
             max_blocks_per_seq = -(-self.max_seq_len // block_size)
             if num_blocks is None:
                 num_blocks = 1 + max_seqs * max_blocks_per_seq  # = slot capacity
-            self.block_mgr = BlockedKVCache(num_blocks, block_size,
-                                            max_blocks_per_seq,
-                                            prefix_cache=self.prefix_cache)
+            if sanitize_enabled():
+                # checked mode (docs/ANALYSIS.md): the sanitizing cache
+                # re-verifies refcount conservation, COW exclusivity, and
+                # index↔pool consistency after every allocator op
+                self.block_mgr = checked_cache_cls()(
+                    num_blocks, block_size, max_blocks_per_seq,
+                    prefix_cache=self.prefix_cache,
+                    descs=lambda: self.state.seqs.values())
+            else:
+                self.block_mgr = BlockedKVCache(num_blocks, block_size,
+                                                max_blocks_per_seq,
+                                                prefix_cache=self.prefix_cache)
             self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
             log_dist(
                 f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
@@ -270,11 +280,15 @@ class InferenceEngineV2:
             self._fused_fn = jax.jit(fused, donate_argnums=(1,))
         return self._fused_fn
 
-    def _scratch_for(self, key: Tuple, shapes) -> Tuple[np.ndarray, ...]:
-        """Per-shape preallocated int32 host arrays, zeroed in place."""
+    def _scratch_for(self, key: Tuple, shapes,
+                     dtypes=None) -> Tuple[np.ndarray, ...]:
+        """Per-shape preallocated host arrays (int32 unless ``dtypes``
+        overrides per buffer), zeroed in place."""
         bufs = self._scratch.get(key)
         if bufs is None:
-            bufs = tuple(np.zeros(s, np.int32) for s in shapes)
+            bufs = tuple(
+                np.zeros(s, np.int32 if dtypes is None else dtypes[i])
+                for i, s in enumerate(shapes))
             self._scratch[key] = bufs
         else:
             for a in bufs:
@@ -363,10 +377,14 @@ class InferenceEngineV2:
             r = 0
             for d, take in plan:
                 completes = take == d.in_flight
-                row = self.block_mgr.table_row(d)
+                # fill the first row in place, then broadcast-copy it to the
+                # sequence's remaining rows — no per-row temp allocation
+                r0 = r
+                self.block_mgr.fill_table_row(d, tables[r0])
+                if take > 1:
+                    tables[r0 + 1:r0 + take] = tables[r0]
                 for j in range(take):
                     ids[r, 0] = d.pending[j]
-                    tables[r] = row
                     starts[r] = d.seen_tokens + j
                     r += 1
                 if completes:
@@ -386,7 +404,9 @@ class InferenceEngineV2:
                 # (dedup-aware: identical blocks collapse onto one copy)
                 for d, _ in plan:
                     self.block_mgr.register(d)
-            lg = np.asarray(lg)
+            # THE step's one designed transfer (ships the whole batch's
+            # results at once; everything above is dispatch-only)
+            lg = np.asarray(lg)  # dstpu-lint: ignore[DSTPU001]
             for i, d in enumerate(finals):
                 out[d.uid] = int(lg[i]) if greedy else lg[i]
 
@@ -405,7 +425,8 @@ class InferenceEngineV2:
         shipping the full logit rows to the host.
         """
         if do_checks and len(batch_uids) > self.state.max_seqs:
-            raise RuntimeError(f"batch of {len(batch_uids)} exceeds {self.state.max_seqs} slots")
+            raise EngineUsageError(
+                f"batch of {len(batch_uids)} exceeds {self.state.max_seqs} slots")
         if greedy and not self.paged:
             raise ValueError(
                 "put(greedy=True) is paged-mode only (the slot prefill path "
@@ -499,9 +520,11 @@ class InferenceEngineV2:
             # mixed arrivals and decodes in one step is the normal case
             uids = list(tokens)
             return self.put(uids, [[tokens[u]] for u in uids], greedy=greedy)
-        toks = np.zeros((self.max_seqs,), np.int32)
-        poss = np.zeros((self.max_seqs,), np.int32)
-        active = np.zeros((self.max_seqs,), bool)
+        # per-shape reused scratch (zeroed in place): the slot-mode decode
+        # loop must not pay three fresh np.zeros per generated token
+        toks, poss, active = self._scratch_for(
+            ("decode_slot", self.max_seqs), ((self.max_seqs,),) * 3,
+            dtypes=(np.int32, np.int32, np.bool_))
         by_slot: Dict[int, int] = {}
         # validation for EVERY uid first: a raise here must leave all
         # sequence state untouched (no half-advanced positions)
@@ -560,15 +583,15 @@ class InferenceEngineV2:
         if not tokens:
             return {}
         if len(tokens) > self.max_seqs:
-            raise RuntimeError(
+            raise EngineUsageError(
                 f"batch of {len(tokens)} exceeds {self.max_seqs} slots")
         K = horizon
         for uid in tokens:
             d = self.state.seqs[uid]  # unknown uid: loud KeyError
             if d.in_flight:
-                raise RuntimeError(
+                raise EngineUsageError(
                     f"uid {uid}: {d.in_flight} pending prefill tokens — "
-                    "drain before fused decode")
+                    "drain before fused decode", uid=uid)
             if d.seen_tokens + K > self.max_seq_len:
                 raise ContextOverflowError(
                     f"uid {uid}: fused horizon {K} exceeds context "
@@ -599,7 +622,7 @@ class InferenceEngineV2:
             ("fused", B), ((B,), (B, self.block_mgr.max_blocks_per_seq), (B,)))
         for r, d in enumerate(descs):
             toks[r] = tokens[d.uid]
-            tables[r] = self.block_mgr.table_row(d)
+            self.block_mgr.fill_table_row(d, tables[r])  # in place, no temp
             starts[r] = d.seen_tokens
         ys, self.kv = self._get_fused()(
             self.params, self.kv, jnp.asarray(toks), jnp.asarray(tables),
@@ -638,8 +661,9 @@ class InferenceEngineV2:
                     f"uid {uid}: cannot roll back {n} of {d.seen_tokens} "
                     "cached tokens (at least one must remain)")
             if d.in_flight:
-                raise RuntimeError(
-                    f"uid {uid}: rollback with {d.in_flight} pending tokens")
+                raise EngineUsageError(
+                    f"uid {uid}: rollback with {d.in_flight} pending tokens",
+                    uid=uid)
             d.seen_tokens -= n
             if self.prefix_cache:
                 del d.history[-n:]

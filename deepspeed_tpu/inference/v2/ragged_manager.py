@@ -28,7 +28,7 @@ from typing import Dict, Iterable, List, Sequence, Tuple
 
 import numpy as np
 
-from ...resilience.errors import PoolExhaustedError
+from ...resilience.errors import ContextOverflowError, PoolExhaustedError
 
 #: chain root sentinel for the content index (block ids are >= 0)
 _ROOT = -1
@@ -177,16 +177,28 @@ class BlockedKVCache:
         """Grow ``desc.blocks`` to cover ``n_tokens`` logical positions."""
         need = self.blocks_needed(n_tokens)
         if need > self.max_blocks_per_seq:
-            raise RuntimeError(
+            # per-sequence context wall, same family as the engine's
+            # max_seq_len check: permanent and attributable to this uid
+            raise ContextOverflowError(
                 f"uid {desc.uid}: {n_tokens} tokens need {need} blocks > "
-                f"max {self.max_blocks_per_seq} per sequence")
+                f"max {self.max_blocks_per_seq} per sequence", uid=desc.uid)
         while len(desc.blocks) < need:
             desc.blocks.append(self._allocate(desc.uid))
 
     def table_row(self, desc: SequenceDescriptor) -> np.ndarray:
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
-        row[: len(desc.blocks)] = desc.blocks
+        self.fill_table_row(desc, row)
         return row
+
+    def fill_table_row(self, desc: SequenceDescriptor,
+                       out: np.ndarray) -> None:
+        """Write ``desc``'s block table into ``out`` in place (trailing
+        entries zeroed → trash block 0) — the hot-path variant of
+        :meth:`table_row`: the engine's step loops fill rows of reused
+        scratch instead of allocating a fresh row per sequence per step."""
+        n = len(desc.blocks)
+        out[:n] = desc.blocks
+        out[n:] = 0
 
     def rollback(self, desc: SequenceDescriptor, n_tokens: int) -> int:
         """Release ``desc``'s trailing blocks past what ``n_tokens`` logical

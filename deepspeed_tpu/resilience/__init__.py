@@ -7,8 +7,8 @@ containment; the engine raises the typed capacity errors. See
 ``docs/RESILIENCE.md``."""
 
 from .breaker import BreakerState, CircuitBreaker  # noqa: F401
-from .errors import (ContextOverflowError, PoolExhaustedError,  # noqa: F401
-                     RequestFailedError, SheddingError,
+from .errors import (ContextOverflowError, EngineUsageError,  # noqa: F401
+                     PoolExhaustedError, RequestFailedError, SheddingError,
                      TransientEngineError, WatchdogTimeoutError)
 from .faults import (SITES, FaultInjector, FaultSpec,  # noqa: F401
                      InjectedEngine)

@@ -50,6 +50,19 @@ class ContextOverflowError(RuntimeError):
         self.uid = uid
 
 
+class EngineUsageError(RuntimeError):
+    """The caller broke the engine's calling contract: a batch wider than
+    the slot pool, fused decode with prefill tokens still pending, a
+    rollback of in-flight work. Not a fault and not pressure — there is no
+    retry, preemption, or quarantine story; the calling code is wrong and
+    must be fixed. Typed (DSTPU003) so no dispatcher ever string-matches
+    it; ``uid`` names the offending sequence when one is attributable."""
+
+    def __init__(self, message: str, uid: Optional[int] = None):
+        super().__init__(message)
+        self.uid = uid
+
+
 class TransientEngineError(RuntimeError):
     """An engine call failed in a way that a bounded retry may fix
     (runtime hiccup, transport blip, injected transient fault). The fault

@@ -19,6 +19,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from ..analysis import sanitizer as _sanitizer
+
 _uid_counter = itertools.count(1)
 
 
@@ -100,3 +102,13 @@ class Request:
         self.tokens.append(token)
         if self.on_token is not None:
             self.on_token(self, token)
+
+    def __setattr__(self, name: str, value) -> None:
+        # checked mode (docs/ANALYSIS.md): every lifecycle transition is
+        # validated against the legal graph. Off (the default), this is
+        # one string compare per attribute assignment — unmeasurable.
+        if name == "state" and _sanitizer.sanitize_enabled():
+            _sanitizer.check_transition(
+                getattr(self, "uid", None), getattr(self, "state", None),
+                value)
+        object.__setattr__(self, name, value)

@@ -65,6 +65,7 @@ from typing import Callable, Deque, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from ..analysis import sanitizer as _sanitizer
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.errors import (ContextOverflowError, PoolExhaustedError,
                                  RequestFailedError, SheddingError,
@@ -655,6 +656,11 @@ class ContinuousBatchScheduler:
         import jax
 
         jax.block_until_ready(self.engine.kv)
+        if _sanitizer.sanitize_enabled():
+            # checked mode: a drained engine must hold zero sequences and
+            # zero block references — a leak here is a scheduler bug that
+            # would otherwise surface as slow pool starvation in prod
+            _sanitizer.check_drained(self.engine)
 
     def __enter__(self):
         return self

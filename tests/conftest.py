@@ -127,6 +127,36 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.core)
 
 
+# Serving/inference test modules run under the runtime sanitizer
+# (docs/ANALYSIS.md "checked mode"): the engine builds the self-verifying
+# KV cache, every Request.state transition is validated, and scheduler
+# close() runs the pool-leak check — so tier-1 exercises the mechanized
+# invariants on every real workload these suites drive, not just on the
+# seeded-bug tests. An explicit DSTPU_SANITIZE in the environment (e.g.
+# DSTPU_SANITIZE=0 to bisect a sanitizer-only failure) wins.
+_SANITIZE_FILES = (
+    "test_serve.py",
+    "test_resilience.py",
+    "test_fused_decode.py",
+    "test_inference_v2.py",
+    "test_prefix_cache.py",
+)
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_serving_modules(request):
+    fspath = str(getattr(request.node, "fspath", ""))
+    if (os.path.basename(fspath) in _SANITIZE_FILES
+            and "DSTPU_SANITIZE" not in os.environ):
+        os.environ["DSTPU_SANITIZE"] = "1"
+        try:
+            yield
+        finally:
+            os.environ.pop("DSTPU_SANITIZE", None)
+    else:
+        yield
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_state():
     """Each test gets a fresh topology (mesh) — mirrors per-test process groups."""

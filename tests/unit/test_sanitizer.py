@@ -1,0 +1,311 @@
+"""Runtime-sanitizer tests (docs/ANALYSIS.md, checked mode): seeded-bug
+tests proving each planted corruption — refcount leak, double free,
+use-after-free, COW sharing violation, rollback over-free, illegal request
+transition, drained-pool leak — is caught loudly with the matching
+diagnostic; silence + bitwise identity on a clean serving workload; and a
+slow-marked overhead bound. The serve/inference suites themselves run
+under ``DSTPU_SANITIZE=1`` in tier-1 via the conftest fixture."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (IllegalTransitionError,
+                                              SanitizerError, check_drained,
+                                              check_transition,
+                                              checked_cache_cls,
+                                              sanitize_enabled)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.ragged_manager import (BlockedKVCache,
+                                                       SequenceDescriptor)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, Request,
+                                 RequestState)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _checked(num_blocks=9, block_size=4, max_per_seq=8, prefix=True):
+    return checked_cache_cls()(num_blocks, block_size, max_per_seq,
+                               prefix_cache=prefix)
+
+
+class TestEnvGate:
+    def test_off_by_default_and_flips(self, monkeypatch):
+        monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+        assert not sanitize_enabled()
+        for off in ("0", "false", "off", ""):
+            monkeypatch.setenv("DSTPU_SANITIZE", off)
+            assert not sanitize_enabled()
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        assert sanitize_enabled()
+
+    def test_engine_builds_checked_cache_only_when_armed(self, setup,
+                                                         monkeypatch):
+        m, params = setup
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        assert isinstance(_engine(m, params).block_mgr, checked_cache_cls())
+        monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+        eng = _engine(m, params)
+        assert type(eng.block_mgr) is BlockedKVCache
+
+
+class TestCheckedCacheSeededBugs:
+    """Each test plants one corruption a PR-1/PR-4 regression could cause
+    and asserts the very next checked operation reports it."""
+
+    def _two_sharing_descs(self, cache):
+        """d1 registered with 2 full blocks; d2 prefix-hits both."""
+        bs = cache.block_size
+        toks = list(range(2 * bs))
+        d1 = SequenceDescriptor(uid=1, slot=0)
+        cache.ensure(d1, len(toks))
+        d1.history.extend(toks)
+        d1.seen_tokens = len(toks)
+        cache.register(d1)
+        d2 = SequenceDescriptor(uid=2, slot=1)
+        skipped = cache.lookup(d2, toks + [99])
+        assert skipped == 2 * bs and d2.blocks == d1.blocks
+        return d1, d2
+
+    def test_clean_lifecycle_is_silent(self):
+        cache = _checked()
+        d1, d2 = self._two_sharing_descs(cache)
+        cache.ensure(d2, 9)  # grow past the shared prefix
+        src, dst = cache.copy_on_write(d2, 1)
+        assert cache.refcount(dst) == 1
+        cache.rollback(d2, 4)
+        cache.free(d2)
+        cache.free(d1)
+        cache.flush_cache()
+        cache.verify("final")
+
+    def test_refcount_leak_is_caught(self):
+        cache = _checked()
+        d1, _ = self._two_sharing_descs(cache)
+        cache._incref(d1.blocks[0])  # the plant: a ref nobody holds
+        with pytest.raises(SanitizerError, match="invariant broken"):
+            cache.verify("leak-check")
+        # and any subsequent checked op reports it too
+        with pytest.raises(SanitizerError):
+            cache.ensure(SequenceDescriptor(uid=3, slot=2), 4)
+
+    def test_double_free_is_caught_before_corrupting(self):
+        cache = _checked()
+        d = SequenceDescriptor(uid=1, slot=0)
+        cache.ensure(d, 8)
+        stale = list(d.blocks)  # a racing scheduler path kept a copy
+        cache.free(d)
+        d.blocks = stale        # the plant: re-free via the stale view
+        with pytest.raises(SanitizerError, match="double free"):
+            cache.free(d)
+
+    def test_use_after_free_is_caught(self):
+        cache = _checked()
+        d = SequenceDescriptor(uid=1, slot=0)
+        cache.ensure(d, 8)
+        cache._decref(d.blocks[-1])  # the plant: freed under a live mapping
+        with pytest.raises(SanitizerError, match="use-after-free"):
+            cache.verify("uaf-check")
+
+    def test_rollback_over_free_is_caught(self, monkeypatch):
+        cache = _checked()
+        d = SequenceDescriptor(uid=1, slot=0)
+        cache.ensure(d, 16)  # 4 blocks
+        assert len(d.blocks) == 4
+
+        def buggy_rollback(self, desc, n_tokens):
+            keep = self.blocks_needed(n_tokens) - 1  # off-by-one over-free
+            freed = 0
+            while len(desc.blocks) > keep:
+                self._decref(desc.blocks.pop())
+                freed += 1
+            return freed
+
+        monkeypatch.setattr(BlockedKVCache, "rollback", buggy_rollback)
+        with pytest.raises(SanitizerError, match="rollback exactness"):
+            cache.rollback(d, 8)
+
+    def test_cow_exclusivity_violation_is_caught(self, monkeypatch):
+        cache = _checked()
+        _, d2 = self._two_sharing_descs(cache)
+
+        def buggy_cow(self, desc, j):
+            # forgets to detach: returns the SHARED block as the write dst
+            return desc.blocks[j], desc.blocks[j]
+
+        monkeypatch.setattr(BlockedKVCache, "copy_on_write", buggy_cow)
+        with pytest.raises(SanitizerError, match="COW"):
+            cache.copy_on_write(d2, 0)
+
+    def test_full_prompt_lookup_cap_is_enforced(self, monkeypatch):
+        cache = _checked()
+        d1, _ = self._two_sharing_descs(cache)
+
+        def buggy_lookup(self, desc, tokens):
+            # maps EVERY token as cached — leaves nothing to produce logits
+            for b in d1.blocks:
+                self._incref(b)
+            desc.blocks = list(d1.blocks)
+            desc.n_indexed = len(desc.blocks)
+            return len(tokens)
+
+        monkeypatch.setattr(BlockedKVCache, "lookup", buggy_lookup)
+        d3 = SequenceDescriptor(uid=3, slot=2)
+        with pytest.raises(SanitizerError, match="final prompt token"):
+            cache.lookup(d3, list(range(2 * cache.block_size)))
+
+
+class TestRequestStateMachine:
+    def _req(self):
+        return Request(prompt=[1, 2, 3], max_new_tokens=4)
+
+    def test_legal_walk_is_silent(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        req = self._req()
+        for s in (RequestState.PREFILL, RequestState.DECODE,
+                  RequestState.DECODE, RequestState.PREEMPTED,
+                  RequestState.QUEUED, RequestState.PREFILL,
+                  RequestState.DECODE, RequestState.DONE):
+            req.state = s
+        assert req.state is RequestState.DONE
+
+    @pytest.mark.parametrize("old,new", [
+        (RequestState.QUEUED, RequestState.DONE),
+        (RequestState.QUEUED, RequestState.DECODE),
+        (RequestState.DECODE, RequestState.PREFILL),
+        (RequestState.DONE, RequestState.QUEUED),
+        (RequestState.FAILED, RequestState.DECODE),
+        (RequestState.PREEMPTED, RequestState.DECODE),
+    ])
+    def test_illegal_edges_raise(self, monkeypatch, old, new):
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        req = self._req()
+        object.__setattr__(req, "state", old)
+        with pytest.raises(IllegalTransitionError, match="illegal request"):
+            req.state = new
+
+    def test_unchecked_when_disarmed(self, monkeypatch):
+        monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+        req = self._req()
+        req.state = RequestState.DONE  # illegal, but checked mode is off
+        assert req.state is RequestState.DONE
+
+    def test_check_transition_direct(self):
+        check_transition(1, None, RequestState.QUEUED)          # init
+        check_transition(1, RequestState.DONE, RequestState.DONE)  # self
+        with pytest.raises(IllegalTransitionError):
+            check_transition(1, RequestState.DONE, RequestState.QUEUED)
+
+
+class TestDrainLeakCheck:
+    def test_clean_engine_passes(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        eng.put([1], [[5, 6, 7]], greedy=True)
+        eng.flush(1)
+        check_drained(eng)
+
+    def test_resident_sequence_is_a_leak(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        eng.put([1], [[5, 6, 7]], greedy=True)
+        with pytest.raises(SanitizerError, match="pool leak"):
+            check_drained(eng)
+
+    def test_scheduler_close_reports_leaked_blocks(self, setup,
+                                                   monkeypatch):
+        """A scheduler whose finish path stops flushing (the plant) must
+        fail close() with the pool-leak diagnostic, not drain silently."""
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        m, params = setup
+        sched = ContinuousBatchScheduler(_engine(m, params))
+        monkeypatch.setattr(sched, "_engine_flush", lambda uid: None)
+        sched.submit([3, 4, 5], max_new_tokens=3)
+        sched.run_until_complete()
+        with pytest.raises(SanitizerError, match="pool leak"):
+            sched.close()
+
+    def test_scheduler_close_clean_under_sanitizer(self, setup,
+                                                   monkeypatch):
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        m, params = setup
+        with ContinuousBatchScheduler(_engine(m, params)) as sched:
+            req = sched.submit([3, 4, 5], max_new_tokens=3)
+            sched.run_until_complete()
+        assert req.state is RequestState.DONE
+
+
+class TestSilenceAndBitwiseOnCleanWorkload:
+    def _run(self, m, params, horizon=1):
+        eng = _engine(m, params, decode_horizon=horizon)
+        rng = np.random.default_rng(7)
+        with ContinuousBatchScheduler(eng) as sched:
+            reqs = [sched.submit(rng.integers(0, 128, int(n)).tolist(),
+                                 max_new_tokens=8)
+                    for n in rng.integers(4, 24, 6)]
+            sched.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        return [list(r.tokens) for r in reqs]
+
+    @pytest.mark.parametrize("horizon", [1, 4])
+    def test_checked_mode_is_silent_and_bitwise(self, setup, monkeypatch,
+                                                horizon):
+        """Sanitize ON changes nothing on a healthy workload (incl. fused
+        decode + rollback): same tokens, no diagnostics — the checker only
+        ever speaks when an invariant actually breaks."""
+        m, params = setup
+        monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+        plain = self._run(m, params, horizon)
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        checked = self._run(m, params, horizon)
+        assert checked == plain
+
+
+@pytest.mark.slow
+@pytest.mark.sanitize
+def test_sanitizer_overhead_is_bounded(setup, monkeypatch):
+    """Checked mode brackets every host-side allocator op with O(blocks)
+    verification; the compiled dispatches dominate, so the wall-clock cost
+    on the serving loop stays under ~10% (best-of-3 per mode to shave
+    scheduler noise on a loaded host)."""
+    m, params = setup
+
+    def run_once():
+        t0 = time.perf_counter()
+        eng = _engine(m, params, num_blocks=65)
+        rng = np.random.default_rng(3)
+        with ContinuousBatchScheduler(eng) as sched:
+            for n in rng.integers(4, 24, 8):
+                sched.submit(rng.integers(0, 128, int(n)).tolist(),
+                             max_new_tokens=16)
+            sched.run_until_complete()
+        return time.perf_counter() - t0
+
+    monkeypatch.delenv("DSTPU_SANITIZE", raising=False)
+    run_once()  # warm the compile caches out of the measurement
+    plain = min(run_once() for _ in range(3))
+    monkeypatch.setenv("DSTPU_SANITIZE", "1")
+    checked = min(run_once() for _ in range(3))
+    assert checked <= plain * 1.10, (
+        f"sanitizer overhead {checked / plain - 1:.1%} exceeds 10% "
+        f"({checked:.3f}s vs {plain:.3f}s)")
