@@ -28,6 +28,11 @@ the cache-on/cache-off speedup.
 The ``priority_mix`` row benches the scheduler itself: mixed priorities over
 a deliberately undersized block pool, reporting preemption and TTFT counters
 (every preempted request re-admits through the prefix cache).
+
+The ``prefill_convoy`` row is chunked interleaved prefill's acceptance A/B
+(docs/SERVING.md): long prompts arriving into a live decode batch, run
+chunked vs monolithic with bitwise-asserted tokens, TTFT p50/p95/p99, and
+``serve/prefill/*`` interleave counters.
 """
 
 import json
@@ -48,7 +53,8 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              prompt_hi=256, gen_lo=16, gen_hi=64, sync_each_step=False,
              shared_prefix=None, priorities=None, fault_injector=None,
              breaker=None, retry=None, watchdog=None, on_submitted=None,
-             collect_tokens=False):
+             collect_tokens=False, prompts=None, arrivals=None,
+             gen_targets=None, chunked_prefill=None):
     """Drive the engine with Poisson arrivals until all requests finish —
     through ``ContinuousBatchScheduler``, so the bench exercises the
     production admit/preempt/decode path (docs/SERVING.md), not a private
@@ -65,7 +71,10 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     the engine, the rest parameterize the scheduler. ``on_submitted(sched,
     reqs)`` runs after all submits (uid-dependent fault specs install here).
     ``collect_tokens`` returns per-request token streams for bitwise
-    fault-free-vs-faulted comparison.
+    fault-free-vs-faulted comparison. ``prompts``/``arrivals``/
+    ``gen_targets`` override the generated workload with an explicit one
+    (the prefill-convoy A/B), and ``chunked_prefill`` forwards to the
+    scheduler (None = its paged-mode default).
     """
     import jax
 
@@ -73,11 +82,14 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
 
     vocab = engine.cfg.vocab_size
     base = list(shared_prefix) if shared_prefix else []
-    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n_requests))
-    prompts = [base + rng.integers(0, vocab,
-                                   rng.integers(prompt_lo, prompt_hi + 1)).tolist()
-               for _ in range(n_requests)]
-    gen_targets = rng.integers(gen_lo, gen_hi + 1, n_requests)
+    if arrivals is None:
+        arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate, n_requests))
+    if prompts is None:
+        prompts = [base + rng.integers(
+            0, vocab, rng.integers(prompt_lo, prompt_hi + 1)).tolist()
+            for _ in range(n_requests)]
+    if gen_targets is None:
+        gen_targets = rng.integers(gen_lo, gen_hi + 1, n_requests)
     prios = priorities if priorities is not None else np.zeros(n_requests, int)
 
     # scheduling clock = wall time since start plus a fast-forward offset:
@@ -91,7 +103,9 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
 
     driven = engine if fault_injector is None else fault_injector.wrap(engine)
     kw = {k: v for k, v in (("breaker", breaker), ("retry", retry),
-                            ("watchdog", watchdog)) if v is not None}
+                            ("watchdog", watchdog),
+                            ("chunked_prefill", chunked_prefill))
+          if v is not None}
     sched = ContinuousBatchScheduler(driven, max_queue=n_requests,
                                      clock=clock, **kw)
     reqs = []
@@ -114,8 +128,12 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     out = {"generated_tokens": generated, "wall_s": round(wall, 2),
            "tokens_per_s": round(generated / wall, 1),
            "ttft_p50_ms": m["ttft_p50_ms"], "ttft_p95_ms": m["ttft_p95_ms"],
+           "ttft_p99_ms": m["ttft_p99_ms"],
            "preemptions": int(m["preemptions"]),
            "preempted_blocks_reclaimed": int(m["preempted_blocks_reclaimed"])}
+    # chunked interleaved prefill counters (docs/SERVING.md): all-zero on a
+    # monolithic (chunked_prefill=False) run — the A/B discriminator
+    out["prefill"] = {k: float(v) for k, v in sched.metrics.prefill.items()}
     # fused multi-token decode accounting (docs/SERVING.md): how many
     # compiled dispatches the decode phase cost per generated token
     dec = sched.metrics.decode
@@ -169,7 +187,10 @@ def run_chaos(eng, n_req: int) -> dict:
     culpable_idx = n_req // 4
 
     def arm_persistent(sched, reqs):
-        injector.inject(site="decode_step", kind="persistent",
+        # site "put": the chunked scheduler routes a live uid's work
+        # through the mixed put dispatch, and put fires no later than the
+        # uid's admission — the quarantine stays deterministic
+        injector.inject(site="put", kind="persistent",
                         uid=reqs[culpable_idx].uid)
 
     faulted = run_load(
@@ -284,6 +305,101 @@ def run_decode_horizon(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_prefill_convoy(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The chunked-prefill acceptance row (docs/SERVING.md): a handful of
+    long prompts (U[1024, 2048]) arriving into a live decode batch, with a
+    second wave of short requests queued behind them — the TTFT-convoy
+    shape. The SAME workload runs chunked (default) and monolithic
+    (``chunked_prefill=False``); greedy tokens must be bitwise identical,
+    aggregate tokens/s within noise, and chunked TTFT must be O(chunk):
+    the ISSUE 6 gate is ``ttft_p95 <= 8 * ttft_p50`` on the chunked run.
+
+    Like the decode-horizon row this uses a deliberately small model with
+    a long context: the convoy is a *scheduling* pathology (who waits on
+    whom), not a compute one, so host-scale prompts keep the A/B cheap."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    cfg = gpt2_config("125m", max_seq_len=2304, hidden_size=128,
+                      num_layers=2, num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def workload():
+        rng = np.random.default_rng(17)
+        n_live, n_long, n_late = 12, 4, 8
+        prompts, arrivals = [], []
+        for _ in range(n_live):   # the live decode batch, arrival t=0
+            prompts.append(rng.integers(
+                0, 1024, rng.integers(32, 65)).tolist())
+            arrivals.append(0.0)
+        for i in range(n_long):   # the convoy: long prompts into live decode
+            prompts.append(rng.integers(
+                0, 1024, rng.integers(1024, 2049)).tolist())
+            arrivals.append(0.5 + 0.1 * i)
+        for i in range(n_late):   # the victims: queued behind the longs
+            prompts.append(rng.integers(
+                0, 1024, rng.integers(32, 65)).tolist())
+            arrivals.append(1.0 + 0.05 * i)
+        n = n_live + n_long + n_late
+        return prompts, np.asarray(arrivals), np.full(n, 32)
+
+    runs = {}
+    toks = {}
+    for label, chunked in (("chunked", True), ("monolithic", False)):
+        eng = InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=2304,
+            prefill_chunk=256, dtype=jnp.bfloat16, paged=True,
+            block_size=64, token_budget=256,
+            num_blocks=1 + max_seqs * 36, prefix_cache=prefix_cache)
+        prompts, arrivals, gens = workload()
+        r = run_load(eng, n_requests=len(prompts), arrival_rate=1.0,
+                     rng=np.random.default_rng(0), prompts=prompts,
+                     arrivals=arrivals, gen_targets=gens,
+                     chunked_prefill=chunked, collect_tokens=True)
+        toks[label] = r.pop("request_tokens")
+        r.pop("request_states")
+        r["compiled_programs"] = eng.ragged_cache_size
+        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        runs[label] = r
+        del eng
+        gc.collect()
+    c, m = runs["chunked"], runs["monolithic"]
+    ratio = (c["tokens_per_s"] / m["tokens_per_s"]
+             if m["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "prefill_convoy",
+                               prefix_cache),
+        "value": c["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(ratio, 3) if ratio else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-convoy-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': "
+                      "1024} ctx=2304 (scheduling-bound convoy A/B)"),
+            "workload": ("12 short U[32,64] at t=0 (live decode batch) + "
+                         "4 long U[1024,2048] at t≈0.5 (the convoy) + "
+                         "8 short U[32,64] at t≈1.0 (queued behind), "
+                         "gen 32 each, chunked vs monolithic"),
+            "chunked": c, "monolithic": m,
+            "tokens_bitwise_identical": toks["chunked"] == toks["monolithic"],
+            "ttft_p95_over_p50_chunked": round(
+                c["ttft_p95_ms"] / c["ttft_p50_ms"], 2)
+            if c["ttft_p50_ms"] else None,
+            "ttft_p95_over_p50_monolithic": round(
+                m["ttft_p95_ms"] / m["ttft_p50_ms"], 2)
+            if m["ttft_p50_ms"] else None,
+            "throughput_ratio_chunked_vs_monolithic": round(ratio, 3)
+            if ratio else None,
+        },
+    }
+
+
 def _metric_name(mode: str, max_seqs: int, workload: str,
                  prefix_cache: bool) -> str:
     name = f"serve_{mode}_{max_seqs}seq"
@@ -343,6 +459,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
     n_req = int(os.environ.get("DSTPU_BENCH_REQUESTS", "120"))
     if workload == "decode_horizon":
         return run_decode_horizon(max_seqs, prefix_cache)
+    if workload == "prefill_convoy":
+        return run_prefill_convoy(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -439,6 +557,7 @@ CONFIGS = (
     ("paged", 32, "shared_prefix", False),
     ("paged", 32, "priority_mix", True),
     ("paged", 4, "decode_horizon", True),
+    ("paged", 16, "prefill_convoy", True),
 )
 
 

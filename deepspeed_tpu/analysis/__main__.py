@@ -13,6 +13,7 @@ import sys
 from typing import List, Optional
 
 from . import baseline as baseline_mod
+from .cache import LintCache, default_cache_path, lint_paths_cached
 from .lint import lint_paths
 from .rules import ALL_RULE_IDS, RULES
 
@@ -38,6 +39,14 @@ def _build_parser() -> argparse.ArgumentParser:
                         "the baseline and exit 0")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as a JSON array")
+    p.add_argument("--cache", default=None, metavar="FILE", nargs="?",
+                   const=default_cache_path(),
+                   help="mtime-keyed finding cache: unchanged files lint "
+                        "from the cache (default file: "
+                        f"{default_cache_path()}; invalidated by file "
+                        "edits, rule-set changes, and linter upgrades)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the finding cache even if one exists")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -76,7 +85,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"(known: {', '.join(ALL_RULE_IDS)})", file=sys.stderr)
             return 2
 
-    findings = lint_paths(paths, rule_ids)
+    cache = None
+    if args.cache is not None and not args.no_cache:
+        cache = LintCache(args.cache)
+        findings = lint_paths_cached(paths, rule_ids, cache)
+    else:
+        findings = lint_paths(paths, rule_ids)
 
     baseline_path = args.baseline or baseline_mod.default_path()
     if args.write_baseline:
@@ -113,6 +127,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             bits.append(f"{len(stale)} stale baseline entr"
                         f"{'y' if len(stale) == 1 else 'ies'} "
                         "(prune with --write-baseline)")
+        if cache is not None:
+            bits.append(f"cache {cache.hits} hit"
+                        f"{'' if cache.hits == 1 else 's'}/"
+                        f"{cache.misses} miss"
+                        f"{'' if cache.misses == 1 else 'es'}")
         print(f"dstpu-lint: {', '.join(bits)}")
     return 1 if unsuppressed else 0
 

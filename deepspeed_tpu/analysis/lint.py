@@ -95,8 +95,22 @@ def _in_scope(path_parts: Sequence[str], scope: Sequence[str]) -> bool:
 # jit-context discovery (rule DSTPU004)
 # ---------------------------------------------------------------------------
 
-_JIT_CALL_LASTS = {"jit", "pjit", "pmap"}
-_SCAN_DOTTED = {"lax.scan", "jax.lax.scan", "scan"}
+#: callables whose first positional argument becomes traced code and whose
+#: ``static_argnums``/``static_argnames`` kwargs exempt parameters.
+#: ``shard_map`` traces its body exactly like jit (every array argument is
+#: a tracer inside) — the multi-chip lintability prerequisite (ROADMAP).
+_JIT_CALL_LASTS = {"jit", "pjit", "pmap", "shard_map"}
+#: structured-control-flow callees → the positional args that are traced
+#: bodies (no static-argument machinery: every parameter is traced).
+#: ``lax.cond(pred, true_fn, false_fn, *ops)``; ``lax.while_loop(cond_fn,
+#: body_fn, init)``; ``lax.scan(body, init, xs)``.
+_BODY_CALL_ARGS = {"scan": (0,), "cond": (1, 2), "while_loop": (0, 1)}
+#: accepted spellings, mirroring the original lax.scan resolution: bare
+#: name or lax-qualified — a dotted path ending in e.g. ``foo.cond`` that
+#: is not lax is NOT a trace context
+_BODY_DOTTED = {form.format(name)
+                for name in _BODY_CALL_ARGS
+                for form in ("{}", "lax.{}", "jax.lax.{}")}
 
 
 def _param_names(fn: ast.AST) -> List[str]:
@@ -133,7 +147,9 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
     """Map FunctionDef nodes that become traced code → their *static*
     parameter names. Covers ``@jax.jit`` decoration (bare, called, and via
     ``functools.partial``), by-name ``jax.jit(f, ...)`` / ``pjit`` /
-    ``pmap`` calls, and ``lax.scan(f, ...)`` bodies."""
+    ``pmap`` / ``shard_map`` calls, and structured-control-flow bodies:
+    ``lax.scan(f, ...)``, ``lax.cond(p, true_fn, false_fn, ...)``, and
+    ``lax.while_loop(cond_fn, body_fn, ...)``."""
     parent: Dict[ast.AST, ast.AST] = {}
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
@@ -171,22 +187,28 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
             continue
         d = _dotted(node.func) or ""
         last = d.split(".")[-1]
-        is_jit = last in _JIT_CALL_LASTS
-        is_scan = d in _SCAN_DOTTED and last == "scan"
-        if not (is_jit or is_scan):
-            continue
-        arg0 = node.args[0]
-        if not isinstance(arg0, ast.Name):
+        if last in _JIT_CALL_LASTS:
+            positions, statics_call = (0,), node
+        elif d in _BODY_DOTTED:
+            positions, statics_call = _BODY_CALL_ARGS[last], None
+        else:
             continue
         chain = scope_chain(node)
-        for fn in defs.get(arg0.id, ()):
-            # the def must live in a scope enclosing the jit call (same
-            # local function, same class body, or module level) — a
-            # same-named def elsewhere in the file is not this target
-            if parent.get(fn) in chain or isinstance(parent.get(fn),
-                                                     ast.Module):
-                statics = (_static_names(fn, node) if is_jit else set())
-                targets[fn] = targets.get(fn, set()) | statics
+        for pos in positions:
+            if pos >= len(node.args):
+                continue
+            ref = node.args[pos]
+            if not isinstance(ref, ast.Name):
+                continue
+            for fn in defs.get(ref.id, ()):
+                # the def must live in a scope enclosing the tracing call
+                # (same local function, same class body, or module level) —
+                # a same-named def elsewhere in the file is not this target
+                if parent.get(fn) in chain or isinstance(parent.get(fn),
+                                                         ast.Module):
+                    statics = (_static_names(fn, statics_call)
+                               if statics_call is not None else set())
+                    targets[fn] = targets.get(fn, set()) | statics
     return targets
 
 

@@ -239,6 +239,43 @@ def checked_cache_cls():
 
 
 # ---------------------------------------------------------------------------
+# chunked-prefill ownership check
+# ---------------------------------------------------------------------------
+
+def check_prefill_ownership(engine, live: Dict[int, object]) -> None:
+    """Chunked interleaved prefill (docs/SERVING.md) makes ``PREFILL`` a
+    long-lived state: partially-prefilled sequences stay resident in the
+    engine across scheduler steps. Two invariants tie the scheduler's view
+    to the engine's between steps:
+
+    - every engine descriptor still holding pending (undispatched) tokens
+      belongs to a live request — an orphaned backlog row would keep
+      dispatching a dead request's prompt and leak its blocks;
+    - every live ``PREFILL``-state request is still resident with work
+      outstanding — a PREFILL request with no pending tokens lost its
+      backlog (it can never produce a first token).
+    """
+    state = getattr(engine, "state", None)
+    if state is None:
+        return
+    for uid, d in state.seqs.items():
+        if d.in_flight and uid not in live:
+            raise SanitizerError(
+                f"[sanitizer] orphaned prefill backlog: uid {uid} holds "
+                f"{d.in_flight} pending token(s) but no live request owns "
+                "it — cancel/preempt must flush pending work")
+    for uid, req in live.items():
+        if getattr(getattr(req, "state", None), "value", None) != "prefill":
+            continue
+        d = state.seqs.get(uid)
+        if d is None or d.in_flight == 0:
+            raise SanitizerError(
+                f"[sanitizer] live PREFILL request uid {uid} has no "
+                "pending work in the engine — its backlog was lost, the "
+                "request can never produce a first token")
+
+
+# ---------------------------------------------------------------------------
 # drain leak check
 # ---------------------------------------------------------------------------
 

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...analysis.sanitizer import checked_cache_cls, sanitize_enabled
-from ...resilience.errors import ContextOverflowError, EngineUsageError
+from ...resilience.errors import (ContextOverflowError, EngineUsageError,
+                                  PoolExhaustedError)
 from ...utils.logging import log_dist
 from ..config import DeepSpeedInferenceConfig
 from .ragged_manager import DSStateManager
@@ -97,6 +98,10 @@ class InferenceEngineV2:
         self.params = jax.tree_util.tree_map_with_path(cast, params)
         self.state = DSStateManager(max_seqs, self.max_seq_len)
         self.flush_noops = 0  # idempotent-flush debug counter (see flush())
+        #: rows deferred out of a ragged dispatch because their blocks could
+        #: not be allocated (the pool served the rows that fit instead of
+        #: failing the whole step) — chunked-prefill pressure diagnostics
+        self.plan_deferrals = 0
         self._prefill_fns = {}
         self._decode_fn = None
         self._cow_fn = None
@@ -314,17 +319,28 @@ class InferenceEngineV2:
         fn = self._prefill_fns.get("ragged")
         return 0 if fn is None else fn._cache_size()
 
-    def _put_paged(self, out: Dict[int, np.ndarray], greedy: bool = False) -> None:
-        """Drain all pending tokens through fixed-budget ragged steps.
+    def _put_paged(self, out: Dict[int, np.ndarray], greedy: bool = False,
+                   max_steps: Optional[int] = None) -> None:
+        """Advance pending tokens through fixed-budget ragged steps.
 
         Scheduling policy (the token-budget scheduler the reference hides
         behind ``query``/``can_schedule``): sequences with the fewest pending
         tokens go first — live decodes (1 token) always beat prefill chunks,
-        bounding decode latency under heavy prefill (split-fuse)."""
-        while True:
+        bounding decode latency under heavy prefill (split-fuse).
+
+        ``max_steps`` bounds how many compiled dispatches this call may run
+        (``None`` drains everything; ``0`` is register-only — no dispatch).
+        Chunked interleaved prefill (docs/SERVING.md) rides on ``1``: the
+        scheduler advances one budget of mixed decode+prefill-chunk rows per
+        iteration, so decode rounds and queued admissions never convoy
+        behind a long prompt's full prefill. Partially-prefilled sequences
+        simply keep their ``pending`` tail across calls."""
+        steps = 0
+        while max_steps is None or steps < max_steps:
             work = [d for d in self.state.seqs.values() if d.in_flight > 0]
             if not work:
                 return
+            steps += 1
             work.sort(key=lambda d: (d.in_flight, d.slot))
             # decode-round fast path: when every pending item is a single
             # token and they fit in max_seqs rows, use the small compiled
@@ -350,9 +366,27 @@ class InferenceEngineV2:
                 plan.append((d, take))
                 used += take
             # allocate blocks for the WHOLE step before mutating any sequence
-            # state — an exhaustion raise must leave every descriptor intact
+            # state. A row whose blocks cannot be allocated is DEFERRED (its
+            # tokens stay pending for a later dispatch) rather than failing
+            # rows that can run — under chunked interleaved prefill, live
+            # decodes must keep progressing (and freeing blocks) while a big
+            # prompt waits for pool capacity. Exhaustion raises only when
+            # nothing at all is dispatchable, with every descriptor's
+            # pending/seen state intact (blocks already grown are kept and
+            # used by the retried step, the standing retry contract).
+            ready: List[Tuple] = []
+            pool_exhausted: Optional[PoolExhaustedError] = None
             for d, take in plan:
-                self.block_mgr.ensure(d, d.seen_tokens + take)
+                try:
+                    self.block_mgr.ensure(d, d.seen_tokens + take)
+                except PoolExhaustedError as e:
+                    pool_exhausted = e
+                    self.plan_deferrals += 1
+                    continue
+                ready.append((d, take))
+            if not ready:
+                raise pool_exhausted
+            plan = ready
             if self.prefix_cache:
                 # copy-on-write: a write landing inside a block some OTHER
                 # sequence also references (a full-prompt cache hit recomputes
@@ -414,7 +448,8 @@ class InferenceEngineV2:
     # reference surface
     # ------------------------------------------------------------------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
-            do_checks: bool = True, greedy: bool = False) -> Dict[int, np.ndarray]:
+            do_checks: bool = True, greedy: bool = False,
+            max_steps: Optional[int] = None) -> Dict[int, np.ndarray]:
         """Advance the engine one step with new/continuing requests
         (reference ``engine_v2.py:107``).
 
@@ -423,6 +458,14 @@ class InferenceEngineV2:
         Returns {uid: (V,) numpy logits} — or, with ``greedy=True`` (paged
         mode), {uid: int token} sampled on-device (argmax), which avoids
         shipping the full logit rows to the host.
+
+        ``max_steps`` (paged only) bounds the number of compiled dispatches:
+        ``None`` drains every pending token (the monolithic path), ``0``
+        registers/extends sequences without dispatching (admission under
+        chunked interleaved prefill — the prefix-cache lookup still runs),
+        ``1`` advances one token-budget ragged step. Sequences whose prompt
+        is not fully consumed keep their ``pending`` tail and yield no
+        output yet; the final consumed token's dispatch returns their entry.
         """
         if do_checks and len(batch_uids) > self.state.max_seqs:
             raise EngineUsageError(
@@ -431,6 +474,10 @@ class InferenceEngineV2:
             raise ValueError(
                 "put(greedy=True) is paged-mode only (the slot prefill path "
                 "returns logits; decode_step supports greedy in both modes)")
+        if max_steps is not None and not self.paged:
+            raise ValueError(
+                "put(max_steps=...) is paged-mode only (slot prefill has no "
+                "mixed ragged dispatch to bound)")
         # 1. register / extend sequences
         for uid, toks in zip(batch_uids, batch_tokens):
             desc = self.state.get_or_create_sequence(uid)
@@ -451,7 +498,7 @@ class InferenceEngineV2:
         out: Dict[int, np.ndarray] = {}
         if self.paged:
             # single compiled ragged program over a fixed token budget
-            self._put_paged(out, greedy=greedy)
+            self._put_paged(out, greedy=greedy, max_steps=max_steps)
             return out
         # 2. slot mode: chunked prefill for pending prompt tokens (split-fuse:
         # bounded chunks, grouped by padded segment length). A sequence near
@@ -701,6 +748,12 @@ class InferenceEngineV2:
     def _blocks_held(self, uid: int) -> int:
         desc = self.state.seqs.get(uid)
         return len(desc.blocks) if (desc is not None and self.paged) else 0
+
+    def prefill_backlog(self) -> int:
+        """Pending (registered but undispatched) tokens across all resident
+        sequences — the chunked-prefill backlog the scheduler trades decode
+        horizon against (docs/SERVING.md). Zero on a fully-drained engine."""
+        return sum(d.in_flight for d in self.state.seqs.values())
 
     # reference ``query``/``can_schedule`` surface
     def query(self) -> Tuple[int, int]:

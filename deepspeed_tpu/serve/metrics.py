@@ -47,6 +47,19 @@ class ServeMetrics:
         #: KEPT tokens — rolled-back tokens are never emitted.
         self.decode: Dict[str, float] = {
             "horizon": 1.0, "fused_steps": 0, "rollback_tokens": 0}
+        #: chunked interleaved prefill counters (docs/SERVING.md), exported
+        #: under ``serve/prefill/*``: how many dispatches consumed prompt
+        #: tokens (``chunks``) and how many tokens they consumed
+        #: (``chunk_tokens``); ``interleaved_steps`` are dispatches that
+        #: carried BOTH live decode rows and prefill-chunk rows — the
+        #: convoy-killing shape — vs ``prefill_only_steps``;
+        #: ``deferred_steps`` made no prefill progress under pool pressure
+        #: (rows trimmed, decodes served); ``backlog_tokens`` is the
+        #: end-of-step pending-prompt gauge, ``backlog_peak`` its high water.
+        self.prefill: Dict[str, float] = {
+            "chunks": 0, "chunk_tokens": 0, "interleaved_steps": 0,
+            "prefill_only_steps": 0, "deferred_steps": 0,
+            "backlog_tokens": 0.0, "backlog_peak": 0}
         #: resilience counters, exported under ``serve/faults/*``
         #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
         #: step, the rest are incremented by the scheduler as faults land
@@ -82,6 +95,26 @@ class ServeMetrics:
     def observe_rollback(self, n_tokens: int) -> None:
         self.decode["rollback_tokens"] += n_tokens
 
+    def observe_prefill_chunk(self, n_tokens: int, interleaved: bool) -> None:
+        """One dispatch that consumed ``n_tokens`` prompt tokens;
+        ``interleaved`` when live decode rows shared the same program."""
+        self.prefill["chunks"] += 1
+        self.prefill["chunk_tokens"] += n_tokens
+        if interleaved:
+            self.prefill["interleaved_steps"] += 1
+        else:
+            self.prefill["prefill_only_steps"] += 1
+
+    def observe_prefill_deferred(self) -> None:
+        """A dispatch ran under a pending backlog but consumed no prompt
+        tokens (its prefill rows were trimmed under pool pressure)."""
+        self.prefill["deferred_steps"] += 1
+
+    def observe_prefill_backlog(self, backlog_tokens: int) -> None:
+        self.prefill["backlog_tokens"] = float(backlog_tokens)
+        self.prefill["backlog_peak"] = max(self.prefill["backlog_peak"],
+                                           backlog_tokens)
+
     def observe_gauges(self, queue_depth: int, live: int) -> None:
         self.queue_depth = queue_depth
         self.live = live
@@ -115,6 +148,7 @@ class ServeMetrics:
             "queue_peak": self.queue_peak,
             "ttft_p50_ms": round(self._pct(self.ttft_s, 50) * 1000, 2),
             "ttft_p95_ms": round(self._pct(self.ttft_s, 95) * 1000, 2),
+            "ttft_p99_ms": round(self._pct(self.ttft_s, 99) * 1000, 2),
             "token_lat_p50_ms": round(self._pct(self.step_lat_s, 50) * 1000, 2),
             "token_lat_p95_ms": round(self._pct(self.step_lat_s, 95) * 1000, 2),
         }
@@ -130,5 +164,7 @@ class ServeMetrics:
                  for k, v in sorted(self.summary().items())]
                 + [(f"serve/decode/{k}", float(v), step)
                    for k, v in sorted(self.decode.items())]
+                + [(f"serve/prefill/{k}", float(v), step)
+                   for k, v in sorted(self.prefill.items())]
                 + [(f"serve/faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])

@@ -9,6 +9,21 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   aged low-priority request always overtakes a *later-arriving* one — a
   steady stream of VIP traffic cannot starve the tail. Backpressure is a
   bounded queue: ``submit`` raises :class:`QueueFullError` when full.
+- **chunked interleaved prefill** (paged engines, default on): admission
+  only *registers* a request's prompt with the engine (the prefix-cache
+  lookup runs immediately); the prompt's tokens then ride the per-step
+  dispatch in budget-bounded chunks MIXED with the live decode rows — one
+  compiled ragged program per scheduler iteration, decode rows first
+  (shortest-pending-first), prefill chunks filling the remaining budget.
+  TTFT under a long-prompt convoy is O(chunk), not O(prompt): no decode
+  round, and no queued admission, ever waits for a whole foreign prefill.
+  Partially-prefilled requests are first-class: they persist in ``PREFILL``
+  across steps, stay preemptible (re-admission replays the prompt through
+  the prefix cache, which already indexed the partial prompt's full
+  blocks — bitwise-lossless under greedy), and rows whose KV blocks cannot
+  be allocated are deferred by the engine rather than stalling the batch.
+  ``chunked_prefill=False`` restores the monolithic drain-at-admission
+  path (the A/B baseline; slot engines always use it).
 - **preemption under block-pool pressure**: when ``can_schedule`` fails for
   a higher-priority arrival (or the shared KV block pool runs dry mid-step),
   a victim is selected — lowest priority, then most blocks held, then least
@@ -109,8 +124,30 @@ class ContinuousBatchScheduler:
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog: Optional[StepWatchdog] = None,
                  sleep: Callable[[float], None] = time.sleep,
-                 decode_horizon: Optional[int] = None):
+                 decode_horizon: Optional[int] = None,
+                 chunked_prefill: Optional[bool] = None):
         self.engine = engine
+        # chunked interleaved prefill (docs/SERVING.md): the default for
+        # paged engines — admission registers the prompt, its chunks ride
+        # the per-step mixed dispatch. False = monolithic drain at _start
+        # (the A/B baseline). Slot engines have no mixed ragged program to
+        # interleave into, so they always run monolithic.
+        if chunked_prefill is None:
+            chunked_prefill = bool(getattr(engine, "paged", False))
+        elif chunked_prefill and not getattr(engine, "paged", False):
+            raise ValueError(
+                "chunked_prefill=True needs a paged engine (prefill chunks "
+                "interleave into the mixed ragged dispatch)")
+        self.chunked_prefill = chunked_prefill
+        #: fused dispatches run since prefill last progressed — the duty
+        #: cycle _effective_horizon uses to trade K against backlog
+        self._fused_since_prefill = 0
+        #: priority of the highest-priority PREFILL request whose backlog
+        #: is deferral-starved under pool pressure (None = no starvation).
+        #: While set, _admit holds strictly-lower-priority candidates back:
+        #: freed capacity must reach the starved prefill, not be stolen by
+        #: a re-admitted victim's replay (the admit↔preempt ping-pong)
+        self._starved_prio: Optional[int] = None
         # fused multi-token decode (docs/SERVING.md): the horizon K the
         # decode loop MAY run at — defaults to the engine's compiled horizon.
         # The adaptive policy (_effective_horizon) collapses to 1 whenever
@@ -295,7 +332,7 @@ class ContinuousBatchScheduler:
                                      RequestState.DECODE)]:
             self._preempt(other)
             self.metrics.faults["containment_preemptions"] += 1
-        self._stalled = any(
+        self._stalled = not self.chunked_prefill and any(
             d.in_flight for d in self.engine.state.seqs.values())
 
     # ------------------------------------------------------------------
@@ -362,6 +399,16 @@ class ContinuousBatchScheduler:
             if not arrived:
                 return
             best = max(arrived, key=lambda r: self._score(r, now))
+            if (self.chunked_prefill and self._starved_prio is not None
+                    and best.priority <= self._starved_prio):
+                # a prefill at this priority or above is starved for
+                # blocks: freed capacity must reach it first — admitting
+                # now would let the candidate's replay re-grab (via the
+                # prefix-cache lookup) the very blocks a relief preemption
+                # just reclaimed, and the starved row would defer forever
+                # (the admit↔preempt ping-pong). Cleared the moment the
+                # backlog consumes a chunk again, or empties.
+                return
             if not self.engine.can_schedule(1):
                 # block-pool / slot pressure: a higher-priority arrival may
                 # evict a lower-priority live request — but only one whose
@@ -385,10 +432,17 @@ class ContinuousBatchScheduler:
             req.admitted_time = now
         self._live[req.uid] = req
         self.metrics.admitted += 1
+        if self.chunked_prefill:
+            # register + prefix-cache lookup only (max_steps=0): the
+            # prompt's chunks ride this step's mixed dispatch and onward —
+            # admission never runs a foreign prompt's prefill to completion
+            self._engine_put([req.uid], [req.replay_tokens()], max_steps=0)
+            return
         out = self._engine_put([req.uid], [req.replay_tokens()])
         self._absorb(out, now)
 
-    def _engine_put(self, uids: List[int], token_lists: List[List[int]]
+    def _engine_put(self, uids: List[int], token_lists: List[List[int]],
+                    max_steps: Optional[int] = None
                     ) -> Dict[int, np.ndarray]:
         """``engine.put`` with full fault handling.
 
@@ -412,10 +466,15 @@ class ContinuousBatchScheduler:
         while True:
             try:
                 t0 = time.perf_counter()
+                kw = {"max_steps": max_steps} if self.engine.paged else {}
                 out = self.engine.put(uids, token_lists,
-                                      greedy=self.engine.paged)
-                self._observe_engine_ok("prefill", time.perf_counter() - t0)
-                self._stalled = any(
+                                      greedy=self.engine.paged, **kw)
+                if max_steps != 0:
+                    self._observe_engine_ok("prefill",
+                                            time.perf_counter() - t0)
+                # chunked mode: pending tokens inside the engine are the
+                # normal mid-prefill case, never an admission-gating stall
+                self._stalled = not self.chunked_prefill and any(
                     d.in_flight for d in self.engine.state.seqs.values())
                 return out
             except TransientEngineError as e:
@@ -505,25 +564,45 @@ class ContinuousBatchScheduler:
         req.finish_time = now
         self.metrics.completed += 1
 
+    def _prefill_backlog(self) -> int:
+        """Pending prompt tokens registered with the engine but not yet
+        dispatched (the chunked-prefill backlog)."""
+        if not getattr(self.engine, "paged", False):
+            return 0
+        return self.engine.prefill_backlog()
+
     def _effective_horizon(self, now: float, feed: Dict[int, int]) -> int:
         """The horizon this decode round actually runs at. Collapses to 1 —
         single-step decode, unchanged TTFT/SLA behavior — whenever:
 
-        - admissions are queued (an arrived request is waiting; a K-step
-          dispatch would delay its admission by K token times),
-        - a stalled prefill is draining (its tokens interleave per step),
+        - a stalled monolithic prefill is draining,
+        - (monolithic mode) admissions are queued — a K-step dispatch would
+          delay the arrival's whole-prompt prefill by K token times,
         - a live request has fewer than K tokens remaining (don't generate
           guaranteed overrun) or fewer than K context positions left,
         - a live deadline falls inside the horizon's wall-clock budget
           (K × the EMA per-token dispatch time) — the fused step must not
           blow through an SLA the single-step loop would have honored.
+
+        Under chunked interleaved prefill a pending backlog no longer
+        hard-collapses the horizon: fused decode and prefill-serving mixed
+        dispatches ALTERNATE (at most one fused dispatch per dispatch that
+        consumed prompt tokens), so steady decode traffic keeps ~K/2 of the
+        fused amortization while the prefilling request's TTFT stays
+        O(chunk) at merely twice the all-prefill pace — the trade the
+        monolithic path couldn't make. Queued arrivals stop costing a
+        collapse too: admission is registration-only and its chunks enter
+        the same duty cycle next step.
         """
         K = self.decode_horizon
         if K <= 1 or not getattr(self.engine, "paged", False):
             return 1
         if self._stalled:
             return 1
-        if any(r.arrival_time <= now for r in self._queue):
+        if self.chunked_prefill:
+            if self._prefill_backlog() and self._fused_since_prefill >= 1:
+                return 1
+        elif any(r.arrival_time <= now for r in self._queue):
             return 1
         for uid in feed:
             req = self._live[uid]
@@ -539,17 +618,49 @@ class ContinuousBatchScheduler:
         return K
 
     def _decode_once(self, now: float) -> None:
-        feed = {uid: r.tokens[-1] for uid, r in self._live.items()
-                if r.state is RequestState.DECODE}
-        if not feed:
+        """One engine dispatch: the live decode feed plus — under chunked
+        interleaved prefill — as many pending prefill-chunk rows as the
+        token budget holds, in ONE compiled ragged program. Pure decode
+        rounds (no backlog) keep the dedicated ``decode_step``/fused paths
+        bitwise-unchanged."""
+        backlog = self._prefill_backlog() if self.chunked_prefill else 0
+        if not backlog:
+            # no pending prompt tokens: nothing is starved, and the fused
+            # duty cycle re-arms (must happen even when this round has no
+            # feed either — a stale starvation flag would gate admission
+            # of an empty system forever)
+            self._starved_prio = None
+            self._fused_since_prefill = 0
+        if self.chunked_prefill:
+            # a fed token deferred by a trimmed dispatch (pool pressure, or
+            # a fault raised after enqueue) still sits in the engine's
+            # pending queue — refeeding it would double-advance the request
+            feed = {}
+            for uid, r in self._live.items():
+                if r.state is not RequestState.DECODE:
+                    continue
+                d = self.engine.state.seqs.get(uid)
+                if d is not None and d.in_flight == 0:
+                    feed[uid] = r.tokens[-1]
+        else:
+            feed = {uid: r.tokens[-1] for uid, r in self._live.items()
+                    if r.state is RequestState.DECODE}
+        if not feed and not backlog:
             return
-        horizon = self._effective_horizon(now, feed)
+        horizon = self._effective_horizon(now, feed) if feed else 1
         attempt = 0
         while True:
             t0 = time.perf_counter()
             try:
                 if horizon > 1:
                     out = self.engine.decode_multi(feed, horizon=horizon)
+                elif backlog:
+                    # the mixed chunked-prefill dispatch: decode rows first
+                    # (the engine's shortest-pending-first order), prompt
+                    # chunks filling the rest of the token budget
+                    uids = list(feed)
+                    out = self.engine.put(uids, [[feed[u]] for u in uids],
+                                          greedy=True, max_steps=1)
                 else:
                     out = self.engine.decode_step(feed, greedy=True)
                 break
@@ -567,32 +678,83 @@ class ContinuousBatchScheduler:
             except PoolExhaustedError:
                 if not self.preemption:
                     raise
+                if self.chunked_prefill:
+                    # nothing was dispatchable: any pending prefill is
+                    # starved — route reclaimed capacity to it (see
+                    # _relieve_prefill_pressure / _admit)
+                    self._starved_prio = max(
+                        (r.priority for r in self._live.values()
+                         if r.state is RequestState.PREFILL), default=None)
                 # decode-time pool pressure: SOMEONE must yield or no
                 # sequence can progress (and nothing would ever free) —
-                # eviction here is unconditional on priority, lowest first
+                # eviction here is unconditional on priority, lowest first.
+                # Exception: a sole mid-prefill resident would just replay
+                # into the same wall (its replay needs at least the same
+                # blocks) — propagate, the pool cannot hold the request
                 victim = self._pick_victim()
-                if victim is None:
+                if victim is None or (
+                        len(self._live) == 1
+                        and victim.state is RequestState.PREFILL):
                     raise
                 self._preempt(victim)
                 return  # retry next step with the shrunken batch
         dt = time.perf_counter() - t0
-        self._observe_engine_ok("decode", dt, scale=horizon)
-        self.metrics.observe_step(dt, len(feed), horizon=horizon)
-        self.metrics.observe_decode(horizon, fused=horizon > 1)
-        per_tok = dt / horizon
-        self._token_est_s = (per_tok if self._token_est_s == 0.0
-                             else 0.5 * self._token_est_s + 0.5 * per_tok)
+        kind = "decode" if not backlog else ("mixed" if feed else "prefill")
+        self._observe_engine_ok(kind, dt, scale=horizon)
+        if feed:
+            self.metrics.observe_step(dt, len(feed), horizon=horizon)
+            self.metrics.observe_decode(horizon, fused=horizon > 1)
+            per_tok = dt / horizon
+            self._token_est_s = (per_tok if self._token_est_s == 0.0
+                                 else 0.5 * self._token_est_s + 0.5 * per_tok)
+        if backlog:
+            # chunked-prefill accounting + the fused/prefill duty cycle:
+            # a dispatch that consumed prompt tokens re-arms one fused
+            # dispatch; one that couldn't (rows trimmed under pool
+            # pressure) applies admission-style preemption pressure so a
+            # lower-priority resident can't starve a prefilling request
+            consumed = max(0, backlog - self._prefill_backlog())
+            if consumed:
+                self.metrics.observe_prefill_chunk(consumed,
+                                                   interleaved=bool(feed))
+                self._fused_since_prefill = 0
+                self._starved_prio = None
+            elif horizon > 1:
+                self._fused_since_prefill += 1
+            else:
+                self.metrics.observe_prefill_deferred()
+                self._relieve_prefill_pressure(now)
         if horizon > 1:
             self._absorb_multi(out, now)
         else:
             self._absorb(out, now)
+
+    def _relieve_prefill_pressure(self, now: float) -> None:
+        """A mixed dispatch under pool pressure served its decode rows but
+        deferred every prefill chunk. Decodes free blocks as they finish,
+        so the backlog is not wedged — but a strictly-lower-priority
+        resident should not make a prefilling request wait for organic
+        frees: evict one (the same priority test admission-time eviction
+        applies), and record the starved priority so _admit routes the
+        reclaimed capacity to the starved prefill instead of a re-admitted
+        victim."""
+        prio = max((r.priority for r in self._live.values()
+                    if r.state is RequestState.PREFILL), default=None)
+        self._starved_prio = prio
+        if prio is None or not self.preemption:
+            return
+        victim = self._pick_victim(below_priority=prio)
+        if victim is not None:
+            self._preempt(victim)
 
     # ------------------------------------------------------------------
     # driving surface
     # ------------------------------------------------------------------
     def step(self) -> bool:
         """One scheduler iteration: poll the breaker, expire deadlines,
-        admit, drain stalled prefills, run one decode round. Returns True
+        admit (registration-only under chunked prefill), drain stalled
+        monolithic prefills, then run ONE engine dispatch — mixed
+        decode+prefill-chunk rows when a backlog is pending. Returns True
         while work remains."""
         now = self._clock()
         self.breaker.poll(now)
@@ -602,7 +764,13 @@ class ContinuousBatchScheduler:
             self._absorb(self._engine_put([], []), now)
         self._decode_once(now)
         self.metrics.observe_gauges(len(self._queue), len(self._live))
+        self.metrics.observe_prefill_backlog(self._prefill_backlog())
         self.metrics.observe_resilience(self.breaker, self.watchdog)
+        if _sanitizer.sanitize_enabled():
+            # checked mode (docs/ANALYSIS.md): between steps, every pending
+            # backlog row must belong to a live request and every live
+            # PREFILL request must still have work in the engine
+            _sanitizer.check_prefill_ownership(self.engine, self._live)
         return bool(self._queue or self._live)
 
     def run_until_complete(self) -> None:

@@ -140,6 +140,7 @@ _SANITIZE_FILES = (
     "test_fused_decode.py",
     "test_inference_v2.py",
     "test_prefix_cache.py",
+    "test_chunked_prefill.py",
 )
 
 

@@ -223,6 +223,80 @@ def build():
         assert rules_of(src, path=TRAIN) == []
 
 
+class TestExtendedTraceContexts:
+    """DSTPU004 resolution beyond jit: ``shard_map`` bodies and
+    ``lax.cond``/``lax.while_loop`` callables are traced code too (the
+    multi-chip lintability prerequisite, ROADMAP)."""
+
+    def test_shard_map_body_is_traced(self):
+        src = """
+import jax
+
+def build(mesh):
+    def step(params, x):
+        if x > 0:
+            return x
+        return -x
+    return jax.shard_map(step, mesh=mesh, in_specs=None, out_specs=None)
+"""
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_cond_branches_are_traced(self):
+        src = """
+from jax import lax
+
+def build(pred, x):
+    def true_fn(v):
+        if v > 0:          # traced: cond branches get tracers
+            return v
+        return -v
+    def false_fn(v):
+        return float(v)    # traced: concretization hazard
+    return lax.cond(pred, true_fn, false_fn, x)
+"""
+        assert sorted(rules_of(src, path=TRAIN)) == ["DSTPU004"] * 2
+
+    def test_while_loop_cond_and_body_are_traced(self):
+        src = """
+from jax import lax
+
+def build(x):
+    def keep_going(v):
+        name = f"v={v}"    # f-string at trace time
+        return v < 10
+    def body(v):
+        if v > 0:
+            return v + 1
+        return v
+    return lax.while_loop(keep_going, body, x)
+"""
+        assert sorted(rules_of(src, path=TRAIN)) == ["DSTPU004"] * 2
+
+    def test_cond_predicate_arg_is_not_a_trace_context(self):
+        src = """
+from jax import lax
+
+def build(pred, x):
+    def picker(v):
+        if v > 0:          # plain host helper: passed as cond's PREDICATE
+            return v       # position, not a branch — must not be flagged
+        return -v
+    return lax.cond(picker, lambda v: v, lambda v: v, x)
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+    def test_non_lax_cond_name_is_not_a_trace_context(self):
+        src = """
+def build(scheduler, x):
+    def fn(v):
+        if v > 0:
+            return v
+        return -v
+    return scheduler.cond(fn, fn, x)   # foo.cond is not lax.cond
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+
 # ---------------------------------------------------------------------------
 # DSTPU005 — nondeterminism in decision logic
 # ---------------------------------------------------------------------------
@@ -385,6 +459,77 @@ class TestCLI:
         f.write_text("def f(:\n")
         assert lint_main([str(f), "--baseline", "none"]) == 1
         assert "DSTPU000" in capsys.readouterr().out
+
+
+class TestLintCache:
+    """mtime-keyed finding cache (docs/ANALYSIS.md): unchanged files are
+    served from the cache, edits/rule-set changes invalidate per file,
+    and suppression still applies on cached findings."""
+
+    def _tree(self, tmp_path):
+        f = tmp_path / "deepspeed_tpu" / "serve" / "mod.py"
+        f.parent.mkdir(parents=True)
+        f.write_text(SUPPRESSIBLE)
+        clean = tmp_path / "deepspeed_tpu" / "serve" / "clean.py"
+        clean.write_text("x = 1\n")
+        return tmp_path, f
+
+    def test_hit_on_unchanged_miss_on_edit(self, tmp_path):
+        from deepspeed_tpu.analysis.cache import LintCache, lint_paths_cached
+
+        root, f = self._tree(tmp_path)
+        cpath = str(tmp_path / "cache.json")
+        cold = LintCache(cpath)
+        found1 = lint_paths_cached([str(root)], None, cold)
+        assert cold.hits == 0 and cold.misses == 2
+        warm = LintCache(cpath)
+        found2 = lint_paths_cached([str(root)], None, warm)
+        assert warm.hits == 2 and warm.misses == 0
+        assert ([(x.rule, x.norm_path, x.line) for x in found1]
+                == [(x.rule, x.norm_path, x.line) for x in found2])
+        # an edit invalidates exactly that file (mtime_ns + size key)
+        f.write_text(SUPPRESSIBLE + "\n# touched\n")
+        os.utime(f, ns=(1, 1))  # force a distinct mtime even on fast FS
+        third = LintCache(cpath)
+        lint_paths_cached([str(root)], None, third)
+        assert third.hits == 1 and third.misses == 1
+
+    def test_rule_set_change_invalidates(self, tmp_path):
+        from deepspeed_tpu.analysis.cache import LintCache, lint_paths_cached
+
+        root, _ = self._tree(tmp_path)
+        cpath = str(tmp_path / "cache.json")
+        lint_paths_cached([str(root)], ["DSTPU001"], LintCache(cpath))
+        narrow = LintCache(cpath)
+        found = lint_paths_cached([str(root)], ["DSTPU002"], narrow)
+        assert narrow.misses == 2 and not found  # 001-only fixture
+
+    def test_corrupt_cache_is_cold_not_fatal(self, tmp_path):
+        from deepspeed_tpu.analysis.cache import LintCache, lint_paths_cached
+
+        root, _ = self._tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        cpath.write_text("{not json")
+        cache = LintCache(str(cpath))
+        found = lint_paths_cached([str(root)], None, cache)
+        assert cache.misses == 2 and len(found) >= 1
+
+    def test_cli_cache_flag_and_pragma_on_cached_findings(self, tmp_path,
+                                                          capsys):
+        root, _ = self._tree(tmp_path)
+        cpath = str(tmp_path / "cache.json")
+        argv = [str(root), "--baseline", "none", f"--cache={cpath}"]
+        assert lint_main(argv) == 1        # cold: finding reported
+        assert lint_main(argv) == 1        # warm: cached finding reported
+        out = capsys.readouterr().out
+        assert "cache 2 hits" in out
+        # baseline suppression applies to cached findings (fresh each run)
+        bl = tmp_path / "bl.txt"
+        assert lint_main([str(root), "--baseline", str(bl),
+                          "--write-baseline"]) == 0
+        assert lint_main([str(root), "--baseline", str(bl),
+                          f"--cache={cpath}"]) == 0
+        capsys.readouterr()
 
 
 # ---------------------------------------------------------------------------

@@ -41,11 +41,12 @@ def _prompts(n=3):
     return [rng.integers(0, 128, ln).tolist() for ln in (33, 30, 28)][:n]
 
 
-def _run_sched(m, params, prompts, gen=16, eos=None, **ekw):
+def _run_sched(m, params, prompts, gen=16, eos=None, priorities=None, **ekw):
     eng = _engine(m, params, **ekw)
     sched = ContinuousBatchScheduler(eng)
-    reqs = [sched.submit(p, max_new_tokens=gen, eos_token=eos)
-            for p in prompts]
+    prios = priorities or [0] * len(prompts)
+    reqs = [sched.submit(p, max_new_tokens=gen, eos_token=eos, priority=pr)
+            for p, pr in zip(prompts, prios)]
     sched.run_until_complete()
     return eng, sched, reqs
 
@@ -164,12 +165,15 @@ class TestFusedScheduler:
 
     def test_bitwise_under_preemption_churn(self, setup):
         """An undersized pool forces preempt/re-admit churn mid-fused-load;
-        greedy output stays bitwise identical to uncontended runs."""
+        greedy output stays bitwise identical to uncontended runs. Mixed
+        priorities make the churn deterministic under chunked prefill: the
+        highest-priority (longest) prompt's starved chunks preempt the
+        lower-priority residents instead of waiting for organic frees."""
         m, params = setup
         prompts = _prompts()
         refs = [_run_sched(m, params, [p])[2][0].tokens for p in prompts]
         eng, sched, reqs = _run_sched(m, params, prompts, decode_horizon=4,
-                                      num_blocks=9)
+                                      num_blocks=7, priorities=[2, 1, 0])
         assert sched.metrics.preemptions > 0
         assert sched.metrics.decode["fused_steps"] > 0
         assert [r.tokens for r in reqs] == refs
@@ -230,7 +234,10 @@ class TestFusedScheduler:
         stalled prefill, and <K context positions left."""
         m, params = setup
         eng = _engine(m, params, decode_horizon=4)
-        sched = ContinuousBatchScheduler(eng)
+        # monolithic mode: these are the LEGACY collapse conditions (queued
+        # arrivals included); the chunked-prefill horizon/backlog duty
+        # cycle is covered in test_chunked_prefill.TestHorizonBacklogTrade
+        sched = ContinuousBatchScheduler(eng, chunked_prefill=False)
         r1 = sched.submit(_prompts(1)[0], max_new_tokens=12)
         sched.step()
         assert r1.state is RequestState.DECODE

@@ -214,7 +214,11 @@ def _run_workload(m, params, n_req, *, injector=None, breaker=None,
         retry=RetryPolicy(max_attempts=5), sleep=lambda s: None, **sched_kw)
     reqs = [sched.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
     if persistent_index is not None:
-        injector.inject(site="decode_step", kind="persistent",
+        # site "put": under chunked interleaved prefill the scheduler
+        # routes this uid's work through the mixed put dispatch (pure
+        # decode_step rounds may never carry it), and put fires no later
+        # than the uid's admission registration — deterministic quarantine
+        injector.inject(site="put", kind="persistent",
                         uid=reqs[persistent_index].uid)
     sched.run_until_complete()
     return sched, eng, reqs
@@ -234,9 +238,15 @@ class TestChaosContainment:
         assert all(r.state is RequestState.DONE for r in ref)
         _assert_pool_restored(ref_eng)
 
+        # both bursts target put: the chunked scheduler drives admissions
+        # AND mixed decode+chunk dispatches through it (pure decode_step
+        # rounds only happen when no prompt backlog is pending, which this
+        # admission-saturated workload rarely guarantees). Burst one is
+        # retried away (2 < threshold); burst two (3 consecutive) opens
+        # the breaker.
         inj = FaultInjector([
             dict(site="put", kind="transient", nth=2, count=2),
-            dict(site="decode_step", kind="transient", nth=5, count=3),
+            dict(site="put", kind="transient", nth=5, count=3),
         ])
         # cooldown 0: OPEN -> HALF_OPEN on the next poll, the probe is the
         # next engine call — the recovery walk is deterministic

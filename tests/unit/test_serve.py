@@ -106,7 +106,8 @@ class TestLifecycleAndStreaming:
         assert dead.cancel_reason == "deadline"
         assert sched.metrics.deadline_cancels == 1
         assert live.state in (RequestState.DECODE, RequestState.DONE)
-        assert sched.cancel(live.uid) is (not live.finished)
+        was_finished = live.finished  # capture BEFORE cancel mutates it
+        assert sched.cancel(live.uid) is (not was_finished)
         assert not eng.state.seqs
         sched.run_until_complete()
 
@@ -174,8 +175,13 @@ class TestAdmissionPolicy:
         m, params = setup
         eng = _engine(m, params, max_seqs=1)
         vt = [0.0]
+        # monolithic prefill: the virtual-time math below counts one
+        # admission+completion per step, which needs prefill+both decodes
+        # inside a single step (chunked mode spreads them over dispatches;
+        # the admission *order* under test is identical either way)
         sched = ContinuousBatchScheduler(eng, age_weight=1.0,
-                                         clock=lambda: vt[0])
+                                         clock=lambda: vt[0],
+                                         chunked_prefill=False)
         rng = np.random.default_rng(3)
         low = sched.submit(rng.integers(0, 128, 8).tolist(), priority=0,
                            max_new_tokens=2, arrival_time=0.0)
