@@ -33,6 +33,12 @@ The ``prefill_convoy`` row is chunked interleaved prefill's acceptance A/B
 (docs/SERVING.md): long prompts arriving into a live decode batch, run
 chunked vs monolithic with bitwise-asserted tokens, TTFT p50/p95/p99, and
 ``serve/prefill/*`` interleave counters.
+
+The ``spec_decode`` row is speculative decoding's acceptance A/B
+(docs/SERVING.md): prompt-lookup self-drafting + one-dispatch batch
+verification vs the K=8 fused decode baseline, on a drafting-friendly
+single-stream workload (the ISSUE 8 >2.5x gate) and a natural batched one,
+tokens bitwise-asserted and ``serve/spec/*`` acceptance counters reported.
 """
 
 import json
@@ -54,7 +60,7 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              shared_prefix=None, priorities=None, fault_injector=None,
              breaker=None, retry=None, watchdog=None, on_submitted=None,
              collect_tokens=False, prompts=None, arrivals=None,
-             gen_targets=None, chunked_prefill=None):
+             gen_targets=None, chunked_prefill=None, proposer=None):
     """Drive the engine with Poisson arrivals until all requests finish —
     through ``ContinuousBatchScheduler``, so the bench exercises the
     production admit/preempt/decode path (docs/SERVING.md), not a private
@@ -74,7 +80,10 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     fault-free-vs-faulted comparison. ``prompts``/``arrivals``/
     ``gen_targets`` override the generated workload with an explicit one
     (the prefill-convoy A/B), and ``chunked_prefill`` forwards to the
-    scheduler (None = its paged-mode default).
+    scheduler (None = its paged-mode default). ``proposer`` (a
+    ``DraftProposer``/``SpecPolicy``) turns on speculative decoding — the
+    engine must be compiled with ``decode_horizon > 1``; the ``serve/spec``
+    counters are reported under ``"spec"``.
     """
     import jax
 
@@ -104,7 +113,8 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     driven = engine if fault_injector is None else fault_injector.wrap(engine)
     kw = {k: v for k, v in (("breaker", breaker), ("retry", retry),
                             ("watchdog", watchdog),
-                            ("chunked_prefill", chunked_prefill))
+                            ("chunked_prefill", chunked_prefill),
+                            ("proposer", proposer))
           if v is not None}
     sched = ContinuousBatchScheduler(driven, max_queue=n_requests,
                                      clock=clock, **kw)
@@ -143,6 +153,9 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     if sched.decode_horizon > 1:
         out["fused_steps"] = int(dec["fused_steps"])
         out["rollback_tokens"] = int(dec["rollback_tokens"])
+    if proposer is not None:
+        # speculative-decoding acceptance accounting (serve/spec/*)
+        out["spec"] = {k: float(v) for k, v in sched.metrics.spec.items()}
     if sync_each_step:
         # decode-step latency == per-token latency (keys predate the
         # scheduler; sourced from its per-step samples now)
@@ -165,24 +178,34 @@ def run_chaos(eng, n_req: int) -> dict:
     reference pass, then the SAME workload under a seeded fault plan —
     transient put/decode bursts (enough consecutive failures to open the
     circuit breaker), one latency spike, and one persistent per-request
-    fault. Reports goodput degradation, breaker recovery
-    (open -> half_open -> closed), and bitwise token integrity: every
-    non-failed request must produce exactly the fault-free tokens (greedy) —
-    faults may slow the fleet down, never corrupt or duplicate output."""
+    fault. The workload decodes speculatively (the engine is built with
+    ``decode_horizon=4`` and both passes run a ``PromptLookupProposer``),
+    so the plan's transient/latency specs cover the full chunked site mix —
+    ``put``, ``decode_multi`` (degraded rounds), and ``verify_multi`` —
+    and a faulted speculation step must retry verbatim. Reports goodput
+    degradation, breaker recovery (open -> half_open -> closed), and
+    bitwise token integrity: every non-failed request must produce exactly
+    the fault-free tokens (greedy) — faults may slow the fleet down, never
+    corrupt or duplicate output."""
     from deepspeed_tpu.resilience import (CircuitBreaker, FaultInjector,
                                           RetryPolicy, StepWatchdog)
+    from deepspeed_tpu.serve import PromptLookupProposer
 
     def fresh_rng():
         return np.random.default_rng(21)
 
     base = run_load(eng, n_requests=n_req, arrival_rate=200.0,
-                    rng=fresh_rng(), collect_tokens=True)
+                    rng=fresh_rng(), collect_tokens=True,
+                    proposer=PromptLookupProposer())
     for uid in list(eng.state.seqs):
         eng.flush(uid)
     injector = FaultInjector(seed=13)
     injector.inject(site="put", kind="transient", nth=3, count=2)
-    injector.inject(site="decode_step", kind="transient", nth=10, count=3)
-    injector.inject(site="decode_step", kind="latency", nth=25,
+    injector.inject(site="decode_multi", kind="transient", nth=2, count=2)
+    injector.inject(site="verify_multi", kind="transient", nth=3, count=3)
+    injector.inject(site="verify_multi", kind="latency", nth=8,
+                    latency_s=0.02)
+    injector.inject(site="decode_step", kind="latency", nth=5,
                     latency_s=0.02)
     culpable_idx = n_req // 4
 
@@ -196,6 +219,7 @@ def run_chaos(eng, n_req: int) -> dict:
     faulted = run_load(
         eng, n_requests=n_req, arrival_rate=200.0, rng=fresh_rng(),
         collect_tokens=True, fault_injector=injector,
+        proposer=PromptLookupProposer(),
         breaker=CircuitBreaker(failure_threshold=3, cooldown_s=0.5,
                                shed_priority_floor=1),
         retry=RetryPolicy(max_attempts=5, base_s=0.005, cap_s=0.05, seed=7),
@@ -301,6 +325,151 @@ def run_decode_horizon(max_seqs: int, prefix_cache: bool = True) -> dict:
                 horizons["K4"]["tokens_per_s"]
                 / horizons["K1"]["tokens_per_s"], 3)
             if horizons["K1"]["tokens_per_s"] else None,
+        },
+    }
+
+
+def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The speculative-decoding acceptance row (docs/SERVING.md): prompt-
+    lookup self-drafting + fused batch verification vs the PR-4 K=8 fused
+    decode baseline, on two workloads.
+
+    - ``repetition``: the drafting-friendly shape — a SINGLE latency-bound
+      stream whose prompt already contains its own continuation (the
+      extraction / quote-heavy serving case; synthesized here by seeding the
+      prompt with the model's own greedy continuation, generated off the
+      clock). Prompt-lookup drafts near-perfectly, so each verify dispatch
+      commits ~K tokens while the fused baseline's ``lax.scan`` still pays
+      its per-round cost K times per dispatch even at batch 1 — the
+      single-stream regime is where speculation pays most, exactly as in
+      the literature. The ISSUE 8 gate is >2.5x tokens/s vs fused K=8 with
+      bitwise-identical tokens.
+    - ``natural``: ``max_seqs`` concurrent random prompts (nothing seeded)
+      at equal horizon — reports the honest acceptance rate and whatever
+      speedup the workload's self-repetition yields; no gate.
+
+    Both workloads are greedy and asserted bitwise identical to the
+    non-speculative baseline — a bad draft can only cost throughput. Like
+    the decode-horizon row this uses a deliberately small model (the
+    regime where per-round host/dispatch overhead is comparable to
+    per-round compute); warmup passes pay every compile off the clock and
+    the measured number is best-of-3."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.serve import PromptLookupProposer
+
+    cfg = gpt2_config("125m", max_seq_len=512, hidden_size=128, num_layers=2,
+                      num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    K_SPEC, K_BASE = 16, 8
+
+    def engine(n_seqs, k):
+        return InferenceEngineV2(
+            model, params, max_seqs=n_seqs, max_seq_len=512,
+            prefill_chunk=64, dtype=jnp.bfloat16, paged=True, block_size=32,
+            token_budget=64, num_blocks=1 + n_seqs * 16, decode_horizon=k,
+            prefix_cache=prefix_cache)
+
+    def measure(eng, prompts, gens, spec, passes=3):
+        best = None
+        for i in range(passes + 1):  # pass 0 = warmup (compiles, cold cache)
+            for uid in list(eng.state.seqs):
+                eng.flush(uid)
+            r = run_load(eng, n_requests=len(prompts), arrival_rate=1e9,
+                         rng=np.random.default_rng(3),
+                         prompts=[list(p) for p in prompts],
+                         arrivals=np.zeros(len(prompts)),
+                         gen_targets=np.asarray(gens, dtype=int),
+                         collect_tokens=True,
+                         proposer=PromptLookupProposer() if spec else None)
+            if i and (best is None or r["tokens_per_s"] > best["tokens_per_s"]):
+                best = r
+        toks = best.pop("request_tokens")
+        best.pop("request_states")
+        return best, toks
+
+    rng = np.random.default_rng(23)
+
+    # --- repetition workload: seed the prompt with the model's own 48-token
+    # greedy continuation (off the clock) so the answer is in the prompt ---
+    base = [rng.integers(0, 1024, 16).tolist()]
+    eng_p = engine(1, K_BASE)
+    _, pilot = measure(eng_p, base, [48], spec=False, passes=1)
+    rep_prompts = [base[0] + pilot[0]]
+    del eng_p
+    gc.collect()
+    GEN = 336  # a multiple of both horizons: no partial-round tail
+    eng_b = engine(1, K_BASE)
+    rep_base, rep_base_toks = measure(eng_b, rep_prompts, [GEN], spec=False)
+    del eng_b
+    gc.collect()
+    eng_s = engine(1, K_SPEC)
+    # warm the degraded-path fused K=16 program off the clock too
+    measure(eng_s, rep_prompts, [GEN], spec=False, passes=1)
+    rep_spec, rep_spec_toks = measure(eng_s, rep_prompts, [GEN], spec=True)
+    assert eng_s.ragged_cache_size <= 4 and eng_s.fused_cache_size <= 1 \
+        and eng_s.verify_cache_size <= 1, (
+            eng_s.ragged_cache_size, eng_s.fused_cache_size,
+            eng_s.verify_cache_size)
+    rep_programs = (eng_s.ragged_cache_size + eng_s.fused_cache_size
+                    + eng_s.verify_cache_size)
+    del eng_s
+    gc.collect()
+
+    # --- natural workload: nothing to look up but the output's own
+    # self-repetition; equal horizon K=8, max_seqs concurrent streams ---
+    nat_prompts = [rng.integers(0, 1024, int(rng.integers(32, 129))).tolist()
+                   for _ in range(max_seqs)]
+    nat_gens = [96] * max_seqs
+    eng_n = engine(max_seqs, K_BASE)
+    nat_base, nat_base_toks = measure(eng_n, nat_prompts, nat_gens,
+                                      spec=False)
+    nat_spec, nat_spec_toks = measure(eng_n, nat_prompts, nat_gens,
+                                      spec=True)
+    assert eng_n.ragged_cache_size <= 4 and eng_n.fused_cache_size <= 1 \
+        and eng_n.verify_cache_size <= 1, (
+            eng_n.ragged_cache_size, eng_n.fused_cache_size,
+            eng_n.verify_cache_size)
+    del eng_n
+    gc.collect()
+
+    speedup = (rep_spec["tokens_per_s"] / rep_base["tokens_per_s"]
+               if rep_base["tokens_per_s"] else None)
+    nat_speedup = (nat_spec["tokens_per_s"] / nat_base["tokens_per_s"]
+                   if nat_base["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "spec_decode",
+                               prefix_cache),
+        "value": rep_spec["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(speedup, 2) if speedup else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-spec-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': 1024} "
+                      "ctx=512 (host-overhead-bound decode)"),
+            "workload": ("repetition: 1 stream, 64-tok prompt seeded with "
+                         f"the model's own continuation, gen {GEN}, "
+                         f"prompt-lookup K={K_SPEC} vs fused K={K_BASE}; "
+                         f"natural: {max_seqs} random prompts U[32,128], "
+                         f"gen 96, K={K_BASE} both"),
+            "repetition": {"fused_k8": rep_base, "speculative": rep_spec},
+            "natural": {"fused_k8": nat_base, "speculative": nat_spec},
+            "tokens_bitwise_identical": (
+                rep_spec_toks == rep_base_toks
+                and nat_spec_toks == nat_base_toks),
+            "speedup_spec_vs_fused_k8_repetition": round(speedup, 3)
+            if speedup else None,
+            "speedup_spec_vs_fused_k8_natural": round(nat_speedup, 3)
+            if nat_speedup else None,
+            "acceptance_rate_repetition": rep_spec["spec"]["acceptance_rate"],
+            "acceptance_rate_natural": nat_spec["spec"]["acceptance_rate"],
+            "compiled_programs": rep_programs,
         },
     }
 
@@ -435,11 +604,17 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       admissions, so the adaptive horizon stays at K), long uniform decodes.
       Reports tokens/s, dispatches/token, compiled-program count, and
       bitwise K-vs-1 token identity per horizon.
+    - ``spec_decode``: the speculative-decoding A/B (docs/SERVING.md):
+      prompt-lookup drafting + ``verify_multi`` batch verification against
+      the K=8 fused baseline on a drafting-friendly single stream (the
+      >2.5x ISSUE 8 gate) plus a natural batched workload, both greedy and
+      bitwise-asserted, with ``serve/spec/*`` acceptance counters.
     - ``chaos`` (``--faults``): the mixed workload under a seeded fault plan
-      (transient bursts, a latency spike, one persistent per-request fault)
-      vs its own fault-free reference — goodput must degrade gracefully, the
-      breaker must recover, and no token may be lost or duplicated
-      (docs/RESILIENCE.md).
+      (transient bursts, latency spikes, one persistent per-request fault)
+      vs its own fault-free reference, decoding speculatively so the site
+      mix spans ``put``/``decode_multi``/``verify_multi`` — goodput must
+      degrade gracefully, the breaker must recover, and no token may be
+      lost or duplicated (docs/RESILIENCE.md).
     """
     import logging
 
@@ -461,6 +636,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_decode_horizon(max_seqs, prefix_cache)
     if workload == "prefill_convoy":
         return run_prefill_convoy(max_seqs, prefix_cache)
+    if workload == "spec_decode":
+        return run_spec_decode(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -482,7 +659,10 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         prefill_chunk=256, dtype=jnp.bfloat16, paged=(mode == "paged"),
         block_size=64, token_budget=256 if mode == "paged" else 0,
         num_blocks=(1 + max_seqs * blocks_per_seq) if mode == "paged" else None,
-        prefix_cache=prefix_cache)
+        prefix_cache=prefix_cache,
+        # the chaos row runs speculatively (decode_horizon 4 + prompt-lookup)
+        # so the fault plan can exercise the verify_multi/decode_multi sites
+        decode_horizon=4 if workload == "chaos" else 1)
     if workload == "chaos":
         chaos = run_chaos(eng, n_req)
         row = {
@@ -498,10 +678,14 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
                              "put/decode bursts + latency spike + one "
                              "persistent per-request fault"),
                 "chaos": chaos,
-                "compiled_programs": eng.ragged_cache_size,
+                "compiled_programs": (eng.ragged_cache_size
+                                      + eng.fused_cache_size
+                                      + eng.verify_cache_size),
             },
         }
         assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
+        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1, (
+            eng.fused_cache_size, eng.verify_cache_size)
         return row
     prefix = (rng.integers(0, cfg.vocab_size, 256).tolist() if shared else None)
     load_kw = dict(shared_prefix=prefix)
@@ -558,6 +742,7 @@ CONFIGS = (
     ("paged", 32, "priority_mix", True),
     ("paged", 4, "decode_horizon", True),
     ("paged", 16, "prefill_convoy", True),
+    ("paged", 4, "spec_decode", True),
 )
 
 

@@ -14,7 +14,8 @@ families over the tree:
   (``resilience.errors``) is mandatory there.
 - **DSTPU004** retrace/concretization hazards inside functions that are
   jitted (decorated with ``jax.jit``, passed to ``jax.jit``/``pjit``/
-  ``pmap`` by name, or used as a ``lax.scan`` body): Python branches on
+  ``pmap`` by name, or used as a ``lax.scan``/``cond``/``while_loop``/
+  ``fori_loop`` body or a ``lax.switch`` branch): Python branches on
   traced parameters (``static_argnums``/``static_argnames`` are parsed
   and exempted), f-strings built at trace time, and ``int()``/``float()``/
   ``bool()`` concretization of traced values.
@@ -103,8 +104,11 @@ _JIT_CALL_LASTS = {"jit", "pjit", "pmap", "shard_map"}
 #: structured-control-flow callees → the positional args that are traced
 #: bodies (no static-argument machinery: every parameter is traced).
 #: ``lax.cond(pred, true_fn, false_fn, *ops)``; ``lax.while_loop(cond_fn,
-#: body_fn, init)``; ``lax.scan(body, init, xs)``.
-_BODY_CALL_ARGS = {"scan": (0,), "cond": (1, 2), "while_loop": (0, 1)}
+#: body_fn, init)``; ``lax.scan(body, init, xs)``; ``lax.fori_loop(lower,
+#: upper, body_fn, init)``; ``lax.switch(index, branches, *ops)`` — the
+#: ``branches`` arg is a LIST/TUPLE of traced callables, unpacked below.
+_BODY_CALL_ARGS = {"scan": (0,), "cond": (1, 2), "while_loop": (0, 1),
+                   "fori_loop": (2,), "switch": (1,)}
 #: accepted spellings, mirroring the original lax.scan resolution: bare
 #: name or lax-qualified — a dotted path ending in e.g. ``foo.cond`` that
 #: is not lax is NOT a trace context
@@ -148,8 +152,10 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
     parameter names. Covers ``@jax.jit`` decoration (bare, called, and via
     ``functools.partial``), by-name ``jax.jit(f, ...)`` / ``pjit`` /
     ``pmap`` / ``shard_map`` calls, and structured-control-flow bodies:
-    ``lax.scan(f, ...)``, ``lax.cond(p, true_fn, false_fn, ...)``, and
-    ``lax.while_loop(cond_fn, body_fn, ...)``."""
+    ``lax.scan(f, ...)``, ``lax.cond(p, true_fn, false_fn, ...)``,
+    ``lax.while_loop(cond_fn, body_fn, ...)``, ``lax.fori_loop(lo, hi,
+    body_fn, init)``, and every element of a ``lax.switch(i, [f, g, ...])``
+    branch list."""
     parent: Dict[ast.AST, ast.AST] = {}
     for node in ast.walk(tree):
         for child in ast.iter_child_nodes(node):
@@ -197,18 +203,24 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
         for pos in positions:
             if pos >= len(node.args):
                 continue
-            ref = node.args[pos]
-            if not isinstance(ref, ast.Name):
-                continue
-            for fn in defs.get(ref.id, ()):
-                # the def must live in a scope enclosing the tracing call
-                # (same local function, same class body, or module level) —
-                # a same-named def elsewhere in the file is not this target
-                if parent.get(fn) in chain or isinstance(parent.get(fn),
-                                                         ast.Module):
-                    statics = (_static_names(fn, statics_call)
-                               if statics_call is not None else set())
-                    targets[fn] = targets.get(fn, set()) | statics
+            arg = node.args[pos]
+            # lax.switch passes its branch callables as ONE list/tuple
+            # argument — every element is an independent trace context
+            refs = (list(arg.elts)
+                    if isinstance(arg, (ast.List, ast.Tuple)) else [arg])
+            for ref in refs:
+                if not isinstance(ref, ast.Name):
+                    continue
+                for fn in defs.get(ref.id, ()):
+                    # the def must live in a scope enclosing the tracing
+                    # call (same local function, same class body, or module
+                    # level) — a same-named def elsewhere in the file is
+                    # not this target
+                    if parent.get(fn) in chain or isinstance(
+                            parent.get(fn), ast.Module):
+                        statics = (_static_names(fn, statics_call)
+                                   if statics_call is not None else set())
+                        targets[fn] = targets.get(fn, set()) | statics
     return targets
 
 

@@ -33,8 +33,9 @@ class Rule:
 #: here multiply by tokens/second. (``step``/``_absorb*``/``_decode_once``
 #: are the scheduler's per-token loop; the rest are the engine's.)
 HOT_FUNCTIONS: FrozenSet[str] = frozenset({
-    "decode_step", "decode_multi", "_put_paged",
-    "_decode_once", "_absorb", "_absorb_multi", "step",
+    "decode_step", "decode_multi", "verify_multi", "_put_paged",
+    "_decode_once", "_absorb", "_absorb_multi", "_absorb_speculation",
+    "step", "_collect_drafts", "propose",
 })
 
 #: where the hot-path rules (001/002) apply

@@ -199,6 +199,18 @@ def checked_cache_cls():
 
         def register(self, desc):
             self._track(desc)
+            # speculation-aware rollback accounting (docs/SERVING.md): a
+            # fused/verify dispatch marks its K advanced positions as
+            # uncommitted; registering them in the content index before
+            # rollback commits the step would let the prefix cache serve
+            # unverified draft tokens to other requests
+            if getattr(desc, "uncommitted", 0):
+                raise SanitizerError(
+                    f"[sanitizer] register during speculation: uid "
+                    f"{desc.uid} has {desc.uncommitted} uncommitted "
+                    "token(s) from the last fused/verify dispatch — "
+                    "rollback must commit the step before the prefix "
+                    "index may cover it")
             super().register(desc)
             self.verify(f"register(uid={desc.uid})")
 
@@ -273,6 +285,43 @@ def check_prefill_ownership(engine, live: Dict[int, object]) -> None:
                 f"[sanitizer] live PREFILL request uid {uid} has no "
                 "pending work in the engine — its backlog was lost, the "
                 "request can never produce a first token")
+
+
+# ---------------------------------------------------------------------------
+# speculative-decoding commit check
+# ---------------------------------------------------------------------------
+
+def check_speculation_commit(engine) -> None:
+    """Speculative decoding (docs/SERVING.md) advances every verified
+    row's cache by the full horizon K and relies on the scheduler to
+    commit/rollback the step — ``engine.rollback(uid, n)`` — before the
+    next scheduler iteration. Between steps, then:
+
+    - no descriptor may carry ``uncommitted`` positions (a dispatch whose
+      accept/rollback bookkeeping was skipped would feed the next round
+      from unverified cache state);
+    - no descriptor's prefix-index registration may cover more tokens than
+      it has committed (``seen_tokens``) — the draft-tokens-never-indexed
+      guarantee (docs/PREFIX_CACHING.md).
+    """
+    state = getattr(engine, "state", None)
+    if state is None:
+        return
+    mgr = getattr(engine, "block_mgr", None)
+    bs = getattr(mgr, "block_size", None)
+    for uid, d in state.seqs.items():
+        if getattr(d, "uncommitted", 0):
+            raise SanitizerError(
+                f"[sanitizer] uncommitted speculation across a step "
+                f"boundary: uid {uid} still has {d.uncommitted} "
+                "uncommitted token(s) — the scheduler must rollback/commit "
+                "every fused/verify dispatch it absorbs")
+        if bs and getattr(d, "n_indexed", 0) * bs > d.seen_tokens:
+            raise SanitizerError(
+                f"[sanitizer] prefix index past committed history: uid "
+                f"{uid} registered {d.n_indexed} full block(s) "
+                f"({d.n_indexed * bs} tokens) but committed only "
+                f"{d.seen_tokens}")
 
 
 # ---------------------------------------------------------------------------

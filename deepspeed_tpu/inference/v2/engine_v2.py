@@ -106,6 +106,7 @@ class InferenceEngineV2:
         self._decode_fn = None
         self._cow_fn = None
         self._fused_fn = None
+        self._verify_fn = None
         # per-shape host scratch for the ragged/fused step inputs: reused
         # (zeroed in place) instead of np.zeros every step — the steady-state
         # decode loop must not pay a fresh allocation per dispatch. Safe to
@@ -285,6 +286,23 @@ class InferenceEngineV2:
             self._fused_fn = jax.jit(fused, donate_argnums=(1,))
         return self._fused_fn
 
+    def _get_verify(self):
+        """THE speculative-verification program: the target model over
+        ``(max_seqs, decode_horizon)`` proposed-token segments in one
+        position-parallel forward, per-position greedy argmax out
+        (docs/SERVING.md). Like the fused program it is compiled for exactly
+        ONE shape — the engine's ``decode_horizon`` — so it adds one trace
+        to the compiled-program bound (``verify_cache_size <= 1``)."""
+        if self._verify_fn is None:
+            model = self.model
+
+            def verify(params, pool, segs, tables, starts):
+                return model.verify_paged_multi(params, pool, segs, tables,
+                                                starts)
+
+            self._verify_fn = jax.jit(verify, donate_argnums=(1,))
+        return self._verify_fn
+
     def _scratch_for(self, key: Tuple, shapes,
                      dtypes=None) -> Tuple[np.ndarray, ...]:
         """Per-shape preallocated host arrays (int32 unless ``dtypes``
@@ -308,6 +326,15 @@ class InferenceEngineV2:
         with ``ragged_cache_size <= 4`` the paged engine's total step-program
         bound is 5 — still O(1) in the load."""
         return 0 if self._fused_fn is None else self._fused_fn._cache_size()
+
+    @property
+    def verify_cache_size(self) -> int:
+        """Number of compiled traces of the speculative-verification program.
+        Bounded at <= 1 (one ``(max_seqs, decode_horizon)`` shape, like the
+        fused program): with ``ragged_cache_size <= 4`` and
+        ``fused_cache_size <= 1`` the paged engine's total step-program bound
+        is 6 — still O(1) in the load, one program family per horizon."""
+        return 0 if self._verify_fn is None else self._verify_fn._cache_size()
 
     @property
     def ragged_cache_size(self) -> int:
@@ -683,7 +710,108 @@ class InferenceEngineV2:
                 d.history.append(int(tokens[d.uid]))
                 d.history.extend(seq[:-1])
             d.seen_tokens += K
+            d.uncommitted = K  # rollback may truncate at most this step
             out[d.uid] = seq
+        return out
+
+    def verify_multi(self, tokens: Dict[int, int],
+                     drafts: Dict[int, Sequence[int]]) -> Dict[int, List[int]]:
+        """Speculative-decoding batch verification (docs/SERVING.md): feed
+        each live uid its last sampled token plus up to ``decode_horizon-1``
+        proposed draft tokens, run the target model over every proposed
+        position in ONE position-parallel compiled dispatch, and return the
+        per-position greedy argmax ``{uid: [g1 .. g_{len(draft)+1}]}`` —
+        ``g_j`` is the model's next token after consuming the fed token and
+        the first ``j-1`` drafts. The caller accepts the longest prefix with
+        ``draft[j] == g_j`` (every such ``g_j`` IS the non-speculative greedy
+        token, bitwise), emits the one free token at the first mismatch, and
+        MUST :meth:`rollback` the rejected remainder — including the
+        ``K-1-len(draft)`` padding positions this call writes — before the
+        next dispatch; ``rollback`` enforces that via ``uncommitted``.
+
+        Draft tokens are NEVER registered in the prefix-cache content index:
+        like :meth:`decode_multi`, registration happens only at the
+        :meth:`rollback` commit, after rejected tokens are gone.
+
+        Validation is all-or-nothing (the ``decode_multi`` discipline): a
+        context/pool raise leaves every descriptor intact so a faulted step
+        retries verbatim. Blocks for the whole horizon are pre-allocated and
+        shared blocks are copied-on-write before the segment lands."""
+        if not self.paged:
+            raise ValueError("verify_multi is paged-mode only")
+        K = self.decode_horizon
+        if K <= 1:
+            raise EngineUsageError(
+                "verify_multi needs decode_horizon > 1 (the verification "
+                "width is the engine's one compiled horizon)")
+        if not tokens:
+            return {}
+        if len(tokens) > self.max_seqs:
+            raise EngineUsageError(
+                f"batch of {len(tokens)} exceeds {self.max_seqs} slots")
+        for uid in tokens:
+            d = self.state.seqs[uid]  # unknown uid: loud KeyError
+            ds = drafts.get(uid, ())
+            if len(ds) > K - 1:
+                raise EngineUsageError(
+                    f"uid {uid}: {len(ds)} draft tokens exceed the verify "
+                    f"width {K - 1} (= decode_horizon - 1)", uid=uid)
+            if d.in_flight:
+                raise EngineUsageError(
+                    f"uid {uid}: {d.in_flight} pending prefill tokens — "
+                    "drain before speculative verification", uid=uid)
+            if d.seen_tokens + K > self.max_seq_len:
+                raise ContextOverflowError(
+                    f"uid {uid}: verify width {K} exceeds context "
+                    f"({d.seen_tokens}+{K} > {self.max_seq_len}); collapse "
+                    "to horizon 1 or flush the sequence", uid=uid)
+        for uid in tokens:
+            d = self.state.seqs[uid]
+            self.block_mgr.ensure(d, d.seen_tokens + K)
+        descs = sorted((self.state.seqs[u] for u in tokens),
+                       key=lambda d: d.slot)
+        if self.prefix_cache:
+            # copy-on-write for every block the K writes can land in —
+            # shared blocks are immutable (same discipline as decode_multi)
+            bs = self.block_mgr.block_size
+            for d in descs:
+                first = d.seen_tokens // bs
+                last = min((d.seen_tokens + K - 1) // bs, len(d.blocks) - 1)
+                for j in range(first, last + 1):
+                    if self.block_mgr.refcount(d.blocks[j]) > 1:
+                        src, dst = self.block_mgr.copy_on_write(d, j)
+                        self.kv = self._get_cow()(
+                            self.kv, jnp.int32(src), jnp.int32(dst))
+        B = self.max_seqs
+        segs, tables, starts = self._scratch_for(
+            ("verify", B, K),
+            ((B, K), (B, self.block_mgr.max_blocks_per_seq), (B,)))
+        fed: Dict[int, List[int]] = {}
+        for r, d in enumerate(descs):
+            row = [int(tokens[d.uid])] + [int(t) for t in drafts.get(d.uid, ())]
+            fed[d.uid] = row
+            for j, t in enumerate(row):  # positions past the draft stay 0
+                segs[r, j] = t           # (zeroed pad — always rolled back)
+            self.block_mgr.fill_table_row(d, tables[r])  # in place, no temp
+            starts[r] = d.seen_tokens
+        ys, self.kv = self._get_verify()(
+            self.params, self.kv, jnp.asarray(segs), jnp.asarray(tables),
+            jnp.asarray(starts))
+        # (max_seqs, K); ONE designed transfer per verified horizon — the
+        # same budget as the fused path's result ship
+        ys = np.asarray(ys)  # dstpu-lint: ignore[DSTPU001]
+        out: Dict[int, List[int]] = {}
+        for r, d in enumerate(descs):
+            row = fed[d.uid]
+            if self.prefix_cache:
+                # cache now holds the fed token, the drafts, and the pad
+                d.history.extend(row)
+                d.history.extend([0] * (K - len(row)))
+            d.seen_tokens += K
+            d.uncommitted = K  # caller must commit/rollback before next step
+            # outputs past the draft's +1 bonus position were computed from
+            # padding — meaningless, never returned
+            out[d.uid] = [int(t) for t in ys[r, :len(row)]]
         return out
 
     def rollback(self, uid: int, n: int = 0) -> int:
@@ -694,8 +822,17 @@ class InferenceEngineV2:
         over-allocated tail blocks refcount-exactly, and only THEN registers
         the kept full blocks in the prefix-cache content index — discarded
         tokens are never indexed. ``n=0`` is the pure commit. Idempotent on
-        unknown uids (returns 0), like :meth:`flush`. Returns the number of
-        block references released."""
+        unknown uids (returns 0), like :meth:`flush` — so a rollback racing
+        a quarantine/cancel flush is a counted no-op, never a double-free.
+        Returns the number of block references released.
+
+        ``n`` may not exceed the tokens generated by the LAST
+        ``decode_multi``/``verify_multi`` dispatch (the descriptor's
+        ``uncommitted`` count): committed tokens are immutable — the prefix
+        index may already cover them, and truncating them would desync every
+        consumer that saw them emitted. Such a request raises a typed
+        :class:`EngineUsageError` instead of silently clamping at the block
+        layer."""
         if not self.paged:
             raise ValueError("rollback is paged-mode only")
         d = self.state.seqs.get(uid)
@@ -707,6 +844,12 @@ class InferenceEngineV2:
                 raise ValueError(
                     f"uid {uid}: cannot roll back {n} of {d.seen_tokens} "
                     "cached tokens (at least one must remain)")
+            if n > d.uncommitted:
+                raise EngineUsageError(
+                    f"uid {uid}: rollback of {n} tokens exceeds the "
+                    f"{d.uncommitted} generated by the last fused/verify "
+                    "dispatch — committed tokens are immutable (the prefix "
+                    "index may already cover them)", uid=uid)
             if d.in_flight:
                 raise EngineUsageError(
                     f"uid {uid}: rollback with {d.in_flight} pending tokens",
@@ -715,6 +858,7 @@ class InferenceEngineV2:
             if self.prefix_cache:
                 del d.history[-n:]
             freed = self.block_mgr.rollback(d, d.seen_tokens)
+        d.uncommitted = 0  # committed BEFORE register: drafts never indexed
         if self.prefix_cache:
             self.block_mgr.register(d)
         return freed

@@ -45,6 +45,11 @@ class SequenceDescriptor:
     blocks: List[int] = field(default_factory=list)  # paged mode: pool block ids
     history: List[int] = field(default_factory=list)  # paged: tokens in cache order
     n_indexed: int = 0  # leading blocks registered in the prefix index
+    #: cache positions advanced by the LAST fused/verify dispatch that have
+    #: not been committed yet — ``rollback`` may truncate at most this many
+    #: tokens (committed tokens are immutable: the prefix index may already
+    #: cover them) and resets it to 0 (docs/SERVING.md speculative decoding)
+    uncommitted: int = 0
     done: bool = False
 
     @property

@@ -1134,6 +1134,58 @@ class TransformerLM:
             round_, (kv_pool, toks, starts), None, length=int(k))
         return ys.T, kv_pool  # (B, k)
 
+    def verify_paged_multi(self, params, kv_pool, segs, tables, starts):
+        """Speculative-decoding batch verification against the blocked pool
+        (docs/SERVING.md): run B sequences' K-token segments — each row's
+        last sampled token followed by K−1 draft tokens — in ONE forward and
+        return the greedy argmax at EVERY position, ``(B, K)``.
+
+        Each of the B·K tokens becomes its own length-1 row of the same
+        ``forward_paged`` shape the ragged/fused programs use: the segment's
+        K/V are scattered into the pool before attention, so position ``j``
+        attends to positions ``< j`` of the same dispatch through the shared
+        block table (exactly how multi-row prefill chunks compose), and the
+        per-row computation — gather, position mask, attention, argmax — is
+        the one the sequential decode round runs. Output ``[r, j]`` is the
+        model's greedy next token after consuming ``segs[r, :j+1]``; while
+        the fed drafts match the model's own choices, those outputs ARE the
+        non-speculative greedy rollout, bitwise. Unlike
+        ``decode_paged_multi``'s K sequential scan rounds, the whole segment
+        runs position-parallel in a single round — the compute win
+        speculation banks when drafts are accepted.
+
+        ``segs`` (B, K) int32 (rows past a row's real draft are padding —
+        the caller rolls their positions back); ``tables`` (B, MAXB);
+        ``starts`` (B,) the first segment position per row."""
+        B, K = segs.shape
+        ids = segs.reshape(B * K, 1)
+        tab = jnp.repeat(tables, K, axis=0)  # (B*K, MAXB): row j shares r's table
+        pos = (starts[:, None]
+               + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(B * K)
+        lg, kv_pool = self.forward_paged(params, ids, kv_pool, tab, pos)
+        return jnp.argmax(lg, axis=-1).astype(jnp.int32).reshape(B, K), kv_pool
+
+    def draft_greedy(self, params, window, n_valid, k: int):
+        """Greedy ``k``-token continuation for a DRAFT model
+        (docs/SERVING.md speculative decoding): one ``lax.scan`` over the
+        fixed-size token ``window`` (W,) int32, right-padded past ``n_valid``.
+        The window is position-rebased (the context tail runs from position
+        0), so drafts from a long context are approximate — acceptable,
+        because the verifier is the oracle: a wrong draft costs a rollback,
+        never a wrong token. The caller guarantees ``n_valid + k <= W``.
+        Returns the (k,) drafted tokens."""
+
+        def round_(carry, _):
+            win, cur = carry
+            lg = self.logits(params, win[None, :])[0]        # (W, V)
+            nxt = jnp.argmax(lg[cur - 1], axis=-1).astype(jnp.int32)
+            win = jax.lax.dynamic_update_index_in_dim(win, nxt, cur, 0)
+            return (win, cur + 1), nxt
+
+        (_, _), ys = jax.lax.scan(
+            round_, (window, n_valid), None, length=int(k))
+        return ys
+
     def forward_with_cache(self, params, input_ids, kv_cache, cache_index, positions=None):
         """Like ``forward_with_cache_all`` but projects only the LAST position
         (B, V) — the decode/prefill hot path skips the (S, V) logits matmul."""

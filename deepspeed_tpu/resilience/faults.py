@@ -3,9 +3,9 @@
 At TPU-fleet scale faults are the steady state; a serving loop that has only
 ever seen healthy engines is untested where it matters. ``FaultInjector``
 injects faults at the engine call sites the scheduler uses — ``put``,
-``decode_step``, ``decode_multi``, ``flush``, ``preempt`` — through
-:class:`InjectedEngine`, a transparent proxy the scheduler cannot
-distinguish from the real engine.
+``decode_step``, ``decode_multi``, ``verify_multi``, ``flush``,
+``preempt`` — through :class:`InjectedEngine`, a transparent proxy the
+scheduler cannot distinguish from the real engine.
 
 **Contract: faults fire BEFORE the wrapped call delegates.** The real
 engine's host state is never mutated by a faulted call, so a retried call
@@ -25,7 +25,8 @@ A fault **plan** is a list of :class:`FaultSpec`:
 - ``kind="latency"``: sleep ``latency_s`` before delegating on those calls —
   the watchdog sees the spike as a genuine slow step.
 - ``kind="persistent"``: raise ``RequestFailedError(uid)`` whenever ``uid``
-  appears in a ``put``/``decode_step`` call — *every* time, which is what
+  appears in a request-processing call (``put``/``decode_step``/
+  ``decode_multi``/``verify_multi``) — *every* time, which is what
   makes it persistent: retries keep failing until the scheduler quarantines
   the request. Restricted to the request-processing sites so a teardown path
   (``flush``/``preempt``) can always reclaim the quarantined blocks.
@@ -42,8 +43,9 @@ import numpy as np
 from .errors import RequestFailedError, TransientEngineError
 
 #: the engine surface the scheduler drives (and therefore the fault surface)
-SITES = ("put", "decode_step", "decode_multi", "flush", "preempt")
-_PERSISTENT_SITES = ("put", "decode_step", "decode_multi")
+SITES = ("put", "decode_step", "decode_multi", "verify_multi", "flush",
+         "preempt")
+_PERSISTENT_SITES = ("put", "decode_step", "decode_multi", "verify_multi")
 
 
 @dataclass
@@ -186,6 +188,13 @@ class InjectedEngine:
         # half-advances the horizon — the retry re-runs the WHOLE step
         self.injector.on_call("decode_multi", list(tokens))
         return self.inner.decode_multi(tokens, *a, **kw)
+
+    def verify_multi(self, tokens, drafts, *a, **kw):
+        # same pre-delegation contract as decode_multi: a faulted verify
+        # never advances any cache position, and the scheduler retries the
+        # step with the SAME drafts — the verified round is verbatim
+        self.injector.on_call("verify_multi", list(tokens))
+        return self.inner.verify_multi(tokens, drafts, *a, **kw)
 
     def flush(self, uid):
         self.injector.on_call("flush", [uid])

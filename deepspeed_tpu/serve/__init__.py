@@ -3,7 +3,9 @@
 Request lifecycle, SLA-aware continuous-batching scheduler (admission,
 preemption, streaming, graceful drain), failure containment over the
 ``deepspeed_tpu.resilience`` layer (typed faults, retry, quarantine,
-watchdog, circuit-breaker load shedding), and the serving metrics surface.
+watchdog, circuit-breaker load shedding), speculative decoding
+(prompt-lookup self-drafting or a small draft model, verified in one
+fused dispatch), and the serving metrics surface.
 See ``docs/SERVING.md`` and ``docs/RESILIENCE.md``.
 """
 
@@ -15,3 +17,5 @@ from .metrics import ServeMetrics  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
                         SchedulerClosedError)
+from .speculation import (DraftModelProposer, DraftProposer,  # noqa: F401
+                          PromptLookupProposer, SpecPolicy)

@@ -60,6 +60,20 @@ class ServeMetrics:
             "chunks": 0, "chunk_tokens": 0, "interleaved_steps": 0,
             "prefill_only_steps": 0, "deferred_steps": 0,
             "backlog_tokens": 0.0, "backlog_peak": 0}
+        #: speculative-decoding counters (docs/SERVING.md), exported under
+        #: ``serve/spec/*``: ``steps`` verified dispatches ran,
+        #: ``proposed_tokens``/``accepted_tokens`` feed the acceptance story
+        #: (``acceptance_rate`` is their running ratio), ``bonus_tokens``
+        #: are the free verifier tokens emitted at mismatch/positions past
+        #: the draft, ``rollback_tokens`` the speculative share of rollback
+        #: traffic (also counted in ``serve/decode/rollback_tokens``),
+        #: ``degraded_steps`` fused dispatches taken by requests whose
+        #: acceptance EMA collapsed, and ``draft_horizon`` the mean draft
+        #: length of the latest speculative dispatch (gauge).
+        self.spec: Dict[str, float] = {
+            "steps": 0, "proposed_tokens": 0, "accepted_tokens": 0,
+            "bonus_tokens": 0, "rollback_tokens": 0, "degraded_steps": 0,
+            "acceptance_rate": 0.0, "draft_horizon": 0.0}
         #: resilience counters, exported under ``serve/faults/*``
         #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
         #: step, the rest are incremented by the scheduler as faults land
@@ -94,6 +108,27 @@ class ServeMetrics:
 
     def observe_rollback(self, n_tokens: int) -> None:
         self.decode["rollback_tokens"] += n_tokens
+
+    def observe_speculation(self, proposed: int, accepted: int,
+                            bonus: int, rollback: int,
+                            mean_draft: float) -> None:
+        """One speculative (verify_multi) dispatch: ``proposed`` draft
+        tokens went in, ``accepted`` matched the target argmax, ``bonus``
+        free verifier tokens were emitted on top, ``rollback`` speculative
+        positions were reclaimed."""
+        self.spec["steps"] += 1
+        self.spec["proposed_tokens"] += proposed
+        self.spec["accepted_tokens"] += accepted
+        self.spec["bonus_tokens"] += bonus
+        self.spec["rollback_tokens"] += rollback
+        if self.spec["proposed_tokens"]:
+            self.spec["acceptance_rate"] = (
+                self.spec["accepted_tokens"] / self.spec["proposed_tokens"])
+        self.spec["draft_horizon"] = float(mean_draft)
+
+    def observe_spec_degraded(self) -> None:
+        """A fused dispatch ran because speculation was collapsed/empty."""
+        self.spec["degraded_steps"] += 1
 
     def observe_prefill_chunk(self, n_tokens: int, interleaved: bool) -> None:
         """One dispatch that consumed ``n_tokens`` prompt tokens;
@@ -166,5 +201,7 @@ class ServeMetrics:
                    for k, v in sorted(self.decode.items())]
                 + [(f"serve/prefill/{k}", float(v), step)
                    for k, v in sorted(self.prefill.items())]
+                + [(f"serve/spec/{k}", float(v), step)
+                   for k, v in sorted(self.spec.items())]
                 + [(f"serve/faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])

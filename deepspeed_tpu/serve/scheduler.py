@@ -59,6 +59,15 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   tokens a horizon generates past ``max_new_tokens``/EOS are **rolled
   back** (``engine.rollback``) so output, block accounting, and the prefix
   index are bitwise identical to single-step decode under greedy.
+- **speculative decoding** (docs/SERVING.md): with a ``proposer``
+  configured, full-horizon rounds draft up to K−1 tokens per request
+  (prompt-lookup self-drafting by default, or a small draft model) and
+  verify them in ONE position-parallel ``engine.verify_multi`` dispatch;
+  the longest accepted prefix +1 bonus token is committed, the rest rolled
+  back. A per-request acceptance EMA adapts the draft length and degrades
+  collapsed requests to the plain fused path. Greedy verification emits
+  exactly the tokens sequential greedy would — the bitwise story, the
+  preempt→re-admit replay, and chaos parity all survive unchanged.
 - **streaming**: per-token callbacks (``Request.on_token``) and a pull
   iterator (:meth:`stream`) that drives the loop.
 - **graceful drain**: :meth:`close` rejects new admits, cancels
@@ -90,6 +99,7 @@ from ..resilience.watchdog import StepWatchdog
 from ..utils.logging import logger
 from .metrics import Event, ServeMetrics
 from .request import Request, RequestState
+from .speculation import DraftProposer, SpecPolicy
 
 
 class QueueFullError(RuntimeError):
@@ -125,7 +135,8 @@ class ContinuousBatchScheduler:
                  watchdog: Optional[StepWatchdog] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  decode_horizon: Optional[int] = None,
-                 chunked_prefill: Optional[bool] = None):
+                 chunked_prefill: Optional[bool] = None,
+                 proposer: Optional[DraftProposer] = None):
         self.engine = engine
         # chunked interleaved prefill (docs/SERVING.md): the default for
         # paged engines — admission registers the prompt, its chunks ride
@@ -162,6 +173,23 @@ class ContinuousBatchScheduler:
                 f"{getattr(engine, 'decode_horizon', 1)} (horizons are "
                 "restricted to {1, K} — the fixed-shape discipline)")
         self.decode_horizon = decode_horizon
+        # speculative decoding (docs/SERVING.md): a DraftProposer (or a
+        # pre-built SpecPolicy) turns every full-horizon round into a draft
+        # + ONE verify_multi dispatch. The verify width is the engine's
+        # compiled horizon K: up to K-1 draft tokens per request, and the
+        # per-request acceptance EMA adapts each draft length down to the
+        # expected accepted length (or to 0 — the plain fused path — when
+        # acceptance collapses). Greedy verification keeps output bitwise
+        # identical to non-speculative decode.
+        self.spec: Optional[SpecPolicy] = None
+        if proposer is not None:
+            if not getattr(engine, "paged", False) or self.decode_horizon <= 1:
+                raise ValueError(
+                    "speculative decoding needs a paged engine compiled "
+                    "with decode_horizon > 1 (the verify width K: drafts "
+                    "are up to K-1 tokens, verified in one dispatch)")
+            self.spec = (proposer if isinstance(proposer, SpecPolicy)
+                         else SpecPolicy(proposer))
         self._token_est_s = 0.0  # EMA per-token dispatch wall (deadline guard)
         self.max_queue = max_queue
         self.age_weight = age_weight
@@ -238,6 +266,8 @@ class ContinuousBatchScheduler:
         req.cancel_reason = reason
         req.finish_time = self._clock()
         self.metrics.cancelled += 1
+        if self.spec is not None:
+            self.spec.forget(uid)
         return True
 
     # ------------------------------------------------------------------
@@ -310,6 +340,8 @@ class ContinuousBatchScheduler:
         req.finish_time = now
         self.metrics.failed += 1
         self.metrics.faults["failed_requests"] += 1
+        if self.spec is not None:
+            self.spec.forget(req.uid)
         logger.warning("serve: quarantined uid %d after persistent fault: %s",
                        req.uid, exc)
 
@@ -529,15 +561,25 @@ class ContinuousBatchScheduler:
             if self._emit_token(req, tok, now):
                 self._finish(req, now)
 
-    def _absorb_multi(self, out: Dict[int, List[int]], now: float) -> None:
+    def _absorb_multi(self, out: Dict[int, List[int]],
+                      now: float,
+                      spans: Optional[Dict[int, int]] = None) -> int:
         """Absorb a fused dispatch: emit each row's tokens in order until a
-        stop condition (max_new_tokens / EOS) fires, then ROLL BACK the ≤K−1
+        stop condition (max_new_tokens / EOS) fires, then ROLL BACK the
         overrun tokens — ``engine.rollback`` truncates ``seen_tokens`` and
         history, frees the over-allocated blocks, and registers only the
         kept tokens' full blocks in the prefix index. The rollback runs
         BEFORE the finishing flush so the content index never covers
         discarded tokens; for surviving requests ``rollback(uid, 0)`` is the
-        registration commit the single-step path does inline."""
+        registration commit the single-step path does inline.
+
+        ``spans`` generalizes the fused case to speculative verification:
+        per uid, how many cache positions the dispatch actually advanced.
+        A fused row advanced ``len(toks)``; a verified row advanced the
+        full horizon K while emitting only the accepted prefix + bonus
+        token, so its rollback covers rejected drafts AND pad positions.
+        Returns the total rolled-back token count."""
+        total_overrun = 0
         for uid, toks in out.items():
             req = self._live.get(uid)
             if req is None:  # cancelled between dispatch and absorb
@@ -550,12 +592,15 @@ class ContinuousBatchScheduler:
                 if self._emit_token(req, tok, now):
                     finished = True
                     break
-            overrun = len(toks) - kept
+            span = len(toks) if spans is None else spans[uid]
+            overrun = span - kept
             if overrun:
                 self.metrics.observe_rollback(overrun)
+                total_overrun += overrun
             self.engine.rollback(uid, overrun)
             if finished:
                 self._finish(req, now)
+        return total_overrun
 
     def _finish(self, req: Request, now: float) -> None:
         self._engine_flush(req.uid)
@@ -563,6 +608,8 @@ class ContinuousBatchScheduler:
         req.state = RequestState.DONE
         req.finish_time = now
         self.metrics.completed += 1
+        if self.spec is not None:
+            self.spec.forget(req.uid)
 
     def _prefill_backlog(self) -> int:
         """Pending prompt tokens registered with the engine but not yet
@@ -615,14 +662,32 @@ class ContinuousBatchScheduler:
         for r in self._live.values():
             if r.deadline is not None and r.deadline - now < budget:
                 return 1
-        return K
+        return K  # speculation (when configured) rides exactly this branch:
+        # a verify dispatch advances the same K cache positions a fused
+        # dispatch does, so every collapse condition above applies to both
+
+    def _collect_drafts(self, feed: Dict[int, int]) -> Dict[int, List[int]]:
+        """Drafts for one full-horizon round: each fed request's committed
+        context (prompt + emitted tokens, ending in the token about to be
+        fed) goes to the proposer with its EMA-adapted budget (≤ K−1).
+        Empty dict = nothing draftable this round — run the plain fused
+        path and count a degraded step."""
+        return self.spec.collect(
+            list(feed),
+            lambda uid: self._live[uid].prompt + self._live[uid].tokens,
+            self.decode_horizon - 1)
 
     def _decode_once(self, now: float) -> None:
         """One engine dispatch: the live decode feed plus — under chunked
         interleaved prefill — as many pending prefill-chunk rows as the
         token budget holds, in ONE compiled ragged program. Pure decode
         rounds (no backlog) keep the dedicated ``decode_step``/fused paths
-        bitwise-unchanged."""
+        bitwise-unchanged. With a :class:`DraftProposer` configured,
+        full-horizon rounds become speculative: drafts are verified in ONE
+        ``verify_multi`` dispatch and the accepted prefix (+1 bonus token)
+        is committed, the rest rolled back — the same all-or-nothing
+        K-position shape as the fused path, so retries, containment, and
+        the duty cycle treat both identically."""
         backlog = self._prefill_backlog() if self.chunked_prefill else 0
         if not backlog:
             # no pending prompt tokens: nothing is starved, and the fused
@@ -648,11 +713,21 @@ class ContinuousBatchScheduler:
         if not feed and not backlog:
             return
         horizon = self._effective_horizon(now, feed) if feed else 1
+        # drafts are collected ONCE, outside the retry loop: an injected
+        # fault retries the verify dispatch with the SAME drafts, so the
+        # retried step is verbatim (chaos parity)
+        drafts: Optional[Dict[int, List[int]]] = None
+        if horizon > 1 and self.spec is not None:
+            drafts = self._collect_drafts(feed)
+            if not drafts:
+                self.metrics.observe_spec_degraded()
         attempt = 0
         while True:
             t0 = time.perf_counter()
             try:
-                if horizon > 1:
+                if drafts:
+                    out = self.engine.verify_multi(feed, drafts)
+                elif horizon > 1:
                     out = self.engine.decode_multi(feed, horizon=horizon)
                 elif backlog:
                     # the mixed chunked-prefill dispatch: decode rows first
@@ -665,7 +740,8 @@ class ContinuousBatchScheduler:
                     out = self.engine.decode_step(feed, greedy=True)
                 break
             except TransientEngineError as e:
-                if not self._retry_transient("decode_step", attempt, e):
+                site = "verify_multi" if drafts else "decode_step"
+                if not self._retry_transient(site, attempt, e):
                     raise
                 attempt += 1
             except (RequestFailedError, ContextOverflowError) as e:
@@ -724,10 +800,44 @@ class ContinuousBatchScheduler:
             else:
                 self.metrics.observe_prefill_deferred()
                 self._relieve_prefill_pressure(now)
-        if horizon > 1:
+        if drafts:
+            self._absorb_speculation(out, drafts, now)
+        elif horizon > 1:
             self._absorb_multi(out, now)
         else:
             self._absorb(out, now)
+
+    def _absorb_speculation(self, out: Dict[int, List[int]],
+                            drafts: Dict[int, List[int]],
+                            now: float) -> None:
+        """Acceptance math for one verified dispatch (docs/SERVING.md):
+        per row, ``m`` = longest prefix of the draft matching the target's
+        per-position argmax; emit the first ``m`` (accepted) verifier
+        tokens plus the one FREE token the verifier produced at the first
+        mismatch — identical to what sequential greedy decode would have
+        emitted, which is the whole bitwise story. The cache advanced the
+        full horizon K for every row, so the rollback span is K regardless
+        of draft length (rejected tail + pad positions)."""
+        K = self.decode_horizon
+        accepted_out: Dict[int, List[int]] = {}
+        spans: Dict[int, int] = {}
+        proposed = accepted = 0
+        for uid, g in out.items():
+            ds = drafts.get(uid, [])
+            m = 0
+            while m < len(ds) and int(ds[m]) == int(g[m]):
+                m += 1
+            accepted_out[uid] = g[:m + 1]
+            spans[uid] = K
+            proposed += len(ds)
+            accepted += m
+            if ds:
+                self.spec.observe(uid, len(ds), m)
+        rollback = self._absorb_multi(accepted_out, now, spans=spans)
+        self.metrics.observe_speculation(
+            proposed, accepted, bonus=len(out), rollback=rollback,
+            mean_draft=(sum(len(d) for d in drafts.values())
+                        / max(1, len(drafts))))
 
     def _relieve_prefill_pressure(self, now: float) -> None:
         """A mixed dispatch under pool pressure served its decode rows but
@@ -771,6 +881,10 @@ class ContinuousBatchScheduler:
             # backlog row must belong to a live request and every live
             # PREFILL request must still have work in the engine
             _sanitizer.check_prefill_ownership(self.engine, self._live)
+            # and every speculative dispatch must have been committed or
+            # rolled back — uncommitted draft positions crossing a step
+            # boundary would let the prefix index cover unverified tokens
+            _sanitizer.check_speculation_commit(self.engine)
         return bool(self._queue or self._live)
 
     def run_until_complete(self) -> None:

@@ -296,6 +296,85 @@ def build(scheduler, x):
 """
         assert rules_of(src, path=TRAIN) == []
 
+    def test_switch_branch_list_is_traced(self):
+        src = """
+from jax import lax
+
+def build(i, x):
+    def a(v):
+        if v > 0:          # traced: every switch branch gets tracers
+            return v
+        return -v
+    def b(v):
+        return int(v)      # traced: concretization hazard
+    return lax.switch(i, [a, b], x)
+"""
+        assert sorted(rules_of(src, path=TRAIN)) == ["DSTPU004"] * 2
+
+    def test_switch_branch_tuple_is_traced(self):
+        src = """
+import jax.lax
+
+def build(i, x):
+    def a(v):
+        if v > 0:
+            return v
+        return -v
+    return jax.lax.switch(i, (a, a), x)
+"""
+        # the same def reached through both tuple elements: one finding
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_switch_index_arg_is_not_a_trace_context(self):
+        src = """
+from jax import lax
+
+def build(x):
+    def pick(v):
+        if v > 0:          # plain host helper passed as switch's INDEX
+            return 1       # position, not a branch — must not be flagged
+        return 0
+    return lax.switch(pick, [lambda v: v, lambda v: -v], x)
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+    def test_fori_loop_body_is_traced(self):
+        src = """
+from jax import lax
+
+def build(x):
+    def body(i, v):
+        if v > 0:          # traced: fori_loop bodies get tracers
+            return v + i
+        return v
+    return lax.fori_loop(0, 8, body, x)
+"""
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_fori_loop_bounds_are_not_trace_contexts(self):
+        src = """
+from jax import lax
+
+def build(x):
+    def lower(v):
+        if v > 0:          # host helper computing a BOUND, not the body
+            return 0
+        return 1
+    return lax.fori_loop(lower(x), 8, lambda i, v: v + i, x)
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+    def test_non_lax_switch_name_is_not_a_trace_context(self):
+        src = """
+def build(router, i, x):
+    def fn(v):
+        if v > 0:
+            return v
+        return -v
+    return router.switch(i, [fn], x)   # foo.switch is not lax.switch
+"""
+        assert rules_of(src, path=TRAIN) == []
+
 
 # ---------------------------------------------------------------------------
 # DSTPU005 — nondeterminism in decision logic

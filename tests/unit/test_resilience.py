@@ -461,3 +461,44 @@ def test_randomized_soak_is_lossless(setup):
     assert [r.tokens for r in reqs] == [r.tokens for r in ref]
     assert inj.fired["transient"] > 0  # the storm actually happened
     _assert_pool_restored(eng)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_randomized_soak_speculative_site_mix(setup):
+    """Chunked-``put``-era site mix (ROADMAP satellite): the randomized
+    soak sprayed across ``put``/``decode_multi``/``verify_multi`` — with
+    latency spikes stacked in — against a speculative fused-horizon
+    scheduler. Every request still finishes bitwise identical to the
+    fault-free single-step reference and the pool comes back whole."""
+    from deepspeed_tpu.serve import PromptLookupProposer
+
+    m, params = setup
+    n = 16
+    _, _, ref = _run_workload(m, params, n, seed=47)
+    inj = FaultInjector.random_plan(
+        131, horizon=400, rate=0.05, max_burst=2, latency_s=0.01,
+        sites=("put", "decode_multi", "verify_multi"), sleep=lambda s: None)
+    rng = np.random.default_rng(47)
+    prompts = [rng.integers(0, 128, int(rng.integers(8, 25))).tolist()
+               for _ in range(n)]
+    gens = [int(rng.integers(3, 7)) for _ in range(n)]
+    eng = _engine(m, params, decode_horizon=4)
+    sched = ContinuousBatchScheduler(inj.wrap(eng),
+                                     retry=RetryPolicy(max_attempts=4),
+                                     sleep=lambda s: None,
+                                     proposer=PromptLookupProposer())
+    reqs = [sched.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    for _ in range(100_000):  # outer supervisor: ride out retry give-ups
+        try:
+            if not sched.step():
+                break
+        except TransientEngineError:
+            continue
+    else:
+        raise AssertionError("soak did not converge")
+    assert all(r.state is RequestState.DONE for r in reqs)
+    assert [r.tokens for r in reqs] == [r.tokens for r in ref]
+    assert inj.fired["transient"] > 0
+    assert eng.verify_cache_size <= 1 and eng.fused_cache_size <= 1
+    _assert_pool_restored(eng)
