@@ -167,6 +167,9 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
         out["faults"] = {k: float(v) for k, v in sched.metrics.faults.items()}
         out["injected"] = dict(fault_injector.fired)
         out["breaker_transitions"] = [s for _, s in sched.breaker.transitions]
+        # engine-loss recovery audit (docs/RESILIENCE.md): every loss,
+        # rebuild admission, and replay/cancel count, in clock order
+        out["recovery_trail"] = [ev for _, ev in sched.recovery.trail]
     if collect_tokens:
         out["request_tokens"] = [list(r.tokens) for r in reqs]
         out["request_states"] = [r.state.value for r in reqs]
@@ -241,6 +244,85 @@ def run_chaos(eng, n_req: int) -> dict:
         "failed_index": culpable_idx,
         "tokens_bitwise_identical": bitwise,
         "breaker_recovered": recovered,
+        "goodput_ratio": round(
+            faulted["tokens_per_s"] / base["tokens_per_s"], 3)
+        if base["tokens_per_s"] else None,
+    }
+
+
+def run_engine_loss(eng, n_req: int) -> dict:
+    """The engine-loss recovery acceptance row (docs/RESILIENCE.md): one
+    fault-free reference pass, then the SAME workload under a chaos plan
+    that mixes transient bursts with **whole-engine deaths** —
+    ``device_lost`` specs that leave the (fake) device permanently dead
+    until the scheduler's recovery rebuilds it. At least two deaths land
+    mid-load (so the run spans three engine incarnations); the workload
+    decodes speculatively so deaths can land mid-prefill, mid-decode and
+    mid-speculation. Acceptance: every request completes with tokens
+    bitwise identical to the fault-free pass (journal replay under
+    greedy), the block pool is reclaimed whole, the compiled-program
+    bounds hold per incarnation (rebuild keeps the jitted programs), and
+    the breaker trail shows each rebuild's HALF_OPEN re-arm closing."""
+    from deepspeed_tpu.resilience import (CircuitBreaker, FaultInjector,
+                                          RetryPolicy, StepWatchdog)
+    from deepspeed_tpu.serve import PromptLookupProposer
+
+    def fresh_rng():
+        return np.random.default_rng(29)
+
+    base = run_load(eng, n_requests=n_req, arrival_rate=200.0,
+                    rng=fresh_rng(), collect_tokens=True,
+                    proposer=PromptLookupProposer())
+    for uid in list(eng.state.seqs):
+        eng.flush(uid)
+    rebuilds_before = eng.rebuilds
+    injector = FaultInjector(seed=19)
+    # ordinary chaos rides along: the deaths land inside a transient storm
+    injector.inject(site="put", kind="transient", nth=5, count=2)
+    injector.inject(site="decode_multi", kind="transient", nth=2, count=1)
+    injector.inject(site="verify_multi", kind="transient", nth=4, count=2)
+    # >=2 seeded whole-engine deaths mid-load. The mixed chunked dispatch
+    # routes most work through ``put``, so its call index scales with the
+    # request count and both put deaths are guaranteed to fire; the
+    # verify_multi arm fires only if a draft round lands on that index
+    # (mid-speculation death), bonus coverage either way.
+    injector.inject(site="put", kind="device_lost", nth=max(4, n_req // 6))
+    injector.inject(site="put", kind="device_lost",
+                    nth=max(13, (2 * n_req) // 3))
+    injector.inject(site="verify_multi", kind="device_lost", nth=6)
+    faulted = run_load(
+        eng, n_requests=n_req, arrival_rate=200.0, rng=fresh_rng(),
+        collect_tokens=True, fault_injector=injector,
+        proposer=PromptLookupProposer(),
+        breaker=CircuitBreaker(failure_threshold=3, cooldown_s=0.5,
+                               shed_priority_floor=1),
+        retry=RetryPolicy(max_attempts=5, base_s=0.005, cap_s=0.05, seed=7),
+        watchdog=StepWatchdog())
+    ref_toks = base.pop("request_tokens")
+    base.pop("request_states")
+    toks = faulted.pop("request_tokens")
+    states = faulted.pop("request_states")
+    # no deadlines in this workload, so recovery cancels nothing: EVERY
+    # request must complete, and bitwise identical to the fault-free pass
+    bitwise = all(states[i] == "done" and toks[i] == ref_toks[i]
+                  for i in range(n_req))
+    trans = faulted["breaker_transitions"]
+    # each rebuild re-arms HALF_OPEN and the next healthy dispatch closes
+    # it (an engine loss at CLOSED does not open the breaker by itself, so
+    # the chaos row's open->half_open->closed walk is not required here)
+    rearmed = any(trans[j:j + 2] == ["half_open", "closed"]
+                  for j in range(len(trans) - 1))
+    return {
+        "fault_free": base, "faulted": faulted,
+        "engine_deaths": injector.deaths,
+        "engine_rebuilds": eng.rebuilds - rebuilds_before,
+        "all_requests_completed": all(s == "done" for s in states),
+        "tokens_bitwise_identical": bitwise,
+        "breaker_rearmed_and_closed": rearmed,
+        "pool_reclaimed": (not eng.state.seqs
+                           and eng.block_mgr.free_blocks
+                           == eng.block_mgr.num_blocks - 1),
+        "journal_drained": faulted["faults"]["journal_live"] == 0.0,
         "goodput_ratio": round(
             faulted["tokens_per_s"] / base["tokens_per_s"], 3)
         if base["tokens_per_s"] else None,
@@ -376,7 +458,7 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
             token_budget=64, num_blocks=1 + n_seqs * 16, decode_horizon=k,
             prefix_cache=prefix_cache)
 
-    def measure(eng, prompts, gens, spec, passes=3):
+    def measure(eng, prompts, gens, spec, passes=3, proposer=None):
         best = None
         for i in range(passes + 1):  # pass 0 = warmup (compiles, cold cache)
             for uid in list(eng.state.seqs):
@@ -387,7 +469,8 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
                          arrivals=np.zeros(len(prompts)),
                          gen_targets=np.asarray(gens, dtype=int),
                          collect_tokens=True,
-                         proposer=PromptLookupProposer() if spec else None)
+                         proposer=(proposer or PromptLookupProposer())
+                         if spec else None)
             if i and (best is None or r["tokens_per_s"] > best["tokens_per_s"]):
                 best = r
         toks = best.pop("request_tokens")
@@ -422,6 +505,29 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
     del eng_s
     gc.collect()
 
+    # --- draft-model arm (same repetition workload): DraftModelProposer
+    # drafting with the TARGET model as its own draft — an oracle whose
+    # acceptance rate upper-bounds any separately-trained draft model (the
+    # draft IS the verifier, so only window rebasing can miss), at the cost
+    # of a full extra forward per round. The realistic deployment pairs a
+    # much smaller draft; this arm isolates the verify-side plumbing and
+    # the acceptance ceiling without a second trained checkpoint. ---
+    from deepspeed_tpu.serve import DraftModelProposer
+
+    eng_d = engine(1, K_SPEC)
+    # warm the degraded-path fused K=16 program off the clock too
+    measure(eng_d, rep_prompts, [GEN], spec=False, passes=1)
+    rep_draft, rep_draft_toks = measure(
+        eng_d, rep_prompts, [GEN], spec=True,
+        proposer=DraftModelProposer(model, params, window=64,
+                                    max_draft=K_SPEC - 1))
+    assert eng_d.ragged_cache_size <= 4 and eng_d.fused_cache_size <= 1 \
+        and eng_d.verify_cache_size <= 1, (
+            eng_d.ragged_cache_size, eng_d.fused_cache_size,
+            eng_d.verify_cache_size)
+    del eng_d
+    gc.collect()
+
     # --- natural workload: nothing to look up but the output's own
     # self-repetition; equal horizon K=8, max_seqs concurrent streams ---
     nat_prompts = [rng.integers(0, 1024, int(rng.integers(32, 129))).tolist()
@@ -443,6 +549,8 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
                if rep_base["tokens_per_s"] else None)
     nat_speedup = (nat_spec["tokens_per_s"] / nat_base["tokens_per_s"]
                    if nat_base["tokens_per_s"] else None)
+    draft_speedup = (rep_draft["tokens_per_s"] / rep_base["tokens_per_s"]
+                     if rep_base["tokens_per_s"] else None)
     return {
         "metric": _metric_name("paged", max_seqs, "spec_decode",
                                prefix_cache),
@@ -455,20 +563,30 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
                       "ctx=512 (host-overhead-bound decode)"),
             "workload": ("repetition: 1 stream, 64-tok prompt seeded with "
                          f"the model's own continuation, gen {GEN}, "
-                         f"prompt-lookup K={K_SPEC} vs fused K={K_BASE}; "
+                         f"prompt-lookup K={K_SPEC} vs fused K={K_BASE}, "
+                         "plus a DraftModelProposer arm (target as its own "
+                         "draft: oracle acceptance ceiling); "
                          f"natural: {max_seqs} random prompts U[32,128], "
                          f"gen 96, K={K_BASE} both"),
-            "repetition": {"fused_k8": rep_base, "speculative": rep_spec},
+            "repetition": {"fused_k8": rep_base, "speculative": rep_spec,
+                           "draft_model": rep_draft},
             "natural": {"fused_k8": nat_base, "speculative": nat_spec},
             "tokens_bitwise_identical": (
                 rep_spec_toks == rep_base_toks
+                and rep_draft_toks == rep_base_toks
                 and nat_spec_toks == nat_base_toks),
             "speedup_spec_vs_fused_k8_repetition": round(speedup, 3)
             if speedup else None,
             "speedup_spec_vs_fused_k8_natural": round(nat_speedup, 3)
             if nat_speedup else None,
+            "speedup_draft_model_vs_fused_k8_repetition": round(
+                draft_speedup, 3) if draft_speedup else None,
             "acceptance_rate_repetition": rep_spec["spec"]["acceptance_rate"],
             "acceptance_rate_natural": nat_spec["spec"]["acceptance_rate"],
+            # oracle ceiling: the target drafting for itself — any real
+            # (smaller) draft model lands at or below this
+            "acceptance_rate_draft_model": rep_draft["spec"][
+                "acceptance_rate"],
             "compiled_programs": rep_programs,
         },
     }
@@ -615,6 +733,12 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       mix spans ``put``/``decode_multi``/``verify_multi`` — goodput must
       degrade gracefully, the breaker must recover, and no token may be
       lost or duplicated (docs/RESILIENCE.md).
+    - ``engine_loss`` (``--faults``): the chaos shape with >=2 seeded
+      whole-engine deaths (``device_lost``) mid-load — the scheduler must
+      rebuild the engine hot, replay every journaled request bitwise,
+      reclaim the pool whole, hold the compiled-program bounds across
+      incarnations, and re-arm the breaker HALF_OPEN per rebuild
+      (docs/RESILIENCE.md).
     """
     import logging
 
@@ -660,9 +784,43 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         block_size=64, token_budget=256 if mode == "paged" else 0,
         num_blocks=(1 + max_seqs * blocks_per_seq) if mode == "paged" else None,
         prefix_cache=prefix_cache,
-        # the chaos row runs speculatively (decode_horizon 4 + prompt-lookup)
-        # so the fault plan can exercise the verify_multi/decode_multi sites
-        decode_horizon=4 if workload == "chaos" else 1)
+        # the chaos/engine_loss rows run speculatively (decode_horizon 4 +
+        # prompt-lookup) so the fault plan can exercise the
+        # verify_multi/decode_multi sites
+        decode_horizon=4 if workload in ("chaos", "engine_loss") else 1)
+    if workload == "engine_loss":
+        loss = run_engine_loss(eng, n_req)
+        row = {
+            "metric": _metric_name(mode, max_seqs, workload, prefix_cache),
+            "value": loss["faulted"]["tokens_per_s"], "unit": "tokens/s",
+            "vs_baseline": loss["goodput_ratio"],
+            "detail": {
+                "mode": mode, "max_seqs": max_seqs, "model": (
+                    f"gpt2-{size} bf16" + (f" {overrides}" if overrides
+                                           else "")),
+                "workload": ("Poisson arrivals, prompts U[32,256], gen "
+                             "U[16,64], seeded plan: transient bursts + "
+                             ">=2 whole-engine deaths (device_lost) "
+                             "mid-load, hot rebuild + journal replay"),
+                "engine_loss": loss,
+                "compiled_programs": (eng.ragged_cache_size
+                                      + eng.fused_cache_size
+                                      + eng.verify_cache_size),
+            },
+        }
+        # acceptance (ISSUE 9): deaths landed, everything replayed bitwise,
+        # pool whole, per-incarnation dispatch bounds held (the rebuilt
+        # pools re-enter the surviving compiled programs)
+        assert loss["engine_deaths"] >= 2, loss["engine_deaths"]
+        assert loss["engine_rebuilds"] == loss["engine_deaths"]
+        assert loss["all_requests_completed"]
+        assert loss["tokens_bitwise_identical"]
+        assert loss["pool_reclaimed"] and loss["journal_drained"]
+        assert loss["breaker_rearmed_and_closed"]
+        assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
+        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1, (
+            eng.fused_cache_size, eng.verify_cache_size)
+        return row
     if workload == "chaos":
         chaos = run_chaos(eng, n_req)
         row = {
@@ -753,7 +911,9 @@ def main(faults: bool = False):
     import subprocess
     import sys
 
-    configs = CONFIGS + ((("paged", 32, "chaos", True),) if faults else ())
+    configs = CONFIGS + ((("paged", 32, "chaos", True),
+                          ("paged", 32, "engine_loss", True)) if faults
+                         else ())
     results = []
     rows = {}
     for mode, max_seqs, workload, cache in configs:

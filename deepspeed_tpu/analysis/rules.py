@@ -31,15 +31,19 @@ class Rule:
 #: functions whose bodies are the steady-state serving hot path: one
 #: iteration ≈ one generated token. Host syncs and fresh allocations in
 #: here multiply by tokens/second. (``step``/``_absorb*``/``_decode_once``
-#: are the scheduler's per-token loop; the rest are the engine's.)
+#: are the scheduler's per-token loop; ``_emit_token``/``commit``/
+#: ``record`` are the journal commit path riding inside it — one journal
+#: sync per emitted token; the rest are the engine's.)
 HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     "decode_step", "decode_multi", "verify_multi", "_put_paged",
     "_decode_once", "_absorb", "_absorb_multi", "_absorb_speculation",
     "step", "_collect_drafts", "propose",
+    "_emit_token", "commit", "record",
 })
 
-#: where the hot-path rules (001/002) apply
-HOT_SCOPE = ("serve", "inference")
+#: where the hot-path rules (001/002) apply — ``resilience`` joined when
+#: the journal commit path (recovery.py) entered the per-token loop
+HOT_SCOPE = ("serve", "inference", "resilience")
 #: where the typed-error rule (003) applies — the taxonomy's home turf
 TAXONOMY_SCOPE = ("serve", "inference", "resilience")
 #: where the determinism rule (005) applies — scheduling/containment

@@ -350,3 +350,33 @@ def check_drained(engine) -> None:
     if problems:
         raise SanitizerError("[sanitizer] pool leak at close() drain: "
                              + "; ".join(problems))
+
+
+def check_recovery(journal, queued, all_requests: Dict[int, object]) -> None:
+    """Post-recovery re-admission check (docs/RESILIENCE.md): immediately
+    after an engine rebuild, every journaled live uid must be accounted
+    for — re-queued for replay, or terminally resolved (the
+    deadline-expired-during-rebuild cancels). A uid the journal still holds
+    that is neither queued nor terminal was silently dropped by recovery:
+    its stream consumer would hang forever, the failure mode the journal
+    exists to make impossible. Duck-typed on ``journal.uids()`` /
+    ``Request.state`` so this module keeps importing neither the serve nor
+    the resilience layer."""
+    problems: List[str] = []
+    queued_uids = {getattr(r, "uid", None) for r in queued}
+    for uid in journal.uids():
+        req = all_requests.get(uid)
+        if req is None:
+            problems.append(f"uid {uid} journaled but unknown to the "
+                            "scheduler")
+            continue
+        state = getattr(getattr(req, "state", None), "value", None)
+        if state in ("done", "cancelled", "failed"):
+            problems.append(f"uid {uid} is terminal ({state}) but still "
+                            "journaled — a resolve() is missing")
+        elif uid not in queued_uids:
+            problems.append(f"uid {uid} ({state}) journaled live but "
+                            "neither re-queued nor terminally resolved")
+    if problems:
+        raise SanitizerError("[sanitizer] recovery dropped request(s): "
+                             + "; ".join(problems))

@@ -98,6 +98,7 @@ class InferenceEngineV2:
         self.params = jax.tree_util.tree_map_with_path(cast, params)
         self.state = DSStateManager(max_seqs, self.max_seq_len)
         self.flush_noops = 0  # idempotent-flush debug counter (see flush())
+        self.rebuilds = 0     # engine-loss hot rebuilds (see rebuild())
         #: rows deferred out of a ragged dispatch because their blocks could
         #: not be allocated (the pool served the rows that fit instead of
         #: failing the whole step) — chunked-prefill pressure diagnostics
@@ -892,6 +893,46 @@ class InferenceEngineV2:
     def _blocks_held(self, uid: int) -> int:
         desc = self.state.seqs.get(uid)
         return len(desc.blocks) if (desc is not None and self.paged) else 0
+
+    def rebuild(self) -> None:
+        """Hot rebuild after engine loss (docs/RESILIENCE.md): replace every
+        piece of per-incarnation state — sequence table, block pool
+        bookkeeping, device KV pool — with fresh instances of **identical
+        geometry**, and keep everything else. The compiled-program caches
+        (`_prefill_fns`/`_decode_fn`/`_fused_fn`/`_verify_fn`/`_cow_fn`)
+        survive deliberately: same shapes means the new pools re-enter the
+        same traced programs, so the ragged/fused/verify bounds hold across
+        incarnations with zero recompilation and a rebuild costs one pool
+        allocation, not a cold start. Resident sequences are NOT migrated —
+        their KV died with the device; the scheduler replays them from its
+        journal through normal admission."""
+        self.state = DSStateManager(self.max_seqs, self.max_seq_len)
+        self.rebuilds += 1
+        if not self.paged:
+            self.kv = self.model.init_kv_cache(self.max_seqs,
+                                               self.max_seq_len,
+                                               dtype=self.dtype)
+            log_dist(f"InferenceEngineV2.rebuild #{self.rebuilds}: slot pool "
+                     f"replaced ({self.max_seqs} slots)", ranks=[0])
+            return
+        from .ragged_manager import BlockedKVCache
+
+        old = self.block_mgr
+        if sanitize_enabled():
+            self.block_mgr = checked_cache_cls()(
+                old.num_blocks, old.block_size, old.max_blocks_per_seq,
+                prefix_cache=self.prefix_cache,
+                descs=lambda: self.state.seqs.values())
+        else:
+            self.block_mgr = BlockedKVCache(old.num_blocks, old.block_size,
+                                            old.max_blocks_per_seq,
+                                            prefix_cache=self.prefix_cache)
+        self.kv = self.model.init_kv_pool(old.num_blocks, old.block_size,
+                                          dtype=self.dtype)
+        log_dist(
+            f"InferenceEngineV2.rebuild #{self.rebuilds}: block pool "
+            f"replaced ({old.num_blocks}x{old.block_size}, prefix cache "
+            f"cold), compiled programs retained", ranks=[0])
 
     def prefill_backlog(self) -> int:
         """Pending (registered but undispatched) tokens across all resident

@@ -7,10 +7,13 @@ containment; the engine raises the typed capacity errors. See
 ``docs/RESILIENCE.md``."""
 
 from .breaker import BreakerState, CircuitBreaker  # noqa: F401
-from .errors import (ContextOverflowError, EngineUsageError,  # noqa: F401
-                     PoolExhaustedError, RequestFailedError, SheddingError,
-                     TransientEngineError, WatchdogTimeoutError)
+from .errors import (ContextOverflowError, DeviceLostError,  # noqa: F401
+                     EngineUsageError, PoolExhaustedError,
+                     RequestFailedError, SheddingError, TransientEngineError,
+                     UnrecoverableEngineError, WatchdogTimeoutError)
 from .faults import (SITES, FaultInjector, FaultSpec,  # noqa: F401
                      InjectedEngine)
+from .recovery import (JournalEntry, RecoveryPolicy,  # noqa: F401
+                       RequestJournal)
 from .retry import RetryPolicy  # noqa: F401
 from .watchdog import StepWatchdog  # noqa: F401

@@ -76,6 +76,19 @@ class CircuitBreaker:
             self.opened_at = now
             self._move(BreakerState.OPEN, now)
 
+    def rearm_half_open(self, now: float) -> None:
+        """Engine-loss recovery (docs/RESILIENCE.md): after a hot rebuild
+        the scheduler re-arms the breaker straight into HALF_OPEN from any
+        state — the fresh incarnation is unproven, so the next engine call
+        is the probe (success closes, failure re-opens with a full
+        cooldown). Skipping the OPEN cooldown is deliberate: the cooldown
+        exists to give a *sick* engine time to heal, and the sick engine
+        was just thrown away."""
+        self.consecutive_failures = 0
+        if self.state is not BreakerState.HALF_OPEN:
+            self.half_opens += 1
+            self._move(BreakerState.HALF_OPEN, now)
+
     def on_success(self, now: float) -> None:
         self.consecutive_failures = 0
         if self.state is BreakerState.HALF_OPEN:

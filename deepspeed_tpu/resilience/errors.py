@@ -19,6 +19,11 @@ that matters operationally:
 - **shedding** (:class:`SheddingError`): the breaker is open and the
   submission's priority is below the shed floor — the caller is told to back
   off, typed, at admission time.
+- **engine loss** (:class:`UnrecoverableEngineError`,
+  :class:`DeviceLostError`): the engine *as a whole* is dead or wedged —
+  per-request handling cannot help. The scheduler answers with engine-loss
+  recovery (``resilience.recovery``): rebuild a fresh engine and replay
+  every journaled live request bitwise-losslessly.
 
 All subclass ``RuntimeError`` so pre-taxonomy callers catching
 ``RuntimeError`` keep working, and message texts are unchanged from the
@@ -91,3 +96,23 @@ class WatchdogTimeoutError(RuntimeError):
     """A step (or the close() drain) exceeded its wall-clock budget past the
     point of escalation. Raised only where there is no in-band way to keep
     going; ordinary breaches are counted and escalated to the breaker."""
+
+
+class UnrecoverableEngineError(RuntimeError):
+    """The engine as a whole is dead or wedged: retry cannot fix it, no
+    single request is culpable, and preemption has nothing left to preempt
+    onto. Raised by the watchdog's consecutive hard-breach escalation (a
+    dispatch that never comes back fast enough no matter what) and
+    subclassed by :class:`DeviceLostError`. The scheduler's response is
+    **engine-loss recovery** (docs/RESILIENCE.md): discard the engine,
+    rebuild pools of identical geometry, and replay every journaled live
+    request through normal admission — bitwise lossless under greedy."""
+
+
+class DeviceLostError(UnrecoverableEngineError):
+    """The accelerator (or its runtime) is gone: device reset, XLA abort,
+    preempted TPU slice. Everything resident on the device — KV pool,
+    sequence state — is lost with it; only host-side state (the request
+    journal) survives. At pod scale this is routine, not exceptional
+    (arXiv:2011.03641), which is why it gets a recovery path instead of a
+    crash."""

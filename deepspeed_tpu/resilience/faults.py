@@ -30,6 +30,13 @@ A fault **plan** is a list of :class:`FaultSpec`:
   makes it persistent: retries keep failing until the scheduler quarantines
   the request. Restricted to the request-processing sites so a teardown path
   (``flush``/``preempt``) can always reclaim the quarantined blocks.
+- ``kind="device_lost"``: on the ``nth`` call to ``site`` the fake device
+  dies — ``DeviceLostError`` is raised and the injector marks the engine
+  **permanently dead**: every subsequent call to *any* site keeps raising
+  until :meth:`FaultInjector.revive` runs (which
+  :meth:`InjectedEngine.rebuild` does after the real rebuild succeeds).
+  This is the whole-engine failure mode recovery exists for; the arm sites
+  mirror the dispatch surface (``put``/``decode_multi``/``verify_multi``).
 
 ``seed`` drives :meth:`FaultInjector.random_plan` (the randomized soak
 test); explicit plans are deterministic by construction."""
@@ -40,12 +47,17 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .errors import RequestFailedError, TransientEngineError
+from .errors import DeviceLostError, RequestFailedError, TransientEngineError
 
 #: the engine surface the scheduler drives (and therefore the fault surface)
 SITES = ("put", "decode_step", "decode_multi", "verify_multi", "flush",
          "preempt")
 _PERSISTENT_SITES = ("put", "decode_step", "decode_multi", "verify_multi")
+#: sites a device-loss plan can arm on — the dispatch surface. The *effect*
+#: is global regardless (once dead, every site raises), but arming on a
+#: dispatch makes the death land mid-prefill / mid-decode / mid-speculation,
+#: the lifecycle edges recovery must cover.
+_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi")
 
 
 @dataclass
@@ -53,7 +65,7 @@ class FaultSpec:
     """One planned fault. ``site`` is one of :data:`SITES` or ``"*"``."""
 
     site: str
-    kind: str = "transient"          # transient | persistent | latency
+    kind: str = "transient"    # transient | persistent | latency | device_lost
     nth: Optional[int] = None        # 1-based per-site call index
     count: int = 1                   # consecutive calls affected from nth
     uid: Optional[int] = None        # persistent: the culpable request
@@ -73,6 +85,15 @@ class FaultSpec:
                     "persistent faults are restricted to request-processing "
                     f"sites {_PERSISTENT_SITES} (a faulted flush/preempt "
                     "would leak the quarantined request's blocks)")
+        elif self.kind == "device_lost":
+            if self.nth is None:
+                raise ValueError("device_lost fault needs nth (1-based "
+                                 "per-site call index)")
+            if self.site not in _DEVICE_LOST_SITES:
+                raise ValueError(
+                    "device_lost faults arm on the dispatch surface "
+                    f"{_DEVICE_LOST_SITES}; once fired, EVERY site raises "
+                    "until the engine is rebuilt")
         elif self.kind in ("transient", "latency"):
             if self.nth is None:
                 raise ValueError(f"{self.kind} fault needs nth (1-based "
@@ -98,7 +119,12 @@ class FaultInjector:
         self.enabled = True
         self.calls: Dict[str, int] = {s: 0 for s in SITES}
         self.fired: Dict[str, int] = {"transient": 0, "persistent": 0,
-                                      "latency": 0}
+                                      "latency": 0, "device_lost": 0}
+        #: death message while the fake device is dead; None = alive
+        self.device_lost: Optional[str] = None
+        self.deaths = 0      # device_lost specs that fired
+        self.revivals = 0    # rebuilds observed via revive()
+        self.dead_calls = 0  # calls rejected while dead (beyond the death)
 
     def inject(self, **kw) -> FaultSpec:
         """Append one spec to the live plan (uid-dependent specs are
@@ -111,12 +137,16 @@ class FaultInjector:
     def random_plan(cls, seed: int, *, horizon: int, rate: float = 0.02,
                     sites: Sequence[str] = ("put", "decode_step"),
                     max_burst: int = 2, latency_s: float = 0.0,
+                    n_device_lost: int = 0,
+                    device_lost_sites: Sequence[str] = _DEVICE_LOST_SITES,
                     sleep: Callable[[float], None] = time.sleep
                     ) -> "FaultInjector":
         """Seeded randomized plan for soak testing: each site gets transient
         bursts at ~``rate`` per call over ``horizon`` calls (and latency
-        spikes when ``latency_s > 0``). Same seed, same plan — the soak is
-        rerunnable bit-for-bit."""
+        spikes when ``latency_s > 0``). ``n_device_lost`` scatters that many
+        whole-engine deaths over ``device_lost_sites`` — the engine-loss
+        soak mixes them into the ordinary chaos plan. Same seed, same plan —
+        the soak is rerunnable bit-for-bit."""
         rng = np.random.default_rng(seed)
         specs: List[FaultSpec] = []
         for site in sites:
@@ -128,6 +158,10 @@ class FaultInjector:
                         site=site, kind=kind, nth=n,
                         count=int(rng.integers(1, max_burst + 1)),
                         latency_s=latency_s if kind == "latency" else 0.0))
+        for _ in range(n_device_lost):
+            site = device_lost_sites[int(rng.integers(len(device_lost_sites)))]
+            specs.append(FaultSpec(site=site, kind="device_lost",
+                                   nth=int(rng.integers(1, horizon + 1))))
         return cls(specs, seed=seed, sleep=sleep)
 
     def wrap(self, engine) -> "InjectedEngine":
@@ -137,6 +171,11 @@ class FaultInjector:
         """Fault gate, called by the proxy before delegating. Latency specs
         sleep (several can stack); the first matching raising spec raises."""
         self.calls[site] += 1
+        if self.device_lost is not None:
+            # permanently dead: the device does not come back on its own.
+            # Every site — including teardown — raises until revive().
+            self.dead_calls += 1
+            raise DeviceLostError(self.device_lost)
         if not self.enabled or not self.specs:
             return
         n = self.calls[site]
@@ -156,11 +195,28 @@ class FaultInjector:
                 if spec.kind == "latency":
                     self.fired["latency"] += 1
                     self.sleep(spec.latency_s)
+                elif spec.kind == "device_lost":
+                    self.fired["device_lost"] += 1
+                    self.deaths += 1
+                    self.device_lost = (
+                        spec.message or
+                        f"injected device loss at {site} call {n}")
+                    raise DeviceLostError(self.device_lost)
                 else:
                     self.fired["transient"] += 1
                     raise TransientEngineError(
                         spec.message or
                         f"injected transient fault at {site} call {n}")
+
+    def revive(self) -> None:
+        """A fresh engine incarnation replaced the dead one (called by
+        :meth:`InjectedEngine.rebuild` after the inner rebuild succeeds).
+        Planned specs stay armed — a later ``device_lost`` spec can kill
+        the *next* incarnation too, which is what the N>=2-deaths
+        acceptance row exercises."""
+        if self.device_lost is not None:
+            self.revivals += 1
+            self.device_lost = None
 
 
 class InjectedEngine:
@@ -203,6 +259,15 @@ class InjectedEngine:
     def preempt(self, uid):
         self.injector.on_call("preempt", [uid])
         return self.inner.preempt(uid)
+
+    def rebuild(self, *a, **kw):
+        # NOT a fault site: the dead incarnation is being REPLACED, not
+        # called — rebuild bypasses the gate, and a successful rebuild
+        # revives the injector so the new incarnation serves (until a later
+        # device_lost spec kills it too)
+        out = self.inner.rebuild(*a, **kw)
+        self.injector.revive()
+        return out
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -92,6 +92,13 @@ class ServeMetrics:
             "breaker_half_opens": 0,
             "breaker_closes": 0,
             "breaker_state": 0.0,         # gauge: 0 closed, 1 half, 2 open
+            # engine-loss recovery (docs/RESILIENCE.md)
+            "engine_losses": 0,           # UnrecoverableEngineError raised
+            "engine_rebuilds": 0,         # hot rebuilds completed
+            "recovery_replays": 0,        # journaled live reqs re-queued
+            "recovery_cancelled": 0,      # deadline expired during rebuild
+            "watchdog_hard_breaches": 0,
+            "journal_live": 0.0,          # gauge: unresolved journal entries
         }
 
     def observe_step(self, latency_s: float, batch: int,
@@ -163,6 +170,8 @@ class ServeMetrics:
         self.faults["breaker_state"] = breaker.state_gauge
         self.faults["watchdog_breaches"] = watchdog.breaches
         self.faults["watchdog_escalations"] = watchdog.escalations
+        self.faults["watchdog_hard_breaches"] = getattr(
+            watchdog, "hard_breaches", 0)
 
     @staticmethod
     def _pct(samples: List[float], q: float) -> float:

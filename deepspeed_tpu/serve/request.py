@@ -70,8 +70,11 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     cancel_reason: Optional[str] = None
-    #: terminal FAILED only: the persistent fault that quarantined this
-    #: request — ``stream()`` re-raises it to unblock pull consumers
+    #: terminal failure context: the persistent fault that quarantined this
+    #: request (FAILED), or the typed ``RequestFailedError`` attached when a
+    #: deadline expires during engine-loss recovery (CANCELLED,
+    #: docs/RESILIENCE.md) — ``stream()`` re-raises it either way, so pull
+    #: consumers are unblocked with a reason and never hang
     error: Optional[BaseException] = None
     _cursor: int = 0  # streaming iterator position into ``tokens``
 

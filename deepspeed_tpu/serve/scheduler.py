@@ -93,7 +93,9 @@ from ..analysis import sanitizer as _sanitizer
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.errors import (ContextOverflowError, PoolExhaustedError,
                                  RequestFailedError, SheddingError,
-                                 TransientEngineError)
+                                 TransientEngineError,
+                                 UnrecoverableEngineError)
+from ..resilience.recovery import RecoveryPolicy, RequestJournal
 from ..resilience.retry import RetryPolicy
 from ..resilience.watchdog import StepWatchdog
 from ..utils.logging import logger
@@ -125,6 +127,14 @@ class ContinuousBatchScheduler:
     defaults to no budget), so a healthy engine sees zero behavior change.
     ``sleep`` is the backoff sleeper — injectable so chaos tests don't wait
     out real backoff.
+
+    ``journal`` / ``recovery`` are the engine-loss recovery pair
+    (docs/RESILIENCE.md): the write-ahead request journal and the rebuild
+    budget. On an :class:`UnrecoverableEngineError` the scheduler rebuilds
+    the engine and replays every journaled live request through normal
+    admission — bitwise lossless under greedy; streams see a pause, not an
+    error. ``RecoveryPolicy(max_consecutive_rebuilds=0)`` disables recovery
+    (losses propagate to the caller).
     """
 
     def __init__(self, engine, *, max_queue: int = 256, age_weight: float = 1.0,
@@ -136,7 +146,9 @@ class ContinuousBatchScheduler:
                  sleep: Callable[[float], None] = time.sleep,
                  decode_horizon: Optional[int] = None,
                  chunked_prefill: Optional[bool] = None,
-                 proposer: Optional[DraftProposer] = None):
+                 proposer: Optional[DraftProposer] = None,
+                 journal: Optional[RequestJournal] = None,
+                 recovery: Optional[RecoveryPolicy] = None):
         self.engine = engine
         # chunked interleaved prefill (docs/SERVING.md): the default for
         # paged engines — admission registers the prompt, its chunks ride
@@ -199,6 +211,13 @@ class ContinuousBatchScheduler:
         self.retry = retry or RetryPolicy()
         self.breaker = breaker or CircuitBreaker()
         self.watchdog = watchdog or StepWatchdog()
+        self.journal = journal or RequestJournal()
+        self.recovery = recovery or RecoveryPolicy()
+        #: an engine loss observed on a teardown path (flush/preempt inside
+        #: cancel/finish) — recorded, not raised: the dead engine's pool is
+        #: garbage anyway, so the host-side terminal transition completes
+        #: and the NEXT step() runs recovery before touching the engine
+        self._engine_dead: Optional[BaseException] = None
         self._sleep = sleep
         self.metrics = ServeMetrics()
         self._queue: Deque[Request] = deque()
@@ -249,6 +268,9 @@ class ContinuousBatchScheduler:
             raise ValueError(f"uid {req.uid} is already in flight")
         self._all[req.uid] = req
         self._queue.append(req)
+        # write-ahead: journaled before the engine ever sees the request,
+        # so an engine loss at ANY later point finds a replayable record
+        self.journal.record(req)
         self.metrics.submitted += 1
         return req
 
@@ -265,6 +287,7 @@ class ContinuousBatchScheduler:
         req.state = RequestState.CANCELLED
         req.cancel_reason = reason
         req.finish_time = self._clock()
+        self.journal.resolve(uid)
         self.metrics.cancelled += 1
         if self.spec is not None:
             self.spec.forget(uid)
@@ -291,14 +314,27 @@ class ContinuousBatchScheduler:
         self._sleep(self.retry.delay(attempt + 1, key=site))
         return True
 
+    def _note_engine_lost(self, exc: BaseException) -> None:
+        """Record an engine loss seen on a path that must not raise (the
+        teardown half of cancel/finish): the next :meth:`step` recovers
+        before touching the engine again."""
+        if self._engine_dead is None:
+            self._engine_dead = exc
+
     def _engine_flush(self, uid: int) -> None:
         """``engine.flush`` with transient-fault retry (flush must not fail
         a cancel/finish path on a runtime hiccup; it is idempotent, so the
-        retry is always safe)."""
+        retry is always safe). An engine LOSS here is absorbed, not raised:
+        the blocks this flush would reclaim died with the engine, so the
+        host-side terminal transition completes and recovery (which rebuilds
+        the whole pool) runs at the next step."""
         attempt = 0
         while True:
             try:
                 return self.engine.flush(uid)
+            except UnrecoverableEngineError as e:
+                self._note_engine_lost(e)
+                return
             except TransientEngineError as e:
                 if not self._retry_transient("flush", attempt, e):
                     raise
@@ -309,6 +345,12 @@ class ContinuousBatchScheduler:
         while True:
             try:
                 return self.engine.preempt(uid)
+            except UnrecoverableEngineError as e:
+                # same contract as _engine_flush: the victim is re-queued
+                # host-side (its replay needs no engine state) and the dead
+                # pool reclaims nothing — recovery rebuilds it wholesale
+                self._note_engine_lost(e)
+                return 0
             except TransientEngineError as e:
                 if not self._retry_transient("preempt", attempt, e):
                     raise
@@ -322,9 +364,15 @@ class ContinuousBatchScheduler:
         ``scale`` is the decode horizon: a K-step fused dispatch gets K× the
         step budget (its wall clock is ~K single steps of legitimate work)."""
         now = self._clock()
+        # a hard breach (wedged dispatch) raises UnrecoverableEngineError
+        # out of observe — neither breaker hook runs; step()'s recovery
+        # wrapper catches it and rebuilds the engine
         breached, escalated = self.watchdog.observe(kind, duration_s, scale)
         if not breached:
             self.breaker.on_success(now)
+            # a healthy dispatch proves the current incarnation works:
+            # the consecutive-rebuild budget re-arms
+            self.recovery.note_engine_ok()
         elif escalated:
             self.breaker.on_failure(now)
 
@@ -338,6 +386,7 @@ class ContinuousBatchScheduler:
         req.state = RequestState.FAILED
         req.error = exc
         req.finish_time = now
+        self.journal.resolve(req.uid)
         self.metrics.failed += 1
         self.metrics.faults["failed_requests"] += 1
         if self.spec is not None:
@@ -366,6 +415,92 @@ class ContinuousBatchScheduler:
             self.metrics.faults["containment_preemptions"] += 1
         self._stalled = not self.chunked_prefill and any(
             d.in_flight for d in self.engine.state.seqs.values())
+
+    def _recover(self, exc: BaseException, now: float) -> None:
+        """Engine-loss recovery (docs/RESILIENCE.md): the engine is dead or
+        wedged — quarantine nothing, replace it.
+
+        1. The loss is a breaker failure (the trail records the incident).
+        2. :class:`RecoveryPolicy` admits the rebuild or the loss re-raises
+           (budget spent / recovery disabled — supervisor's problem).
+        3. ``engine.rebuild()`` replaces pools and sequence state with
+           fresh instances of identical geometry; the compiled programs
+           survive, so the per-incarnation dispatch bounds are unchanged.
+        4. Every live request walks the legal eviction edges
+           (``PREFILL/DECODE -> PREEMPTED -> QUEUED``) back into the queue:
+           re-admission feeds its committed history through the NORMAL
+           ``put`` path — the rebuilt prefix cache is cold, so the replay
+           is a real prefill, but greedy decoding makes the continuation
+           bitwise identical (the preemption round-trip guarantee).
+           In-flight dispatch results that were never absorbed are simply
+           lost; replay regenerates those tokens identically.
+        5. Requests whose deadline passed while the engine was down are
+           cancelled TYPED: ``Request.error`` carries a
+           :class:`RequestFailedError`, so ``stream()`` consumers re-raise
+           instead of hanging or ending silently mid-output.
+        6. The breaker re-arms HALF_OPEN — the next dispatch is the probe.
+
+        Every lifecycle position lands in a defined outcome: mid-prefill
+        and mid-speculation requests replay from committed history (a
+        speculative dispatch commits only emitted tokens, so no draft ever
+        enters the journal), PREEMPTED requests are already queued and
+        simply meet a fresh engine, and a loss during ``close()``'s drain
+        recovers here too — the drain loop keeps stepping until the
+        replayed requests finish."""
+        self._engine_dead = None
+        self.metrics.faults["engine_losses"] += 1
+        self.breaker.on_failure(now)
+        if not self.recovery.admit(now, type(exc).__name__):
+            logger.error(
+                "serve: engine lost (%s) with the consecutive-rebuild "
+                "budget (%d) spent — escalating to the supervisor", exc,
+                self.recovery.max_consecutive_rebuilds)
+            raise exc
+        logger.warning(
+            "serve: engine lost (%s); rebuilding — %d live request(s) "
+            "replay from the journal", exc, len(self._live))
+        self.engine.rebuild()
+        replayed = 0
+        for req in list(self._live.values()):
+            req.state = RequestState.PREEMPTED
+            req.preemptions += 1
+            # original arrival time rides along: a replayed request keeps
+            # its age-based admission score (same anti-thrash rule as
+            # ordinary preemption)
+            req.state = RequestState.QUEUED
+            self._queue.append(req)
+            replayed += 1
+        self._live.clear()
+        # per-incarnation scheduler state: the fresh engine holds no
+        # pending prefill, so none of these can carry over
+        self._stalled = False
+        self._starved_prio = None
+        self._fused_since_prefill = 0
+        cancelled = 0
+        rnow = self._clock()
+        for req in [r for r in self._queue
+                    if r.deadline is not None and r.deadline <= rnow]:
+            req.error = RequestFailedError(
+                req.uid, f"deadline expired during engine recovery "
+                f"(deadline {req.deadline:.3f} <= now {rnow:.3f})")
+            self.cancel(req.uid, reason="deadline")
+            self.metrics.deadline_cancels += 1
+            self.metrics.faults["recovery_cancelled"] += 1
+            cancelled += 1
+        self.metrics.faults["engine_rebuilds"] += 1
+        self.metrics.faults["recovery_replays"] += replayed
+        self.recovery.note_rebuilt(rnow, replayed, cancelled)
+        self.breaker.rearm_half_open(rnow)
+        logger.warning(
+            "serve: engine rebuilt (#%d this scheduler): %d replaying, "
+            "%d cancelled past deadline; breaker HALF_OPEN",
+            self.recovery.rebuilds, replayed, cancelled)
+        if _sanitizer.sanitize_enabled():
+            # checked mode: the new incarnation starts empty, and every
+            # journaled live uid must be re-queued or terminally resolved —
+            # a silent drop would hang its stream consumer forever
+            _sanitizer.check_drained(self.engine)
+            _sanitizer.check_recovery(self.journal, self._queue, self._all)
 
     # ------------------------------------------------------------------
     # scheduling policy
@@ -547,6 +682,9 @@ class ContinuousBatchScheduler:
             self.metrics.ttft_s.append(now - req.arrival_time)
         req.state = RequestState.DECODE
         req._emit(tok)
+        # commit point: the journal's committed-token record extends by this
+        # token, so a later engine loss replays exactly the emitted history
+        self.journal.commit(req)
         self.metrics.tokens_generated += 1
         return req.remaining == 0 or (req.eos_token is not None
                                       and tok == req.eos_token)
@@ -607,6 +745,7 @@ class ContinuousBatchScheduler:
         self._live.pop(req.uid, None)
         req.state = RequestState.DONE
         req.finish_time = now
+        self.journal.resolve(req.uid)
         self.metrics.completed += 1
         if self.spec is not None:
             self.spec.forget(req.uid)
@@ -865,17 +1004,32 @@ class ContinuousBatchScheduler:
         admit (registration-only under chunked prefill), drain stalled
         monolithic prefills, then run ONE engine dispatch — mixed
         decode+prefill-chunk rows when a backlog is pending. Returns True
-        while work remains."""
+        while work remains.
+
+        Engine-loss wrapper (docs/RESILIENCE.md): an
+        :class:`UnrecoverableEngineError` from any engine-touching phase —
+        or one recorded earlier on a teardown path — routes to
+        :meth:`_recover` instead of propagating; the step ends after the
+        rebuild and the replay proceeds from the next step's normal
+        admission."""
         now = self._clock()
+        if self._engine_dead is not None:
+            exc, self._engine_dead = self._engine_dead, None
+            self._recover(exc, now)
+            now = self._clock()
         self.breaker.poll(now)
         self._expire_deadlines(now)
-        self._admit(now)
-        if self._stalled:
-            self._absorb(self._engine_put([], []), now)
-        self._decode_once(now)
+        try:
+            self._admit(now)
+            if self._stalled:
+                self._absorb(self._engine_put([], []), now)
+            self._decode_once(now)
+        except UnrecoverableEngineError as e:
+            self._recover(e, now)
         self.metrics.observe_gauges(len(self._queue), len(self._live))
         self.metrics.observe_prefill_backlog(self._prefill_backlog())
         self.metrics.observe_resilience(self.breaker, self.watchdog)
+        self.metrics.faults["journal_live"] = float(len(self.journal))
         if _sanitizer.sanitize_enabled():
             # checked mode (docs/ANALYSIS.md): between steps, every pending
             # backlog row must belong to a live request and every live
@@ -894,12 +1048,17 @@ class ContinuousBatchScheduler:
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s tokens as they are generated, driving the loop.
         A quarantined request unblocks its consumer by re-raising the fault
-        that failed it (after yielding every token generated before it)."""
+        that failed it (after yielding every token generated before it) —
+        and so does a request cancelled *during engine-loss recovery*
+        (deadline expired mid-rebuild): its typed ``RequestFailedError``
+        re-raises the same way, so the consumer sees a reason, never a
+        silently truncated stream and never a hang. A request that merely
+        rides through a recovery sees a pause, not an error."""
         while True:
             for tok in req.new_tokens():
                 yield tok
             if req.finished:
-                if req.state is RequestState.FAILED and req.error is not None:
+                if req.error is not None:
                     raise req.error
                 return
             self.step()

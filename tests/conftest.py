@@ -142,6 +142,8 @@ _SANITIZE_FILES = (
     "test_inference_v2.py",
     "test_prefix_cache.py",
     "test_chunked_prefill.py",
+    "test_recovery.py",
+    "test_recovery_soak.py",
 )
 
 

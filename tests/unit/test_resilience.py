@@ -187,7 +187,8 @@ class TestFaultInjector:
             eng.decode_step({9: 1, 3: 2})  # persistent: fires on uid match
         assert ei.value.uid == 9
         assert eng.flush(9) is None and eng.preempt(9) == 0
-        assert inj.fired == {"transient": 2, "persistent": 1, "latency": 1}
+        assert inj.fired == {"transient": 2, "persistent": 1, "latency": 1,
+                             "device_lost": 0}
         inj.enabled = False  # kill switch
         eng.decode_step({9: 1})
         assert inj.fired["persistent"] == 1
@@ -269,7 +270,8 @@ class TestChaosContainment:
         assert sched.metrics.faults["transient_faults"] == 5
         assert sched.metrics.faults["persistent_faults"] == 1
         assert sched.metrics.faults["containment_preemptions"] > 0
-        assert inj.fired == {"transient": 5, "persistent": 1, "latency": 0}
+        assert inj.fired == {"transient": 5, "persistent": 1, "latency": 0,
+                             "device_lost": 0}
         trans = [s for _, s in br.transitions]
         assert trans[:1] == ["open"] and "half_open" in trans
         assert trans[-1] == "closed"
