@@ -9,8 +9,9 @@ later as bench noise. The linter makes the regression a CI failure with
 a file:line and a fix hint instead.
 
 Scopes are path-based (directory parts of the file under lint), so the
-hot-path rules fire only where hot paths live today; extending them to
-the training step (``runtime/``, ``zero/``) is a tracked ROADMAP item.
+hot-path rules fire only where hot paths live today: the serving loops
+and, since the fault-tolerant-training PR, the training micro-step loop
+(``runtime/``, which contains ``zero/``).
 """
 
 from dataclasses import dataclass
@@ -39,11 +40,18 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     "_decode_once", "_absorb", "_absorb_multi", "_absorb_speculation",
     "step", "_collect_drafts", "propose",
     "_emit_token", "commit", "record",
+    # the training micro-step loop (ROADMAP item 3): one iteration ≈ one
+    # optimizer step — host syncs/allocations here multiply by steps/second
+    # exactly like the decode loop's multiply by tokens/second
+    "train_batch", "step_fn", "backward", "_fused_micro_step",
+    "_multi_exec_step",
 })
 
 #: where the hot-path rules (001/002) apply — ``resilience`` joined when
-#: the journal commit path (recovery.py) entered the per-token loop
-HOT_SCOPE = ("serve", "inference", "resilience")
+#: the journal commit path (recovery.py) entered the per-token loop;
+#: ``runtime`` joined with the training micro-step loop (fault-tolerant
+#: training PR), discharging the docstring's tracked ROADMAP item
+HOT_SCOPE = ("serve", "inference", "resilience", "runtime")
 #: where the typed-error rule (003) applies — the taxonomy's home turf
 TAXONOMY_SCOPE = ("serve", "inference", "resilience")
 #: where the determinism rule (005) applies — scheduling/containment

@@ -380,3 +380,78 @@ def check_recovery(journal, queued, all_requests: Dict[int, object]) -> None:
     if problems:
         raise SanitizerError("[sanitizer] recovery dropped request(s): "
                              + "; ".join(problems))
+
+
+# ---------------------------------------------------------------------------
+# training: partition/gather conservation (ZeRO state)
+# ---------------------------------------------------------------------------
+
+def check_gather_conservation(src_tree, host_tree) -> None:
+    """Checkpoint-gather round trip (docs/RESILIENCE.md): ``_gather_to_host``
+    must return a tree of the SAME structure whose every array leaf is the
+    full global value of its device counterpart — same global shape, same
+    element count, same dtype width. A sharded gather that drops a shard,
+    tiles one twice, or reassembles on the wrong axis changes exactly these,
+    and the checkpoint it feeds would restore silently wrong (the ZeRO
+    partitioning failure mode the bitwise-resume guarantee exists to catch).
+    Mirrors ``CheckedBlockedKVCache``'s conservation discipline on the
+    training side. jax is imported lazily — callers are inside the engine,
+    where it is already loaded."""
+    import jax
+    import numpy as np
+
+    src_leaves, src_def = jax.tree.flatten(src_tree)
+    host_leaves, host_def = jax.tree.flatten(host_tree)
+    if src_def != host_def:
+        raise SanitizerError(
+            f"[sanitizer] gather changed tree structure: {src_def} -> "
+            f"{host_def}")
+    for i, (s, h) in enumerate(zip(src_leaves, host_leaves)):
+        if not isinstance(s, jax.Array):
+            continue  # scalar/str passthrough leaves gather as themselves
+        if not isinstance(h, np.ndarray):
+            raise SanitizerError(
+                f"[sanitizer] gather leaf {i}: device array came back as "
+                f"{type(h).__name__}, not a host ndarray")
+        if tuple(h.shape) != tuple(s.shape):
+            raise SanitizerError(
+                f"[sanitizer] gather leaf {i} shape not conserved: global "
+                f"{tuple(s.shape)} -> host {tuple(h.shape)} (a shard-level "
+                "gather dropped or duplicated a partition)")
+        if int(h.size) != int(s.size):
+            raise SanitizerError(
+                f"[sanitizer] gather leaf {i} element count not conserved: "
+                f"{int(s.size)} -> {int(h.size)}")
+        if h.dtype.itemsize != np.dtype(s.dtype).itemsize:
+            raise SanitizerError(
+                f"[sanitizer] gather leaf {i} dtype width changed: "
+                f"{s.dtype} ({np.dtype(s.dtype).itemsize} B) -> {h.dtype} "
+                f"({h.dtype.itemsize} B) — a lossy cast snuck into the "
+                "checkpoint path")
+
+
+def check_offload_split(host_idx, dev_idx, n_leaves: int) -> None:
+    """Offload twin-flow partition (zero/offload.py ``split_by_ratio``):
+    the host and device index lists must be an exact two-coloring of the
+    parameter leaves — disjoint (no leaf optimizer-stepped twice) and
+    covering (no leaf never stepped). Checked at ``_setup_offload`` and
+    against the index lists a checkpoint carries, since a corrupt/hand-rolled
+    checkpoint can plant overlap the runtime would otherwise act on."""
+    host_set, dev_set = set(host_idx), set(dev_idx)
+    if len(host_set) != len(host_idx) or len(dev_set) != len(dev_idx):
+        raise SanitizerError(
+            f"[sanitizer] offload split has duplicate indices: host "
+            f"{sorted(host_idx)}, dev {sorted(dev_idx)}")
+    overlap = host_set & dev_set
+    if overlap:
+        raise SanitizerError(
+            f"[sanitizer] offload split not disjoint: leaves "
+            f"{sorted(overlap)} appear in BOTH host and device partitions — "
+            "each would be optimizer-stepped twice per step")
+    missing = set(range(n_leaves)) - host_set - dev_set
+    extra = (host_set | dev_set) - set(range(n_leaves))
+    if missing or extra:
+        raise SanitizerError(
+            f"[sanitizer] offload split does not cover the parameter tree: "
+            f"missing leaves {sorted(missing)}, out-of-range "
+            f"{sorted(extra)} (n_leaves={n_leaves})")

@@ -1,19 +1,23 @@
-"""``deepspeed_tpu.resilience`` — fault tolerance for the serving stack.
+"""``deepspeed_tpu.resilience`` — fault tolerance for serving AND training.
 
 Typed fault taxonomy, deterministic seeded fault injection, bounded
-retry/backoff, circuit breaking with load shedding, and step watchdogs.
-The scheduler (``deepspeed_tpu.serve``) composes these into failure
-containment; the engine raises the typed capacity errors. See
-``docs/RESILIENCE.md``."""
+retry/backoff, circuit breaking with load shedding, step watchdogs, and
+recovery budgets. The serving scheduler (``deepspeed_tpu.serve``) composes
+these into failure containment with journal replay; the training side's
+:class:`TrainingSupervisor` composes the same pieces into checkpoint-based
+recovery with bitwise resume. See ``docs/RESILIENCE.md``."""
 
 from .breaker import BreakerState, CircuitBreaker  # noqa: F401
-from .errors import (ContextOverflowError, DeviceLostError,  # noqa: F401
+from .errors import (CheckpointCorruptError,  # noqa: F401
+                     ContextOverflowError, DeviceLostError,
                      EngineUsageError, PoolExhaustedError,
                      RequestFailedError, SheddingError, TransientEngineError,
                      UnrecoverableEngineError, WatchdogTimeoutError)
-from .faults import (SITES, FaultInjector, FaultSpec,  # noqa: F401
-                     InjectedEngine)
+from .faults import (ALL_SITES, SITES, TRAIN_SITES,  # noqa: F401
+                     FaultInjector, FaultSpec, InjectedEngine,
+                     InjectedTrainEngine)
 from .recovery import (JournalEntry, RecoveryPolicy,  # noqa: F401
                        RequestJournal)
 from .retry import RetryPolicy  # noqa: F401
+from .training import TrainingSupervisor  # noqa: F401
 from .watchdog import StepWatchdog  # noqa: F401

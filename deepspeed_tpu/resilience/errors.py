@@ -109,6 +109,24 @@ class UnrecoverableEngineError(RuntimeError):
     request through normal admission — bitwise lossless under greedy."""
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint tag failed integrity verification on load: torn write
+    (npz present but its metadata/manifest missing or unparsable), truncated
+    archive, or a per-array checksum mismatch against the tag's manifest.
+    Per-tag and permanent — retrying the same read returns the same bytes.
+    The recovery answer is the durable-tag ring: fall back to the previous
+    tag that verifies (``DeepSpeedEngine.load_checkpoint`` does this
+    automatically when following the ``latest`` pointer, counting each hop
+    in ``ckpt_corrupt_fallbacks``). ``tag``/``path`` name the rejected
+    checkpoint when known."""
+
+    def __init__(self, message: str, tag: Optional[str] = None,
+                 path: Optional[str] = None):
+        super().__init__(message)
+        self.tag = tag
+        self.path = path
+
+
 class DeviceLostError(UnrecoverableEngineError):
     """The accelerator (or its runtime) is gone: device reset, XLA abort,
     preempted TPU slice. Everything resident on the device — KV pool,

@@ -52,17 +52,33 @@ from .errors import DeviceLostError, RequestFailedError, TransientEngineError
 #: the engine surface the scheduler drives (and therefore the fault surface)
 SITES = ("put", "decode_step", "decode_multi", "verify_multi", "flush",
          "preempt")
+#: the training dispatch surface (docs/RESILIENCE.md training section):
+#: the ``DeepSpeedEngine`` calls a ``TrainingSupervisor`` drives, plus the
+#: checkpoint-engine calls riding inside ``save_checkpoint``
+#: (``ckpt_save``/``ckpt_commit``) — a torn save is a first-class fault.
+TRAIN_SITES = ("train_batch", "backward", "step", "save_checkpoint",
+               "load_checkpoint", "ckpt_save", "ckpt_commit")
+ALL_SITES = SITES + TRAIN_SITES
 _PERSISTENT_SITES = ("put", "decode_step", "decode_multi", "verify_multi")
 #: sites a device-loss plan can arm on — the dispatch surface. The *effect*
 #: is global regardless (once dead, every site raises), but arming on a
 #: dispatch makes the death land mid-prefill / mid-decode / mid-speculation,
-#: the lifecycle edges recovery must cover.
-_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi")
+#: the lifecycle edges recovery must cover. ``train_batch``/``step`` are the
+#: training equivalents: the death lands mid-train-step, between the last
+#: durable checkpoint and the next — the replay window recovery must close.
+_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi",
+                      "train_batch", "step")
+#: ``random_plan``'s default scatter — the SERVING dispatch surface only,
+#: so pre-training seeded plans are reproduced verbatim (same seed, same
+#: plan is an API promise); training soaks pass ``device_lost_sites``
+#: explicitly
+_SERVING_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi")
 
 
 @dataclass
 class FaultSpec:
-    """One planned fault. ``site`` is one of :data:`SITES` or ``"*"``."""
+    """One planned fault. ``site`` is one of :data:`ALL_SITES` (serving
+    :data:`SITES` + training :data:`TRAIN_SITES`) or ``"*"``."""
 
     site: str
     kind: str = "transient"    # transient | persistent | latency | device_lost
@@ -74,9 +90,9 @@ class FaultSpec:
     fired: int = field(default=0, compare=False)  # runtime hit counter
 
     def __post_init__(self):
-        if self.site != "*" and self.site not in SITES:
+        if self.site != "*" and self.site not in ALL_SITES:
             raise ValueError(f"unknown fault site {self.site!r}; "
-                             f"expected one of {SITES} or '*'")
+                             f"expected one of {ALL_SITES} or '*'")
         if self.kind == "persistent":
             if self.uid is None:
                 raise ValueError("persistent fault needs a culpable uid")
@@ -117,7 +133,7 @@ class FaultInjector:
         self.rng = np.random.default_rng(seed)
         self.sleep = sleep
         self.enabled = True
-        self.calls: Dict[str, int] = {s: 0 for s in SITES}
+        self.calls: Dict[str, int] = {s: 0 for s in ALL_SITES}
         self.fired: Dict[str, int] = {"transient": 0, "persistent": 0,
                                       "latency": 0, "device_lost": 0}
         #: death message while the fake device is dead; None = alive
@@ -138,7 +154,8 @@ class FaultInjector:
                     sites: Sequence[str] = ("put", "decode_step"),
                     max_burst: int = 2, latency_s: float = 0.0,
                     n_device_lost: int = 0,
-                    device_lost_sites: Sequence[str] = _DEVICE_LOST_SITES,
+                    device_lost_sites: Sequence[str] = (
+                        _SERVING_DEVICE_LOST_SITES),
                     sleep: Callable[[float], None] = time.sleep
                     ) -> "FaultInjector":
         """Seeded randomized plan for soak testing: each site gets transient
@@ -271,3 +288,93 @@ class InjectedEngine:
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
+
+
+class _InjectedCheckpointEngine:
+    """Fault proxy over a checkpoint engine's durability surface: ``save``
+    (each state-dict file) and ``commit`` (the tag's durability point).
+    Faulting them *before* delegation models a torn write the atomic
+    rename discipline turns into a clean absence — a faulted ``ckpt_save``
+    leaves the previous file intact, a faulted ``ckpt_commit`` leaves
+    ``latest`` on the previous durable tag."""
+
+    def __init__(self, engine, injector: FaultInjector):
+        self.inner = engine
+        self.injector = injector
+
+    def save(self, state_dict, path):
+        self.injector.on_call("ckpt_save", [])
+        return self.inner.save(state_dict, path)
+
+    def commit(self, tag):
+        self.injector.on_call("ckpt_commit", [])
+        return self.inner.commit(tag)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class InjectedTrainEngine:
+    """Fault-injecting proxy over a ``DeepSpeedEngine`` (duck-typed) — the
+    training counterpart of :class:`InjectedEngine`, consumed by
+    ``resilience.training.TrainingSupervisor``.
+
+    Same pre-delegation contract: a faulted call never half-mutates the
+    engine, so a retry re-runs the micro-step verbatim (the supervisor
+    re-pulls the same batches). The engine's own checkpoint engine is
+    wrapped in place so the ``ckpt_save``/``ckpt_commit`` sites fire inside
+    ``save_checkpoint``'s real write path, not on a parallel copy.
+
+    ``rebuild()`` models training's recovery shape: unlike serving there is
+    no pool geometry to reconstruct — the engine object (and its compiled
+    programs) survives; only device state is declared lost. Rebuild
+    therefore just revives the injector; the supervisor then restores
+    device state via ``load_checkpoint`` (which is itself a fault site, so
+    a storm can hit the recovery path too)."""
+
+    def __init__(self, engine, injector: FaultInjector):
+        self.inner = engine
+        self.injector = injector
+        engine.checkpoint_engine = _InjectedCheckpointEngine(
+            engine.checkpoint_engine, injector)
+
+    def train_batch(self, data_iter=None):
+        self.injector.on_call("train_batch", [])
+        return self.inner.train_batch(data_iter)
+
+    def forward(self, *a, **kw):
+        # not a fault site of its own: the fused paths never call it, and
+        # the unfused loop's fault surface is train_batch/backward/step
+        return self.inner.forward(*a, **kw)
+
+    def backward(self, *a, **kw):
+        self.injector.on_call("backward", [])
+        return self.inner.backward(*a, **kw)
+
+    def step(self, *a, **kw):
+        self.injector.on_call("step", [])
+        return self.inner.step(*a, **kw)
+
+    def save_checkpoint(self, *a, **kw):
+        self.injector.on_call("save_checkpoint", [])
+        return self.inner.save_checkpoint(*a, **kw)
+
+    def load_checkpoint(self, *a, **kw):
+        self.injector.on_call("load_checkpoint", [])
+        return self.inner.load_checkpoint(*a, **kw)
+
+    def rebuild(self):
+        self.injector.revive()
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __setattr__(self, name, value):
+        # the proxy owns only its two plumbing slots; every other assignment
+        # lands on the inner engine so callers that set engine attributes
+        # (tests pinning compiled fns, schedulers) hit the real object
+        if name in ("inner", "injector"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.inner, name, value)

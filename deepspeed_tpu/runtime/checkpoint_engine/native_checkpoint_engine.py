@@ -9,14 +9,38 @@ that makes every checkpoint "universal". The Nebula analogue is
 
 import json
 import os
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
+from ...resilience.errors import CheckpointCorruptError
 from ...utils.logging import logger
 from .checkpoint_engine import CheckpointEngine
 
 _SEP = "||"
+_MANIFEST_VERSION = 1
+
+
+def _file_crc32(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _atomic_json_dump(obj, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _flatten(state_dict):
@@ -93,13 +117,78 @@ class NativeCheckpointEngine(CheckpointEngine):
         np.savez(tmp, **flat)
         # numpy appends .npz to the name it writes
         os.replace(tmp + ".npz", path)
-        with open(path + ".meta.json", "w") as f:
-            json.dump(meta, f)
+        _atomic_json_dump(meta, path + ".meta.json")
+        # the manifest is the durability marker, written LAST: its presence
+        # asserts the npz and meta files before it were completely written,
+        # and its checksums let load() detect any later corruption of either.
+        # A crash at any earlier point leaves no manifest → load() reports a
+        # torn write (CheckpointCorruptError) instead of deserializing junk.
+        _atomic_json_dump({
+            "version": _MANIFEST_VERSION,
+            "arrays": len(flat),
+            "npz_crc32": _file_crc32(path),
+            "meta_crc32": _file_crc32(path + ".meta.json"),
+            "npz_bytes": os.path.getsize(path),
+        }, path + ".manifest.json")
         logger.debug(f"[NativeCheckpointEngine] saved {path} ({len(flat)} arrays)")
 
+    def _verify(self, path):
+        """Check ``path`` against its manifest; raise typed on any tear.
+
+        Checkpoints written before the manifest era (no ``.manifest.json``)
+        load unverified for compatibility — but only if the meta sidecar is
+        present; an npz with no sidecars at all is a torn write."""
+        mpath = path + ".manifest.json"
+        if not os.path.exists(mpath):
+            if not os.path.exists(path + ".meta.json"):
+                raise CheckpointCorruptError(
+                    f"torn checkpoint write: {path} has neither manifest nor "
+                    "metadata sidecar", path=path)
+            return  # pre-manifest checkpoint: compat, unverified
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"unreadable checkpoint manifest {mpath}: {e}",
+                path=path) from e
+        for fpath, key in ((path, "npz_crc32"),
+                          (path + ".meta.json", "meta_crc32")):
+            want = manifest.get(key)
+            if want is None:
+                raise CheckpointCorruptError(
+                    f"checkpoint manifest {mpath} missing '{key}'", path=path)
+            try:
+                got = _file_crc32(fpath)
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint file missing/unreadable during verify: "
+                    f"{fpath}: {e}", path=path) from e
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint checksum mismatch for {fpath}: "
+                    f"manifest crc32={want:#010x}, on-disk crc32={got:#010x}",
+                    path=path)
+
     def load(self, path, map_location=None):
-        with open(path + ".meta.json") as f:
-            meta = json.load(f)
-        with np.load(path, allow_pickle=False) as z:
-            flat = {k: z[k] for k in z.files}
-        return _unflatten(flat, meta)
+        self._verify(path)
+        try:
+            with open(path + ".meta.json") as f:
+                meta = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointCorruptError(
+                f"unreadable checkpoint metadata {path}.meta.json: {e}",
+                path=path) from e
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                flat = {k: z[k] for k in z.files}
+        except (OSError, EOFError, ValueError, zipfile.BadZipFile) as e:
+            raise CheckpointCorruptError(
+                f"truncated/corrupt checkpoint archive {path}: {e}",
+                path=path) from e
+        try:
+            return _unflatten(flat, meta)
+        except (KeyError, IndexError) as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path} metadata inconsistent with archive "
+                f"contents: {e}", path=path) from e

@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import comm as dist
 from ..comm.topology import MeshTopology
+from ..resilience.errors import CheckpointCorruptError, EngineUsageError
 from ..ops.optimizers import Optimizer, build_optimizer
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (
@@ -98,7 +99,14 @@ def _gather_to_host(tree):
             return chunked_device_get(x)
         return x
 
-    return jax.tree.map(to_np, tree)
+    out = jax.tree.map(to_np, tree)
+    from ..analysis.sanitizer import sanitize_enabled
+
+    if sanitize_enabled():
+        from ..analysis.sanitizer import check_gather_conservation
+
+        check_gather_conservation(tree, out)
+    return out
 
 
 def _tree_select(pred, on_true, on_false):
@@ -290,6 +298,12 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        # batches consumed from the engine-owned training iterator — persisted
+        # so a resume continues at the same dataset position (bitwise resume)
+        self._data_position = 0
+        # durable-tag ring fallbacks taken because `latest` pointed at a
+        # checkpoint that failed integrity verification (CheckpointCorruptError)
+        self.ckpt_corrupt_fallbacks = 0
         self._cached = None  # (loss, grads) from the last forward
         if config.checkpoint_config.async_save:
             from .checkpoint_engine.async_checkpoint_engine import (
@@ -1088,6 +1102,12 @@ class DeepSpeedEngine:
             )
         leaves, treedef = jax.tree.flatten(fp32_params)
         host_idx, dev_idx = split_by_ratio(leaves, off.ratio)
+        from ..analysis.sanitizer import sanitize_enabled
+
+        if sanitize_enabled():
+            from ..analysis.sanitizer import check_offload_split
+
+            check_offload_split(host_idx, dev_idx, len(leaves))
         opt = self.optimizer
         cpu_opt = DeepSpeedCPUAdam(
             lr=opt.lr, betas=opt.betas, eps=opt.eps, weight_decay=opt.weight_decay,
@@ -1352,7 +1372,7 @@ class DeepSpeedEngine:
         gradient_accumulation_steps == 1 the buffer is the gradients themselves
         (no extra full-tree read/write — matters at 2×model-size fp32)."""
         if self._cached is None:
-            raise RuntimeError("backward() called without a preceding forward()")
+            raise EngineUsageError("backward() called without a preceding forward()")
         self.timers(BACKWARD_MICRO_TIMER).start()
         if isinstance(self._cached, LazyLoss):
             # the fused fwd+bwd launches HERE — forward() deferred it so a
@@ -1436,7 +1456,7 @@ class DeepSpeedEngine:
             self.timers(STEP_MICRO_TIMER).stop()
             return
         if self._step_fn is None:
-            raise RuntimeError("no optimizer configured")
+            raise EngineUsageError("no optimizer configured")
         self.timers(STEP_MICRO_TIMER).start()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         (new_lp, new_master, new_opt, new_scaler, gnorm, overflow) = self._step_fn(
@@ -1489,7 +1509,20 @@ class DeepSpeedEngine:
             # persistent repeating iterator: successive calls advance through the
             # dataset instead of restarting at batch 0
             if getattr(self, "_train_iter", None) is None:
-                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+                inner = iter(RepeatingLoader(self.training_dataloader))
+                # resume: fast-forward to the persisted dataset position so a
+                # restored run sees the same batch sequence it would have seen
+                # uninterrupted (RepeatingLoader repeats the epoch order, so
+                # position modulo epoch length is the in-epoch offset)
+                if self._data_position:
+                    try:
+                        epoch_len = len(self.training_dataloader)
+                    except TypeError:
+                        epoch_len = 0
+                    for _ in range(self._data_position % epoch_len
+                                   if epoch_len else 0):
+                        next(inner)
+                self._train_iter = self._count_batches(inner)
             it = self._train_iter
         self.tput_timer.start()
         if (self.config.gradient_accumulation_steps == 1
@@ -1523,6 +1556,16 @@ class DeepSpeedEngine:
         self.step()
         self.tput_timer.stop(global_step=True)
         return jnp.mean(jnp.stack(losses))
+
+    def _count_batches(self, inner):
+        """Wrap the engine-owned training iterator so every batch pulled bumps
+        ``_data_position`` — whatever step path consumes it (fused, multi-exec
+        window refill, unfused GAS loop). The counter is checkpointed; resume
+        fast-forwards to it. External ``data_iter`` positions are the
+        caller's to track."""
+        for batch in inner:
+            self._data_position += 1
+            yield batch
 
     def _multi_exec_step(self, it):
         """steps_per_execution path: every K-th call pulls K batches, stacks
@@ -1696,6 +1739,28 @@ class DeepSpeedEngine:
         d = os.path.join(save_dir, str(tag))
         return d, os.path.join(d, "model_states.ckpt"), os.path.join(d, "optim_states.ckpt")
 
+    @staticmethod
+    def _durable_tags_before(load_dir, tag):
+        """The durable-tag ring behind ``tag``: every other ``global_step<N>``
+        directory under ``load_dir`` that has a model file, newest first.
+        These are the fallback candidates when the tag ``latest`` points at
+        fails integrity verification — sorted descending so the fallback
+        loses the fewest steps."""
+        def step_of(name):
+            try:
+                return int(name[len("global_step"):])
+            except ValueError:
+                return -1
+
+        try:
+            names = os.listdir(load_dir)
+        except OSError:
+            return []
+        ring = [n for n in names
+                if n != tag and n.startswith("global_step") and step_of(n) >= 0
+                and os.path.exists(os.path.join(load_dir, n, "model_states.ckpt"))]
+        return sorted(ring, key=step_of, reverse=True)
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         if tag is None:
@@ -1714,6 +1779,13 @@ class DeepSpeedEngine:
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
+            # bitwise-resume completeness (docs/RESILIENCE.md): the training
+            # PRNGKey the compiled fns fold per-micro-step, the micro-step
+            # counter they fold it WITH, and the dataset position — without
+            # all three a resumed run diverges from the uninterrupted one
+            "rng": self._rng,
+            "micro_steps": self.micro_steps,
+            "data_position": self._data_position,
             "ds_config_batch": [
                 self.config.train_batch_size,
                 self.config.train_micro_batch_size_per_gpu,
@@ -1794,6 +1866,7 @@ class DeepSpeedEngine:
             load_universal_into_engine(self, load_dir)
             self.loaded_checkpoint_tag = "universal"
             return load_dir, {}
+        from_latest = tag is None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.isfile(latest):
@@ -1801,8 +1874,40 @@ class DeepSpeedEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
-        d, model_path, optim_path = self._ckpt_paths(load_dir, tag)
-        model_sd = self.checkpoint_engine.load(model_path)
+        # both state dicts are read (and integrity-verified) to host BEFORE
+        # any engine state is mutated: a corrupt optim file discovered after
+        # the params were already overwritten would leave the engine
+        # half-restored with no way back
+        want_optim = load_optimizer_states and not load_module_only
+        tags = [tag] + (self._durable_tags_before(load_dir, tag)
+                        if from_latest else [])
+        model_sd = optim_sd = None
+        last_err = None
+        for t in tags:
+            d, model_path, optim_path = self._ckpt_paths(load_dir, t)
+            try:
+                m_sd = self.checkpoint_engine.load(model_path)
+                o_sd = (self.checkpoint_engine.load(optim_path)
+                        if want_optim and os.path.exists(optim_path)
+                        else None)
+            except CheckpointCorruptError as e:
+                e.tag = e.tag or t
+                last_err = e
+                if from_latest:
+                    # one fallback hop per corrupt tag skipped over
+                    self.ckpt_corrupt_fallbacks += 1
+                    logger.warning(
+                        f"checkpoint tag '{t}' failed integrity verification "
+                        f"({e}); falling back to the previous durable tag")
+                    continue
+                raise
+            model_sd, optim_sd, tag = m_sd, o_sd, t
+            break
+        if model_sd is None:
+            raise CheckpointCorruptError(
+                f"no loadable checkpoint under {load_dir}: 'latest' tag and "
+                f"every earlier durable tag failed verification "
+                f"(last: {last_err})", tag=tag) from last_err
 
         module = model_sd["module"]
         # chunked host→device pushes: a checkpoint's full param tree can be
@@ -1828,16 +1933,47 @@ class DeepSpeedEngine:
         self.global_steps = int(model_sd.get("global_steps", 0))
         self.global_samples = int(model_sd.get("global_samples", 0))
         self.skipped_steps = int(model_sd.get("skipped_steps", 0))
+        # pre-completeness checkpoints (no "micro_steps") can only have been
+        # taken at a GAS boundary, where micro_steps == steps * GAS exactly
+        self.micro_steps = int(model_sd.get(
+            "micro_steps",
+            self.global_steps * self.config.gradient_accumulation_steps))
+        self._data_position = int(model_sd.get("data_position", 0))
+        saved_rng = model_sd.get("rng")
+        if saved_rng is not None:
+            saved_rng = np.asarray(saved_rng)
+            cur = np.asarray(self._rng)
+            if cur.shape != saved_rng.shape or not np.array_equal(cur, saved_rng):
+                # the compiled step fns close over the OLD key — rebuild them.
+                # Same-key resume (the common case: same config.seed) skips
+                # this, keeping compiled programs — and therefore bitwise
+                # trajectories — shared between the saver and the resumer.
+                self._rng = jnp.asarray(saved_rng)
+                self._build_compiled_fns()
+        # in-flight micro-step state is meaningless across a restore: the
+        # resumed run re-pulls its batches and re-runs the window
+        self._cached = None
+        self._acc_grads = None
+        self._train_iter = None
+        if getattr(self, "_exec_queue", None):
+            self._exec_queue.clear()
 
         if load_lr_scheduler_states and self.lr_scheduler is not None and "lr_scheduler" in model_sd:
             self.lr_scheduler.load_state_dict(model_sd["lr_scheduler"])
 
-        if self._offload_mgr is not None and not load_module_only \
-                and load_optimizer_states and os.path.exists(optim_path):
-            optim_sd = self.checkpoint_engine.load(optim_path)
+        if self._offload_mgr is not None and optim_sd is not None:
             mgr = self._offload_mgr
             saved_h = optim_sd.get("host_idx")
             saved_d = optim_sd.get("dev_idx") or []
+            from ..analysis.sanitizer import sanitize_enabled
+
+            if saved_h is not None and sanitize_enabled():
+                from ..analysis.sanitizer import check_offload_split
+
+                # a checkpoint with overlapping or gappy index lists would
+                # silently double- or un-restore optimizer shards
+                check_offload_split(saved_h, saved_d,
+                                    len(jax.tree.leaves(self._opt_shardings)))
             same_split = saved_h is None or (
                 list(saved_h) == list(mgr["host_idx"])
                 and list(saved_d) == list(mgr["dev_idx"]))
@@ -1874,9 +2010,7 @@ class DeepSpeedEngine:
                     last_overflow_iter=jnp.asarray(sc["last_overflow_iter"], jnp.int32),
                     iter_=jnp.asarray(sc["iter_"], jnp.int32),
                 )
-        elif not load_module_only and load_optimizer_states and self.opt_state is not None \
-                and os.path.exists(optim_path):
-            optim_sd = self.checkpoint_engine.load(optim_path)
+        elif optim_sd is not None and self.opt_state is not None:
             self.opt_state = self.opt_state._replace(
                 step=jnp.asarray(optim_sd["step"], jnp.int32),
                 m=None if optim_sd["m"] is None else jax.device_put(optim_sd["m"], self._opt_shardings),

@@ -281,8 +281,10 @@ class StreamedZeroEngine:
             losses.append(loss)
             if flat_grads is None:
                 # writable copies only when accumulating (np.asarray views of
-                # device arrays are read-only)
-                flat_grads = g if gas == 1 else [np.array(a) for a in g]
+                # device arrays are read-only); one copy per GLOBAL step is
+                # the accumulation buffer itself, not a per-dispatch leak
+                flat_grads = g if gas == 1 else [
+                    np.array(a) for a in g]  # dstpu-lint: ignore[DSTPU002]
             else:
                 for a, b in zip(flat_grads, g):
                     a += b

@@ -144,6 +144,8 @@ _SANITIZE_FILES = (
     "test_chunked_prefill.py",
     "test_recovery.py",
     "test_recovery_soak.py",
+    "test_train_resilience.py",
+    "test_train_chaos_soak.py",
 )
 
 

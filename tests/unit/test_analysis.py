@@ -17,7 +17,9 @@ from deepspeed_tpu.analysis.lint import _norm_path
 #: (serve/inference/resilience) engage exactly as they do in the repo
 SERVE = "deepspeed_tpu/serve/snippet.py"
 INFER = "deepspeed_tpu/inference/v2/snippet.py"
-TRAIN = "deepspeed_tpu/runtime/snippet.py"  # out of 001/002/003/005 scope
+# out of 001/002/003/005 scope (``runtime/`` joined the hot scope with the
+# fault-tolerant-training PR, so ``models/`` is the cold fixture path now)
+TRAIN = "deepspeed_tpu/models/snippet.py"
 
 
 def rules_of(src, path=SERVE, only=None):

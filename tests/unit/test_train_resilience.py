@@ -1,0 +1,673 @@
+"""Fault-tolerant training (docs/RESILIENCE.md, training section).
+
+Four layers, bottom up:
+
+- checkpoint integrity: the manifest-last durable-write protocol in
+  ``NativeCheckpointEngine`` (torn writes and bit rot surface as typed
+  ``CheckpointCorruptError``, legacy manifest-less checkpoints still load);
+- the engine's durable-tag ring: a corrupt ``latest`` falls back to the
+  newest verifiable ``global_step<N>`` tag (counted), explicit-tag loads
+  raise instead of silently substituting;
+- the resume matrix: kill-at-step-k -> restore -> replay is BITWISE for
+  every k across plain / mixed-precision / optimizer-offload configs (the
+  ``test_bitwise_cpu_zero1`` discipline applied to recovery — compiled
+  programs are pinned between runs because XLA determinism is per compiled
+  program, so the claim is about checkpoint completeness and the training
+  path, not about fusion luck);
+- the ``TrainingSupervisor``: retry/recovery/watchdog/budget state machine
+  on a scripted fake engine, then the acceptance chaos run on a real
+  engine — seeded transient storm + device loss mid-run, final loss curve
+  bitwise-identical to the fault-free reference.
+
+Planted-corruption tests for the training-side sanitizer checks
+(``check_gather_conservation``, ``check_offload_split``) ride along — this
+module runs under ``DSTPU_SANITIZE=1`` (conftest), so the real save/restore
+paths here also exercise the checks in anger.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_gather_conservation,
+                                              check_offload_split)
+from deepspeed_tpu.comm import topology as topo_mod
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.resilience import (CheckpointCorruptError, DeviceLostError,
+                                      FaultInjector, FaultSpec,
+                                      InjectedTrainEngine, RecoveryPolicy,
+                                      RetryPolicy, StepWatchdog,
+                                      TrainingSupervisor,
+                                      TransientEngineError,
+                                      UnrecoverableEngineError)
+from deepspeed_tpu.runtime.checkpoint_engine.native_checkpoint_engine import (
+    NativeCheckpointEngine)
+
+MB, SEQ, STEPS = 2, 16, 5
+
+CONFIGS = {
+    "plain": {},
+    "mixed": {"bf16": {"enabled": True}},
+    "offload": {"zero_optimization": {
+        "stage": 1, "offload_optimizer": {"device": "cpu"}}},
+}
+
+#: the compiled programs shared between a reference engine and a resumed
+#: one — XLA determinism is per compiled program (see module docstring of
+#: test_bitwise_cpu_zero1), so the bitwise-resume claim pins them
+PIN = ("_fwd_bwd", "_train_loss", "_acc", "_step_fn", "_fused_step_fn",
+       "_multi_step_fn")
+
+
+def _cfg():
+    return gpt2_config("125m", hidden_size=32, num_layers=1, num_heads=2,
+                       vocab_size=128, max_seq_len=SEQ)
+
+
+def _batches_for(k):
+    """The replay primitive: micro-batches of global step k as a pure
+    function of k (same index, same batches — bit for bit)."""
+    rng = np.random.default_rng(1000 + k)
+    return [{"input_ids": jnp.asarray(
+        rng.integers(0, 128, (MB, SEQ), dtype=np.int32))}]
+
+
+def _mk_engine(variant="plain"):
+    topo_mod.reset_topology()
+    topo_mod.initialize_topology(data=1, model=1, seq=1, pipe=1, expert=1,
+                                 devices=np.array(jax.devices()[:1]))
+    config = {
+        "train_micro_batch_size_per_gpu": MB,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 0.0,
+        "steps_per_print": 0,
+    }
+    config.update({k: dict(v) for k, v in CONFIGS[variant].items()})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerLM(_cfg()), config=config)
+    return engine
+
+
+def _pin(dst, src):
+    for name in PIN:
+        if hasattr(src, name):
+            setattr(dst, name, getattr(src, name))
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: manifest-last durable writes
+# ---------------------------------------------------------------------------
+
+class TestCheckpointIntegrity:
+    STATE = {"module": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                        "b": np.ones((4,), np.float32)},
+             "global_steps": 3}
+
+    def _save(self, tmp_path):
+        eng = NativeCheckpointEngine()
+        path = str(tmp_path / "model_states.ckpt")
+        eng.save(self.STATE, path)
+        return eng, path
+
+    def test_round_trip_and_sidecars(self, tmp_path):
+        eng, path = self._save(tmp_path)
+        assert os.path.exists(path + ".manifest.json")
+        assert os.path.exists(path + ".meta.json")
+        loaded = eng.load(path)
+        np.testing.assert_array_equal(loaded["module"]["w"],
+                                      self.STATE["module"]["w"])
+        assert loaded["global_steps"] == 3
+
+    def test_bit_rot_raises_typed(self, tmp_path):
+        eng, path = self._save(tmp_path)
+        with open(path, "r+b") as f:  # flip bytes mid-file: crc must catch it
+            f.seek(os.path.getsize(path) // 2)
+            f.write(b"\xff\xff\xff\xff")
+        with pytest.raises(CheckpointCorruptError, match="checksum"):
+            eng.load(path)
+
+    def test_truncation_raises_typed(self, tmp_path):
+        eng, path = self._save(tmp_path)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        with pytest.raises(CheckpointCorruptError):
+            eng.load(path)
+
+    def test_torn_write_raises_typed(self, tmp_path):
+        # no manifest AND no meta = the writer died mid-save
+        eng, path = self._save(tmp_path)
+        os.remove(path + ".manifest.json")
+        os.remove(path + ".meta.json")
+        with pytest.raises(CheckpointCorruptError, match="torn"):
+            eng.load(path)
+
+    def test_legacy_manifestless_checkpoint_still_loads(self, tmp_path):
+        # meta without manifest = written before the manifest protocol:
+        # loads unverified rather than refusing old checkpoints
+        eng, path = self._save(tmp_path)
+        os.remove(path + ".manifest.json")
+        loaded = eng.load(path)
+        np.testing.assert_array_equal(loaded["module"]["b"],
+                                      self.STATE["module"]["b"])
+
+    def test_garbage_manifest_raises_typed(self, tmp_path):
+        eng, path = self._save(tmp_path)
+        with open(path + ".manifest.json", "w") as f:
+            f.write("{not json")
+        with pytest.raises(CheckpointCorruptError):
+            eng.load(path)
+
+
+# ---------------------------------------------------------------------------
+# sanitizer checks: planted corruption must fire
+# ---------------------------------------------------------------------------
+
+class TestSanitizerChecks:
+    def _trees(self):
+        src = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+               "b": jnp.ones((4,), jnp.float32)}
+        host = jax.tree.map(lambda x: np.asarray(x), src)
+        return src, host
+
+    def test_gather_conservation_passes_on_faithful_gather(self):
+        src, host = self._trees()
+        check_gather_conservation(src, host)
+
+    def test_gather_conservation_catches_dropped_partition(self):
+        src, host = self._trees()
+        host["a"] = host["a"][:1]  # a shard went missing in the gather
+        with pytest.raises(SanitizerError, match="dropped or duplicated"):
+            check_gather_conservation(src, host)
+
+    def test_gather_conservation_catches_structure_drift(self):
+        src, host = self._trees()
+        del host["b"]
+        with pytest.raises(SanitizerError):
+            check_gather_conservation(src, host)
+
+    def test_gather_conservation_catches_lossy_cast(self):
+        src, host = self._trees()
+        host["a"] = host["a"].astype(np.float16)
+        with pytest.raises(SanitizerError, match="lossy"):
+            check_gather_conservation(src, host)
+
+    def test_gather_conservation_catches_non_host_leaf(self):
+        src, host = self._trees()
+        host["a"] = src["a"]  # still a device array: nothing was gathered
+        with pytest.raises(SanitizerError):
+            check_gather_conservation(src, host)
+
+    def test_offload_split_passes_on_disjoint_cover(self):
+        check_offload_split([0, 2], [1, 3], 4)
+
+    def test_offload_split_catches_overlap(self):
+        with pytest.raises(SanitizerError, match="stepped twice"):
+            check_offload_split([0, 1], [1, 2], 3)
+
+    def test_offload_split_catches_missing_leaf(self):
+        with pytest.raises(SanitizerError):
+            check_offload_split([0], [2], 3)  # leaf 1 is stepped by nobody
+
+    def test_offload_split_catches_duplicate_index(self):
+        with pytest.raises(SanitizerError):
+            check_offload_split([0, 0], [1], 2)
+
+    def test_offload_split_catches_out_of_range(self):
+        with pytest.raises(SanitizerError):
+            check_offload_split([0, 5], [1], 2)
+
+
+# ---------------------------------------------------------------------------
+# resume matrix: kill at every step k, restore, replay — bitwise
+# ---------------------------------------------------------------------------
+
+class TestResumeMatrix:
+    @pytest.mark.parametrize("variant", sorted(CONFIGS))
+    def test_kill_at_every_step_resumes_bitwise(self, variant, tmp_path):
+        d = str(tmp_path)
+        ref = _mk_engine(variant)
+        ref.save_checkpoint(d)  # global_step0: the kill-before-step-1 target
+        ref_losses = []
+        for k in range(STEPS):
+            ref_losses.append(ref.train_batch(iter(_batches_for(k))))
+            if k < STEPS - 1:
+                ref.save_checkpoint(d)  # global_step{k+1}
+        ref_losses = np.asarray([np.asarray(x) for x in ref_losses])
+
+        # ONE resumed engine re-restored for every kill point: the ring holds
+        # every tag, and load_checkpoint must fully reset derived state
+        res = _mk_engine(variant)
+        _pin(res, ref)
+        for kill in range(STEPS):
+            res.load_checkpoint(d, tag=f"global_step{kill}")
+            assert res.global_steps == kill
+            assert res.micro_steps == kill  # gas=1: one micro-step per step
+            replay = [np.asarray(res.train_batch(iter(_batches_for(k))))
+                      for k in range(kill, STEPS)]
+            np.testing.assert_array_equal(ref_losses[kill:],
+                                          np.asarray(replay))
+        _assert_trees_equal(ref.params, res.params)
+
+    def test_rng_and_counters_persist(self, ring, tmp_path):
+        d = str(tmp_path)
+        ref, res = ring["ref"], ring["res"]
+        ref.save_checkpoint(d)  # a step-4 checkpoint outside the ring dir
+        # plant a divergent training key: load must restore the saved one
+        # (and rebuild the compiled fns that close over it)
+        res._rng = jax.random.fold_in(res._rng, 999)
+        assert not np.array_equal(np.asarray(res._rng), np.asarray(ref._rng))
+        res.load_checkpoint(d)
+        np.testing.assert_array_equal(np.asarray(res._rng),
+                                      np.asarray(ref._rng))
+        assert res.global_steps == 4
+        assert res.micro_steps == 4
+        # the divergent-key load rebuilt res's compiled programs; re-pin the
+        # shared restore engine for the bitwise ring tests that follow
+        _pin(res, ref)
+
+    def test_internal_dataloader_position_resumes(self, tmp_path):
+        d = str(tmp_path)
+        rng = np.random.default_rng(42)
+        # a dataset of SAMPLES (the loader collates MB of them per batch):
+        # 8 samples / MB=2 -> a 4-batch epoch the RepeatingLoader cycles
+        data = [{"input_ids": rng.integers(0, 128, (SEQ,), dtype=np.int32)}
+                for _ in range(4 * MB)]
+
+        def mk(pin_from=None):
+            eng = None
+            topo_mod.reset_topology()
+            topo_mod.initialize_topology(data=1, model=1, seq=1, pipe=1,
+                                         expert=1,
+                                         devices=np.array(jax.devices()[:1]))
+            eng, _, _, _ = deepspeed_tpu.initialize(
+                model=TransformerLM(_cfg()), config={
+                    "train_micro_batch_size_per_gpu": MB,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "gradient_clipping": 0.0,
+                    "steps_per_print": 0,
+                }, training_data=list(data))
+            if pin_from is not None:
+                _pin(eng, pin_from)
+            return eng
+
+        ref = mk()
+        ref_losses = []
+        for k in range(4):
+            ref_losses.append(np.asarray(ref.train_batch()))
+            if k == 1:
+                ref.save_checkpoint(d)
+
+        res = mk(pin_from=ref)
+        res.load_checkpoint(d)
+        assert res._data_position == 2  # two batches consumed pre-kill
+        replay = [np.asarray(res.train_batch()) for _ in range(2, 4)]
+        np.testing.assert_array_equal(np.asarray(ref_losses[2:]),
+                                      np.asarray(replay))
+
+
+# ---------------------------------------------------------------------------
+# durable-tag ring: corrupt latest falls back, explicit tag refuses
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ring(tmp_path_factory):
+    """One durable-tag ring (tags global_step0..3) + one restore engine,
+    shared across the fallback tests: each test works on a COPY of the
+    pristine ring dir and re-restores the same engine (``load_checkpoint``
+    fully resets derived state, which is itself part of the contract under
+    test). The reference engine takes one extra step past the ring so the
+    bitwise-replay test has a target."""
+    d = str(tmp_path_factory.mktemp("ring"))
+    ref = _mk_engine()
+    ref.save_checkpoint(d)
+    for k in range(3):
+        ref.train_batch(iter(_batches_for(k)))
+        ref.save_checkpoint(d)  # tags global_step1..3
+    loss3 = np.asarray(ref.train_batch(iter(_batches_for(3))))
+    res = _mk_engine()
+    _pin(res, ref)
+    return {"dir": d, "ref": ref, "res": res, "loss3": loss3}
+
+
+class TestCorruptTagFallback:
+    def _copy(self, ring, tmp_path):
+        import shutil
+        d = str(tmp_path / "ring")
+        shutil.copytree(ring["dir"], d)
+        ring["res"].ckpt_corrupt_fallbacks = 0
+        return d, ring["res"]
+
+    @staticmethod
+    def _corrupt(d, tag):
+        path = os.path.join(d, tag, "model_states.ckpt")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+
+    def test_latest_falls_back_to_previous_durable_tag(self, ring, tmp_path):
+        d, res = self._copy(ring, tmp_path)
+        self._corrupt(d, "global_step3")
+        res.load_checkpoint(d)
+        assert res.global_steps == 2  # newest verifiable tag won
+        assert res.ckpt_corrupt_fallbacks == 1
+
+    def test_fallback_skips_multiple_corrupt_tags(self, ring, tmp_path):
+        d, res = self._copy(ring, tmp_path)
+        self._corrupt(d, "global_step3")
+        self._corrupt(d, "global_step2")
+        res.load_checkpoint(d)
+        assert res.global_steps == 1
+        assert res.ckpt_corrupt_fallbacks == 2
+
+    def test_explicit_tag_raises_instead_of_substituting(self, ring, tmp_path):
+        d, res = self._copy(ring, tmp_path)
+        self._corrupt(d, "global_step3")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            res.load_checkpoint(d, tag="global_step3")
+        assert ei.value.tag == "global_step3"
+        assert res.ckpt_corrupt_fallbacks == 0
+
+    def test_every_tag_corrupt_raises(self, ring, tmp_path):
+        d, res = self._copy(ring, tmp_path)
+        for tag in ("global_step0", "global_step1", "global_step2",
+                    "global_step3"):
+            self._corrupt(d, tag)
+        with pytest.raises(CheckpointCorruptError, match="no loadable"):
+            res.load_checkpoint(d)
+        assert res.ckpt_corrupt_fallbacks == 4
+
+    def test_fallback_resumes_bitwise_from_surviving_tag(self, ring, tmp_path):
+        d, res = self._copy(ring, tmp_path)
+        self._corrupt(d, "global_step3")
+        res.load_checkpoint(d)  # lands on global_step2
+        res.train_batch(iter(_batches_for(2)))
+        r3 = np.asarray(res.train_batch(iter(_batches_for(3))))
+        np.testing.assert_array_equal(ring["loss3"], r3)
+        _assert_trees_equal(ring["ref"].params, res.params)
+
+
+# ---------------------------------------------------------------------------
+# TrainingSupervisor state machine on a scripted fake engine (no jax)
+# ---------------------------------------------------------------------------
+
+class FakeEngine:
+    """Scripted engine: ``faults`` maps (site, call#) -> exception to raise
+    before the call takes effect (the InjectedTrainEngine contract)."""
+
+    def __init__(self, faults=None):
+        self.global_steps = 0
+        self.ckpt_corrupt_fallbacks = 0
+        self.faults = dict(faults or {})
+        self.calls = {"train_batch": 0, "save_checkpoint": 0,
+                      "load_checkpoint": 0, "rebuild": 0}
+        self.saved_step = None
+        self.dead = False
+
+    def _gate(self, site):
+        self.calls[site] += 1
+        exc = self.faults.pop((site, self.calls[site]), None)
+        if exc is not None:
+            if isinstance(exc, DeviceLostError):
+                self.dead = True
+            raise exc
+        if self.dead:
+            raise DeviceLostError("still dead")
+
+    def train_batch(self, data_iter=None):
+        self._gate("train_batch")
+        self.global_steps += 1
+        return float(self.global_steps)
+
+    def save_checkpoint(self, save_dir, tag=None):
+        self._gate("save_checkpoint")
+        self.saved_step = self.global_steps
+
+    def load_checkpoint(self, load_dir, tag=None):
+        self._gate("load_checkpoint")
+        assert self.saved_step is not None, "restore before any durable save"
+        self.global_steps = self.saved_step
+
+    def rebuild(self):
+        self.calls["rebuild"] += 1
+        self.dead = False
+        return self
+
+
+def _sup(engine, **kw):
+    kw.setdefault("retry", RetryPolicy(max_attempts=3, base_s=0.0))
+    kw.setdefault("recovery", RecoveryPolicy(max_consecutive_rebuilds=3))
+    kw.setdefault("sleep", lambda s: None)
+    return TrainingSupervisor(engine, lambda k: [k], "/tmp/unused", **kw)
+
+
+class TestSupervisor:
+    def test_fault_free_run_banks_every_step(self):
+        sup = _sup(FakeEngine(), save_interval=2)
+        losses = sup.run(6)
+        assert sorted(losses) == list(range(6))
+        rep = sup.report()
+        assert rep["goodput_ratio"] == 1.0
+        assert rep["retries"] == rep["recoveries"] == 0
+        # run-start save + saves at steps 2 and 4 (not at 6: run is over)
+        assert rep["saves"] == 3
+
+    def test_transient_is_retried_in_place(self):
+        eng = FakeEngine({("train_batch", 2): TransientEngineError("blip")})
+        sup = _sup(eng)
+        sup.run(3)
+        rep = sup.report()
+        assert rep["retries"] == 1 and rep["recoveries"] == 0
+        assert rep["net_steps"] == 3 and rep["attempts"] == 4
+        assert rep["goodput_ratio"] == pytest.approx(3 / 4)
+
+    def test_transient_storm_escalates_to_recovery(self):
+        eng = FakeEngine({("train_batch", k): TransientEngineError("storm")
+                          for k in range(2, 5)})  # 3 in a row = retry budget
+        sup = _sup(eng)
+        sup.run(3)
+        rep = sup.report()
+        assert rep["recoveries"] == 1
+        assert rep["net_steps"] == 3
+        assert eng.calls["load_checkpoint"] == 1
+
+    def test_device_lost_routes_to_checkpoint_recovery(self):
+        eng = FakeEngine({("train_batch", 3): DeviceLostError("killed")})
+        sup = _sup(eng, save_interval=1)
+        sup.run(4)
+        rep = sup.report()
+        assert rep["recoveries"] == 1 and eng.calls["rebuild"] == 1
+        assert rep["net_steps"] == 4
+        assert rep["replayed_steps"] == 0  # save_interval=1: nothing lost
+        assert rep["breaker_state"] in ("HALF_OPEN", "CLOSED")
+
+    def test_recovery_replays_steps_since_last_save(self):
+        eng = FakeEngine({("train_batch", 4): DeviceLostError("killed")})
+        sup = _sup(eng, save_interval=2)  # durable at 2; dies attempting 4
+        sup.run(5)
+        rep = sup.report()
+        assert rep["replayed_steps"] == 1  # step 3 re-run from the step-2 tag
+        assert rep["net_steps"] == 5
+
+    def test_device_lost_mid_restore_readmits_and_finishes(self):
+        eng = FakeEngine({("train_batch", 2): DeviceLostError("killed"),
+                          ("load_checkpoint", 1): DeviceLostError("again")})
+        sup = _sup(eng, save_interval=1)
+        sup.run(3)
+        rep = sup.report()
+        assert rep["recoveries"] == 1
+        assert eng.calls["rebuild"] == 2  # revived once per death
+        assert rep["net_steps"] == 3
+
+    def test_recovery_budget_exhaustion_raises_typed(self):
+        # every train_batch dies and rebuild never sticks: the budget
+        # (2 consecutive rebuilds with no healthy step) must end the run
+        eng = FakeEngine({("train_batch", k): DeviceLostError("cursed")
+                          for k in range(2, 12)})
+        sup = _sup(eng, recovery=RecoveryPolicy(max_consecutive_rebuilds=2))
+        with pytest.raises(UnrecoverableEngineError, match="budget"):
+            sup.run(5)
+
+    def test_watchdog_hard_breach_triggers_recovery(self):
+        ticks = iter(range(0, 1000, 10))  # every step takes 10s of fake time
+        eng = FakeEngine()
+        sup = _sup(eng, save_interval=1,
+                   watchdog=StepWatchdog(step_budget_s=1.0, escalate_after=1,
+                                         hard_breach_after=2),
+                   clock=lambda: float(next(ticks)))
+        sup.run(4)
+        rep = sup.report()
+        assert rep["watchdog_breaches"] >= 2
+        assert rep["recoveries"] >= 1
+        assert rep["net_steps"] == 4
+
+    def test_save_that_keeps_faulting_is_abandoned_not_fatal(self):
+        eng = FakeEngine({("save_checkpoint", k): TransientEngineError("io")
+                          for k in range(2, 5)})  # periodic save always fails
+        sup = _sup(eng, save_interval=1)
+        sup.run(2)
+        rep = sup.report()
+        assert rep["save_failures"] == 1
+        assert rep["net_steps"] == 2  # training itself was never hurt
+
+    def test_bad_save_interval_rejected(self):
+        with pytest.raises(ValueError):
+            _sup(FakeEngine(), save_interval=-1)
+
+
+# ---------------------------------------------------------------------------
+# InjectedTrainEngine: the training fault surface
+# ---------------------------------------------------------------------------
+
+class _Ckpt:
+    def __init__(self):
+        self.saves = 0
+        self.commits = 0
+
+    def save(self, state, path):
+        self.saves += 1
+
+    def commit(self, tag):
+        self.commits += 1
+
+
+class _Inner:
+    def __init__(self):
+        self.checkpoint_engine = _Ckpt()
+        self.global_steps = 0
+        self.log = []
+
+    def train_batch(self, data_iter=None):
+        self.log.append("train_batch")
+        self.global_steps += 1
+        return 0.5
+
+    def backward(self, loss):
+        self.log.append("backward")
+
+    def step(self):
+        self.log.append("step")
+
+    def save_checkpoint(self, save_dir, tag=None):
+        self.checkpoint_engine.save({}, "p")
+        self.checkpoint_engine.commit(tag)
+
+    def load_checkpoint(self, load_dir, tag=None):
+        self.log.append("load_checkpoint")
+
+
+class TestInjectedTrainEngine:
+    def test_fault_fires_before_dispatch(self):
+        inj = FaultInjector([FaultSpec(site="backward", kind="transient",
+                                       nth=1)], sleep=lambda s: None)
+        eng = InjectedTrainEngine(_Inner(), inj)
+        with pytest.raises(TransientEngineError):
+            eng.backward(0.5)
+        assert eng.inner.log == []  # gate fired BEFORE the engine moved
+        eng.backward(0.5)  # spec spent: retry goes through verbatim
+        assert eng.inner.log == ["backward"]
+
+    def test_checkpoint_engine_sites_are_armed(self):
+        inj = FaultInjector([FaultSpec(site="ckpt_save", kind="transient",
+                                       nth=2)], sleep=lambda s: None)
+        eng = InjectedTrainEngine(_Inner(), inj)
+        eng.save_checkpoint("/tmp/x")  # save #1 passes
+        with pytest.raises(TransientEngineError):
+            eng.save_checkpoint("/tmp/x")  # save #2 hits the spec
+        assert inj.calls["ckpt_save"] == 2
+        assert inj.calls["ckpt_commit"] == 1  # the faulted save never commits
+
+    def test_device_lost_is_permadeath_until_rebuild(self):
+        inj = FaultInjector([FaultSpec(site="train_batch", kind="device_lost",
+                                       nth=1)], sleep=lambda s: None)
+        eng = InjectedTrainEngine(_Inner(), inj)
+        with pytest.raises(DeviceLostError):
+            eng.train_batch()
+        for call in (eng.step, lambda: eng.load_checkpoint("/tmp/x")):
+            with pytest.raises(DeviceLostError):
+                call()
+        eng.rebuild()
+        eng.train_batch()
+        assert eng.inner.global_steps == 1
+        assert inj.revivals == 1
+
+    def test_attribute_reads_and_writes_delegate(self):
+        eng = InjectedTrainEngine(_Inner(), FaultInjector(sleep=lambda s: None))
+        assert eng.global_steps == 0
+        eng.global_steps = 7
+        assert eng.inner.global_steps == 7
+
+
+# ---------------------------------------------------------------------------
+# acceptance: chaos training run, bitwise loss-curve parity
+# ---------------------------------------------------------------------------
+
+class TestChaosTraining:
+    def test_storm_plus_device_loss_resumes_bitwise(self, tmp_path):
+        d_ref, d_chaos = str(tmp_path / "ref"), str(tmp_path / "chaos")
+        ref = _mk_engine()
+        sup_ref = TrainingSupervisor(ref, _batches_for, d_ref,
+                                     save_interval=2, sleep=lambda s: None)
+        sup_ref.run(STEPS + 3)
+        ref_curve = np.asarray([np.asarray(x) for x in sup_ref.loss_curve()])
+        assert sup_ref.report()["goodput_ratio"] == 1.0
+
+        eng = _mk_engine()
+        _pin(eng, ref)
+        plan = [
+            FaultSpec(site="train_batch", kind="transient", nth=2, count=2),
+            FaultSpec(site="ckpt_save", kind="transient", nth=3),
+            FaultSpec(site="train_batch", kind="device_lost", nth=9),
+            FaultSpec(site="load_checkpoint", kind="transient", nth=1),
+            FaultSpec(site="train_batch", kind="latency", nth=12,
+                      latency_s=0.0),
+        ]
+        inj = FaultInjector(plan, seed=0, sleep=lambda s: None)
+        sup = TrainingSupervisor(
+            InjectedTrainEngine(eng, inj), _batches_for, d_chaos,
+            save_interval=2, retry=RetryPolicy(max_attempts=4, base_s=0.0),
+            recovery=RecoveryPolicy(max_consecutive_rebuilds=3),
+            sleep=lambda s: None)
+        sup.run(STEPS + 3)
+        rep = sup.report()
+        assert rep["retries"] >= 1 and rep["recoveries"] >= 1
+        assert rep["faults_fired"]["device_lost"] == 1
+        assert rep["net_steps"] == STEPS + 3
+        assert 0.0 < rep["goodput_ratio"] < 1.0
+        chaos_curve = np.asarray([np.asarray(x) for x in sup.loss_curve()])
+        np.testing.assert_array_equal(ref_curve, chaos_curve)
+        _assert_trees_equal(ref.params, eng.params)
