@@ -39,6 +39,13 @@ The ``spec_decode`` row is speculative decoding's acceptance A/B
 verification vs the K=8 fused decode baseline, on a drafting-friendly
 single-stream workload (the ISSUE 8 >2.5x gate) and a natural batched one,
 tokens bitwise-asserted and ``serve/spec/*`` acceptance counters reported.
+
+The ``pool_scaling`` row is the engine pool's acceptance A/B
+(docs/SERVING.md "Engine pool"): one shared-prefix workload served at
+N ∈ {1, 2, 4} data-parallel replicas behind the prefix-affinity router,
+with an affinity-off baseline, a seeded replica kill mid-load (journal
+replay across the survivor, bitwise vs the fault-free reference), and
+compiled-program bounds held on every surviving engine.
 """
 
 import json
@@ -687,6 +694,217 @@ def run_prefill_convoy(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_pool_scaling(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The engine-pool acceptance row (docs/SERVING.md "Engine pool"):
+    a shared-prefix workload (4 prompt families, 6 requests each) served
+    by an ``EnginePool`` at N ∈ {1, 2, 4} data-parallel replicas, with
+    ``max_seqs`` seats PER replica — aggregate tokens/s and p99 TTFT per
+    N. Three acceptance arms ride the same workload:
+
+    - **affinity A/B** at N=4: prefix-affinity routing vs pure
+      least-loaded (``Router(affinity=False)``) — affinity must win on
+      pooled cache hit-blocks (followers land where their family's KV
+      already lives instead of recomputing it N ways).
+    - **replica kill** at N=2: a seeded ``device_lost`` fires mid-load
+      on replica 0; the pool absorbs it (journal replay across the
+      survivor) and every request must still complete bitwise identical
+      to the fault-free single-engine reference.
+    - **bounds**: every surviving engine holds the fixed compiled-program
+      set (≤4 ragged, ≤1 fused, ≤1 verify) whatever N or the kill did.
+
+    Like the other micro rows this uses a deliberately small model —
+    pool placement/migration is host-side control-plane work, so a tiny
+    model keeps all five arms cheap while exercising the real paths."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.resilience import (FaultInjector, FaultSpec,
+                                          RecoveryPolicy, RetryPolicy)
+    from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                     RequestState, Router)
+
+    cfg = gpt2_config("125m", max_seq_len=128, hidden_size=128,
+                      num_layers=2, num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    GROUPS, PER_GROUP, GEN = 4, 6, 12
+
+    # workload: 4 prompt families sharing a 48-token head (3 full
+    # 16-token blocks — the affinity probe unit) + unique U[8,24] tails.
+    # Leaders (one per family) go first and cache the head; followers
+    # are the bulk the router places.
+    rng = np.random.default_rng(29)
+    heads = [rng.integers(0, 1024, 48).tolist() for _ in range(GROUPS)]
+    uids = iter(range(9000, 9900))
+    leaders, followers = [], []
+    for head in heads:
+        leaders.append((next(uids), head + rng.integers(
+            0, 1024, int(rng.integers(8, 25))).tolist()))
+    for _ in range(PER_GROUP - 1):
+        for head in heads:
+            followers.append((next(uids), head + rng.integers(
+                0, 1024, int(rng.integers(8, 25))).tolist()))
+    # seeded shuffle: family-ordered submission would rotate in lockstep
+    # with least-loaded's id tie-break, accidentally routing every
+    # family to its leader's replica even with affinity off
+    followers = [followers[i] for i in rng.permutation(len(followers))]
+    workload = leaders + followers
+
+    def make_engine():
+        return InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=128,
+            prefill_chunk=16, dtype=jnp.bfloat16, paged=True,
+            block_size=16, token_budget=32, num_blocks=1 + max_seqs * 12,
+            prefix_cache=prefix_cache)
+
+    def _bounds(eng):
+        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1, (
+            eng.fused_cache_size, eng.verify_cache_size)
+
+    # fault-free single-engine reference — the bitwise oracle (greedy
+    # decoding makes placement/migration/replay invisible in the tokens)
+    ref_sched = ContinuousBatchScheduler(
+        make_engine(), max_queue=len(workload),
+        retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+    refs = [ref_sched.submit(p, max_new_tokens=GEN, uid=u)
+            for u, p in workload]
+    ref_sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in refs)
+    ref_tokens = {r.uid: list(r.tokens) for r in refs}
+    ref_sched.close()
+    gc.collect()
+
+    def arm(n_replicas: int, *, affinity: bool = True,
+            kill: bool = False) -> dict:
+        engines, injectors = {}, {}
+
+        def factory(i):
+            eng = make_engine()
+            engines[i] = eng
+            if kill and i == 0:
+                # 3rd admission on replica 0 dies — mid-load, with the
+                # followers wave queued/live behind it
+                injectors[i] = FaultInjector(
+                    [FaultSpec(site="put", kind="device_lost", nth=3)])
+                return injectors[i].wrap(eng)
+            return eng
+
+        pool = EnginePool.build(
+            factory, n_replicas, router=Router(affinity=affinity),
+            recovery=RecoveryPolicy(max_consecutive_rebuilds=3),
+            max_queue=len(workload),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        if not kill:
+            # warm the fixed-shape compiled programs off the clock (any
+            # request compiles them), then flush the warmup KV out of
+            # the prefix cache and drop its counters/latency samples so
+            # the measured arm starts clean
+            for rep in pool.replicas:
+                w = rep.scheduler.submit(list(range(20)), max_new_tokens=2,
+                                         uid=8900 + rep.replica_id)
+                while not w.finished:
+                    rep.scheduler.step()
+                rep.engine.block_mgr.flush_cache()
+                for k in rep.engine.block_mgr.stats:
+                    rep.engine.block_mgr.stats[k] = 0
+                rep.scheduler.metrics.ttft_s.clear()
+
+        t0 = time.perf_counter()
+        reqs = [pool.submit(p, max_new_tokens=GEN, uid=u)
+                for u, p in leaders]
+        pool.run_until_complete()    # leaders cache their family head
+        reqs += [pool.submit(p, max_new_tokens=GEN, uid=u)
+                 for u, p in followers]
+        pool.run_until_complete()
+        wall = time.perf_counter() - t0
+
+        assert all(r.state is RequestState.DONE for r in reqs)
+        bitwise = all(list(r.tokens) == ref_tokens[r.uid] for r in reqs)
+        assert bitwise, "pool tokens diverged from single-engine reference"
+        ttft = sorted(t for rep in pool.replicas
+                      for t in rep.scheduler.metrics.ttft_s)
+        hit_blocks = lookups = 0
+        for rep in pool.replicas:
+            if rep.state != "dead":
+                _bounds(rep.engine)
+                s = rep.engine.prefix_cache_stats()
+                hit_blocks += s.get("hit_blocks", 0)
+                lookups += s.get("lookups", 0)
+        out = {
+            "n_replicas": n_replicas, "affinity": affinity,
+            "tokens_per_s": round(
+                sum(len(r.tokens) for r in reqs) / wall, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 1),
+            "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 1),
+            "placement_hits": pool.metrics.pool["placement_hits"],
+            "affinity_blocks": pool.metrics.pool["affinity_blocks"],
+            "cache_hit_blocks": hit_blocks, "cache_lookups": lookups,
+            "all_requests_completed": True,
+            "tokens_bitwise_identical": bitwise,
+        }
+        if kill:
+            assert injectors[0].deaths == 1, injectors[0].deaths
+            assert pool.replica(0).state == "dead"
+            assert pool.metrics.pool["replica_deaths"] == 1
+            out.update({
+                "replica_deaths": pool.metrics.pool["replica_deaths"],
+                "death_replays": pool.metrics.pool["death_replays"],
+                "death_cancelled": pool.metrics.pool["death_cancelled"],
+                "recovery_trail": [k for _, k in pool.recovery.trail],
+            })
+        pool.close()
+        del pool, engines, injectors
+        gc.collect()
+        return out
+
+    scaling = {n: arm(n) for n in (1, 2, 4)}
+    no_affinity = arm(4, affinity=False)
+    killed = arm(2, kill=True)
+    if prefix_cache:
+        # the affinity acceptance: routing followers to their family's
+        # replica must beat least-loaded on pooled cache hit-blocks
+        assert scaling[4]["cache_hit_blocks"] > no_affinity[
+            "cache_hit_blocks"], (scaling[4], no_affinity)
+        assert scaling[4]["placement_hits"] > 0
+    speedup = (scaling[4]["tokens_per_s"] / scaling[1]["tokens_per_s"]
+               if scaling[1]["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "pool_scaling",
+                               prefix_cache),
+        "value": scaling[4]["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(speedup, 3) if speedup else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-pool-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': "
+                      "1024} ctx=128 (control-plane-bound pool A/B)"),
+            "workload": (f"{GROUPS} prompt families x {PER_GROUP} "
+                         "requests, 48-tok shared head (3 full blocks) "
+                         f"+ U[8,24] tails, gen {GEN}; leaders warm the "
+                         "cache, followers route; N replicas x "
+                         f"{max_seqs} seats each"),
+            "note": ("all replicas share this host's device, so aggregate "
+                     "tokens/s does NOT scale with N here — the per-N "
+                     "signal is TTFT (more seats, less queueing) and the "
+                     "acceptance arms; on N devices the replicas decode "
+                     "concurrently"),
+            "scaling": {f"n{n}": row for n, row in scaling.items()},
+            "affinity_off_n4": no_affinity,
+            "replica_kill_n2": killed,
+            "aggregate_speedup_n4_vs_n1": round(speedup, 3)
+            if speedup else None,
+            "affinity_hit_blocks_vs_least_loaded": (
+                scaling[4]["cache_hit_blocks"],
+                no_affinity["cache_hit_blocks"]),
+        },
+    }
+
+
 def _metric_name(mode: str, max_seqs: int, workload: str,
                  prefix_cache: bool) -> str:
     name = f"serve_{mode}_{max_seqs}seq"
@@ -727,6 +945,13 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       the K=8 fused baseline on a drafting-friendly single stream (the
       >2.5x ISSUE 8 gate) plus a natural batched workload, both greedy and
       bitwise-asserted, with ``serve/spec/*`` acceptance counters.
+    - ``pool_scaling``: the engine-pool acceptance A/B (docs/SERVING.md
+      "Engine pool"): a shared-prefix workload on an ``EnginePool`` at
+      N ∈ {1, 2, 4} replicas (``max_seqs`` seats each) — aggregate
+      tokens/s + p99 TTFT per N, prefix-affinity vs least-loaded routing
+      on cache hit-blocks, and one seeded replica ``device_lost``
+      mid-load absorbed by journal replay across the survivor, bitwise
+      vs the fault-free single-engine reference.
     - ``chaos`` (``--faults``): the mixed workload under a seeded fault plan
       (transient bursts, latency spikes, one persistent per-request fault)
       vs its own fault-free reference, decoding speculatively so the site
@@ -762,6 +987,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_prefill_convoy(max_seqs, prefix_cache)
     if workload == "spec_decode":
         return run_spec_decode(max_seqs, prefix_cache)
+    if workload == "pool_scaling":
+        return run_pool_scaling(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -901,6 +1128,7 @@ CONFIGS = (
     ("paged", 4, "decode_horizon", True),
     ("paged", 16, "prefill_convoy", True),
     ("paged", 4, "spec_decode", True),
+    ("paged", 4, "pool_scaling", True),
 )
 
 

@@ -45,6 +45,10 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     # exactly like the decode loop's multiply by tokens/second
     "train_batch", "step_fn", "backward", "_fused_micro_step",
     "_multi_exec_step",
+    # the engine pool's per-submission placement decision (router.py) and
+    # the read-only content-index probe it runs against every replica —
+    # pool traffic multiplies both by requests/second × replicas
+    "place", "probe", "prefix_probe",
 })
 
 #: where the hot-path rules (001/002) apply — ``resilience`` joined when

@@ -382,6 +382,55 @@ def check_recovery(journal, queued, all_requests: Dict[int, object]) -> None:
                              + "; ".join(problems))
 
 
+def check_pool_ownership(replica_views, owner: Dict[int, int]) -> None:
+    """Engine-pool ownership invariant (docs/SERVING.md): every live
+    request is owned by EXACTLY one replica. ``replica_views`` is a list
+    of ``(replica_id, journal, all_requests)`` triples (non-dead replicas
+    only); ``owner`` is the pool's uid -> replica_id map. Violations this
+    catches:
+
+    - a uid journaled on two replicas at once (a double adopt — the
+      request would decode twice and its journals diverge);
+    - a journal entry whose uid the SAME replica's scheduler does not
+      know live (an orphaned entry: detach removed the request but the
+      journal handoff was lost — its stream consumer hangs);
+    - a live request no journal covers (an orphaned request: an engine
+      loss now would silently drop it — the write-ahead contract);
+    - the pool's owner map disagreeing with where the journal actually
+      lives (migration updated one side but not the other).
+
+    Duck-typed on ``journal.uids()`` / ``Request.state`` like
+    :func:`check_recovery` — no serve/resilience import."""
+    problems: List[str] = []
+    seen: Dict[int, int] = {}
+    for rid, journal, all_requests in replica_views:
+        for uid in journal.uids():
+            if uid in seen:
+                problems.append(f"uid {uid} journaled on replicas "
+                                f"{seen[uid]} AND {rid} — double adopt")
+                continue
+            seen[uid] = rid
+            req = all_requests.get(uid)
+            state = getattr(getattr(req, "state", None), "value", None)
+            if req is None or state in ("done", "cancelled", "failed"):
+                problems.append(f"uid {uid} journaled on replica {rid} "
+                                f"but not live there ({state}) — "
+                                "orphaned entry")
+            own = owner.get(uid)
+            if own is not None and own != rid:
+                problems.append(f"uid {uid}: pool owner map says replica "
+                                f"{own}, journal lives on {rid}")
+        for uid, req in all_requests.items():
+            state = getattr(getattr(req, "state", None), "value", None)
+            if (state not in ("done", "cancelled", "failed")
+                    and uid not in journal.uids()):
+                problems.append(f"uid {uid} live on replica {rid} with "
+                                "no journal entry — unreplayable")
+    if problems:
+        raise SanitizerError("[sanitizer] pool ownership violation: "
+                             + "; ".join(problems))
+
+
 # ---------------------------------------------------------------------------
 # training: partition/gather conservation (ZeRO state)
 # ---------------------------------------------------------------------------

@@ -84,18 +84,10 @@ class InferenceEngineV2:
         self.decode_horizon = decode_horizon
         if params is None:
             params = model.init_params(jax.random.PRNGKey(0))
-
-        def cast(path, a):
-            # keep weight-only-quantized leaves in their storage dtype
-            # (int8 codes / fp32 group scales — ops/quantizer/woq.py)
-            a = jnp.asarray(a)
-            key = getattr(path[-1], "key", "") if path else ""
-            if jnp.issubdtype(a.dtype, jnp.integer) or (
-                    isinstance(key, str) and key.endswith("::scale")):
-                return a
-            return a.astype(dtype)
-
-        self.params = jax.tree_util.tree_map_with_path(cast, params)
+        self.params = self._cast_params(params)
+        #: rolling-weight-update tag (docs/SERVING.md engine pool): opaque
+        #: label of the weights currently served, set by ``load_params``
+        self.weights_version = None
         self.state = DSStateManager(max_seqs, self.max_seq_len)
         self.flush_noops = 0  # idempotent-flush debug counter (see flush())
         self.rebuilds = 0     # engine-loss hot rebuilds (see rebuild())
@@ -154,6 +146,49 @@ class InferenceEngineV2:
                 f"InferenceEngineV2: slots={max_seqs} ctx={self.max_seq_len} "
                 f"chunk={prefill_chunk}", ranks=[0],
             )
+
+    def _cast_params(self, params):
+        def cast(path, a):
+            # keep weight-only-quantized leaves in their storage dtype
+            # (int8 codes / fp32 group scales — ops/quantizer/woq.py)
+            a = jnp.asarray(a)
+            key = getattr(path[-1], "key", "") if path else ""
+            if jnp.issubdtype(a.dtype, jnp.integer) or (
+                    isinstance(key, str) and key.endswith("::scale")):
+                return a
+            return a.astype(self.dtype)
+
+        return jax.tree_util.tree_map_with_path(cast, params)
+
+    def load_params(self, params, version=None) -> None:
+        """Hot weight swap (docs/SERVING.md engine pool rolling update):
+        replace the served parameters with a new pytree of the SAME
+        structure and shapes, cast exactly like construction. The compiled
+        programs take params as a runtime argument, so same shapes means
+        zero recompilation — the ragged/fused/verify dispatch bounds are
+        untouched. The caller (the pool's drain protocol) guarantees no
+        sequence is resident: KV produced under the old weights must never
+        mix with logits from the new ones."""
+        if self.state.n_active:
+            raise EngineUsageError(
+                f"load_params with {self.state.n_active} resident "
+                "sequence(s) — drain the engine first (their cached KV "
+                "was computed under the old weights)")
+        self.params = self._cast_params(params)
+        self.weights_version = version
+        if self.paged:
+            # the prefix content index holds KV computed under the OLD
+            # weights — serving it to post-swap prompts would silently mix
+            # weight versions
+            self.block_mgr.flush_cache()
+
+    def prefix_probe(self, tokens) -> int:
+        """Read-only placement probe: leading full blocks of ``tokens``
+        present in this engine's prefix content index (0 for slot engines
+        or with the prefix cache off). The router's affinity score."""
+        if not self.paged or not self.prefix_cache:
+            return 0
+        return self.block_mgr.probe(tokens)
 
     # ------------------------------------------------------------------
     # compiled programs

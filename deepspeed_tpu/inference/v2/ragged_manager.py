@@ -270,6 +270,29 @@ class BlockedKVCache:
         self.stats["skipped_prefill_tokens"] += skipped
         return skipped
 
+    def probe(self, tokens: Sequence[int]) -> int:
+        """Read-only affinity probe (docs/SERVING.md engine pool): how many
+        leading FULL blocks of ``tokens`` the content index currently holds.
+        Walks the same root-anchored chain as :meth:`lookup` but touches
+        nothing — no refcounts, no LRU order, no stats — so a router may
+        score every replica per placement without perturbing any cache.
+        Deterministic: the exact chained index, not a hash sketch."""
+        if not self.prefix_cache:
+            return 0
+        bs = self.block_size
+        hits = 0
+        parent = _ROOT
+        while (hits + 1) * bs <= min(len(tokens),
+                                     self.max_blocks_per_seq * bs):
+            key = (parent, tuple(int(t) for t in
+                                 tokens[hits * bs:(hits + 1) * bs]))
+            b = self._index.get(key)
+            if b is None:
+                break
+            hits += 1
+            parent = b
+        return hits
+
     def copy_on_write(self, desc: SequenceDescriptor, j: int) -> Tuple[int, int]:
         """Detach ``desc``'s shared block ``j`` before a write: allocate a
         private block, hand back ``(src, dst)`` so the engine copies the KV

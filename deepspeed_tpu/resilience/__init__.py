@@ -16,6 +16,7 @@ from .errors import (CheckpointCorruptError,  # noqa: F401
 from .faults import (ALL_SITES, SITES, TRAIN_SITES,  # noqa: F401
                      FaultInjector, FaultSpec, InjectedEngine,
                      InjectedTrainEngine)
+from .journal_store import DurableRequestJournal  # noqa: F401
 from .recovery import (JournalEntry, RecoveryPolicy,  # noqa: F401
                        RequestJournal)
 from .retry import RetryPolicy  # noqa: F401

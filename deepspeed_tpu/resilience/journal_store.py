@@ -1,0 +1,200 @@
+"""Durable request journal: append-only on-disk persistence (docs/RESILIENCE.md).
+
+:class:`RequestJournal` is host-memory only — it survives the *engine* by
+construction, but a crash of the *host process* loses every in-flight
+request (ROADMAP: the gap the durable journal closes, and the natural
+first step of the engine pool: a restarted host replays its journal
+exactly like a survivor replica absorbs a dead one's).
+
+:class:`DurableRequestJournal` extends the journal with a write-ahead log
+on disk, adapting the PR 10 checkpoint durability protocol
+(``runtime/checkpoint_engine/native_checkpoint_engine.py``: payload →
+meta → CRC32-verified manifest written LAST) to an append-only stream:
+
+- **one CRC-framed line per mutation** — ``crc32(payload) payload\\n``
+  with a JSON payload. The frame plays the manifest's role at record
+  granularity: a record is durable iff its complete line (CRC prefix,
+  payload, trailing newline) reached the disk. There is no partially
+  valid record, only present or absent — the same all-or-nothing contract
+  the manifest-last rename gives a whole checkpoint.
+- **torn tails truncate, never propagate**: on open, the log is folded
+  record by record; the first invalid frame (short line at EOF, CRC
+  mismatch, undecodable payload) marks the torn tail of an interrupted
+  write — the file is truncated back to the last valid record and the
+  typed counter ``corrupt_tail_truncations`` records the event (with
+  ``corrupt_tail_dropped_bytes`` for forensics). Everything before the
+  tear replays; a commit that never fully landed is re-derived by the
+  normal recovery replay (the token it recorded is regenerated bitwise
+  under greedy).
+- **log kinds mirror the journal surface**: ``record`` / ``commit`` /
+  ``resolve`` and the ownership-transfer pair ``detach`` / ``adopt``
+  (an adopt logs the FULL entry, so each replica's log is self-contained
+  — replaying one file never needs another replica's).
+
+Writes are flushed per append (the commit path is the per-token hot path
+the DSTPU rules police: one buffered ``write`` + ``flush``, no fsync by
+default); ``fsync=True`` upgrades every append to a true durability
+barrier for hosts where the page cache is not trusted to survive."""
+
+import json
+import os
+import zlib
+from typing import Optional
+
+from ..utils.logging import logger
+from .recovery import JournalEntry, RequestJournal
+
+
+def _frame(payload: str) -> str:
+    crc = zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+    return f"{crc:08x} {payload}\n"
+
+
+def _unframe(line: str) -> Optional[dict]:
+    """Parse one framed line; None on any tear (bad frame, CRC mismatch,
+    undecodable payload) — the caller truncates from there."""
+    if not line.endswith("\n") or len(line) < 10 or line[8] != " ":
+        return None
+    try:
+        crc = int(line[:8], 16)
+    except ValueError:
+        return None
+    payload = line[9:-1]
+    if zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF != crc:
+        return None
+    try:
+        rec = json.loads(payload)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) and "kind" in rec else None
+
+
+class DurableRequestJournal(RequestJournal):
+    """A :class:`RequestJournal` whose every mutation is logged to
+    ``path`` before control returns — write-ahead on disk, not just in
+    memory. Opening an existing path replays the log (fold: record/adopt
+    install, commit extends, resolve/detach drop), truncating a torn tail
+    to the last valid record. The in-memory surface and counters behave
+    exactly like the base class; ``replayed_records`` counts the folded
+    log records and ``corrupt_tail_truncations`` the tail repairs."""
+
+    def __init__(self, path: str, *, fsync: bool = False):
+        super().__init__()
+        self.path = path
+        self.fsync = fsync
+        self.replayed_records = 0
+        #: typed counter (docs/RESILIENCE.md): torn-tail repairs performed
+        #: at open — each is one truncation back to the last valid record
+        self.corrupt_tail_truncations = 0
+        self.corrupt_tail_dropped_bytes = 0
+        self._fh = None
+        self._replay()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    # ------------------------------------------------------------------
+    # log replay + tail repair
+    # ------------------------------------------------------------------
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        valid_end = 0
+        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                rec = _unframe(line)
+                if rec is None:
+                    break
+                self._fold(rec)
+                self.replayed_records += 1
+                valid_end += len(line.encode("utf-8"))
+        size = os.path.getsize(self.path)
+        if valid_end < size:
+            self.corrupt_tail_truncations += 1
+            self.corrupt_tail_dropped_bytes += size - valid_end
+            logger.warning(
+                "durable journal %s: corrupt tail — truncating %d byte(s) "
+                "back to the last valid record (%d replayed)", self.path,
+                size - valid_end, self.replayed_records)
+            with open(self.path, "r+b") as f:
+                f.truncate(valid_end)
+
+    def _fold(self, rec: dict) -> None:
+        kind = rec["kind"]
+        if kind in ("record", "adopt"):
+            e = JournalEntry(
+                uid=rec["uid"], prompt=list(rec["prompt"]),
+                tokens=list(rec["tokens"]),
+                max_new_tokens=rec["max_new_tokens"],
+                priority=rec["priority"], deadline=rec["deadline"],
+                arrival_time=rec["arrival_time"], eos_token=rec["eos_token"])
+            self._entries[e.uid] = e
+        elif kind == "commit":
+            e = self._entries.get(rec["uid"])
+            if e is not None:
+                e.tokens.extend(rec["tokens"])
+        elif kind in ("resolve", "detach"):
+            self._entries.pop(rec["uid"], None)
+        # unknown kinds fold to nothing: forward compatibility — a newer
+        # writer's records must not wedge an older reader's recovery
+
+    # ------------------------------------------------------------------
+    # write-ahead appends
+    # ------------------------------------------------------------------
+    def _append(self, rec: dict) -> None:
+        if self._fh is None:  # replay phase: nothing to re-log
+            return
+        self._fh.write(_frame(json.dumps(rec, separators=(",", ":"))))
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    @staticmethod
+    def _entry_rec(kind: str, e: JournalEntry) -> dict:
+        return {"kind": kind, "uid": e.uid, "prompt": list(e.prompt),
+                "tokens": list(e.tokens),
+                "max_new_tokens": e.max_new_tokens, "priority": e.priority,
+                "deadline": e.deadline, "arrival_time": e.arrival_time,
+                "eos_token": e.eos_token}
+
+    def record(self, req) -> JournalEntry:
+        e = super().record(req)
+        self._append(self._entry_rec("record", e))
+        return e
+
+    def commit(self, req) -> None:
+        e = self._entries.get(req.uid)
+        done = len(e.tokens) if e is not None else 0
+        super().commit(req)
+        if e is not None and len(e.tokens) > done:
+            # append-only tail sync, mirroring the in-memory commit: only
+            # the NEW committed tokens hit the log (O(new) per commit point)
+            self._append({"kind": "commit", "uid": req.uid,
+                          "tokens": e.tokens[done:]})
+
+    def resolve(self, uid: int) -> None:
+        present = uid in self._entries
+        super().resolve(uid)
+        if present:
+            self._append({"kind": "resolve", "uid": uid})
+
+    def detach(self, uid: int) -> JournalEntry:
+        e = super().detach(uid)
+        self._append({"kind": "detach", "uid": uid})
+        return e
+
+    def adopt(self, entry: JournalEntry) -> JournalEntry:
+        e = super().adopt(entry)
+        # the FULL entry: this log stays self-contained — its replay never
+        # needs the detaching replica's file
+        self._append(self._entry_rec("adopt", e))
+        return e
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -52,6 +52,14 @@ class JournalEntry:
     arrival_time: float
     eos_token: Optional[int]
     commits: int = field(default=0, compare=False)  # commit points synced
+    #: migration payload (docs/SERVING.md engine pool): ``detach`` attaches
+    #: the live ``Request`` object so the adopting scheduler keeps serving
+    #: the SAME object — streaming consumers and the pool's owner map follow
+    #: the request across replicas. Never persisted (the durable journal
+    #: reconstructs requests from the serialized fields) and excluded from
+    #: equality — two entries with identical replay state are the same
+    #: record whichever host object carries them.
+    request: Optional[object] = field(default=None, compare=False, repr=False)
 
     def replay_tokens(self) -> List[int]:
         """Prompt plus committed tokens — the ``put`` payload re-admission
@@ -74,6 +82,8 @@ class RequestJournal:
         self.records = 0
         self.commit_points = 0
         self.resolutions = 0
+        self.detaches = 0
+        self.adoptions = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -118,6 +128,35 @@ class RequestJournal:
         Idempotent — terminal paths may cross (cancel during fail)."""
         if self._entries.pop(uid, None) is not None:
             self.resolutions += 1
+
+    # ------------------------------------------------------------------
+    # ownership transfer (docs/SERVING.md engine pool)
+    # ------------------------------------------------------------------
+    def detach(self, uid: int) -> JournalEntry:
+        """Remove and return a live entry WITHOUT resolving it: the request
+        is not terminal, its record is changing owners (cross-replica
+        migration / death replay). Counted separately from ``resolutions``
+        so the pool-ownership sanitizer can prove no entry was silently
+        dropped. Raises ``ValueError`` on an unknown uid — a detach of an
+        unrecorded request is a caller bug, never a race."""
+        e = self._entries.pop(uid, None)
+        if e is None:
+            raise ValueError(f"uid {uid} has no journal entry to detach")
+        self.detaches += 1
+        return e
+
+    def adopt(self, entry: JournalEntry) -> JournalEntry:
+        """Install an entry detached from another journal, preserving the
+        committed-token record byte for byte (the bitwise replay contract).
+        Raises ``ValueError`` if the uid is already journaled here — the
+        single-owner invariant ``check_pool_ownership`` enforces across the
+        pool holds within one journal too."""
+        if entry.uid in self._entries:
+            raise ValueError(
+                f"uid {entry.uid} is already journaled here — double adopt")
+        self._entries[entry.uid] = entry
+        self.adoptions += 1
+        return entry
 
     def live(self) -> List[JournalEntry]:
         """Every unresolved entry, in admission order — the replay set."""

@@ -9,12 +9,14 @@ fused dispatch), and the serving metrics surface.
 See ``docs/SERVING.md`` and ``docs/RESILIENCE.md``.
 """
 
-from ..resilience import (CircuitBreaker, FaultInjector,  # noqa: F401
-                          FaultSpec, PoolExhaustedError, RequestFailedError,
-                          RetryPolicy, SheddingError, StepWatchdog,
-                          TransientEngineError)
-from .metrics import ServeMetrics  # noqa: F401
+from ..resilience import (CircuitBreaker, DurableRequestJournal,  # noqa: F401
+                          FaultInjector, FaultSpec, PoolExhaustedError,
+                          RequestFailedError, RetryPolicy, SheddingError,
+                          StepWatchdog, TransientEngineError)
+from .metrics import PoolMetrics, ServeMetrics  # noqa: F401
+from .pool import EnginePool, Replica  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
+from .router import Router  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
                         SchedulerClosedError)
 from .speculation import (DraftModelProposer, DraftProposer,  # noqa: F401

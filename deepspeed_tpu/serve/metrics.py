@@ -12,7 +12,7 @@ scheduler's clock domain, so simulated arrival processes report meaningful
 queueing delay.
 """
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -20,9 +20,18 @@ Event = Tuple[str, float, int]
 
 
 class ServeMetrics:
-    """Aggregated serving counters + latency samples."""
+    """Aggregated serving counters + latency samples.
 
-    def __init__(self):
+    ``replica_id`` is the pool-membership label (docs/SERVING.md engine
+    pool): when set, every event label is emitted under
+    ``serve/replica<id>/...`` instead of ``serve/...`` so N replicas'
+    counters never alias in one ``MonitorMaster.write_events`` stream —
+    replica 0's ``tokens_generated`` and replica 1's stay separate series.
+    ``None`` (the single-engine default) keeps the historical labels
+    byte-identical."""
+
+    def __init__(self, replica_id: Optional[int] = None):
+        self.replica_id = replica_id
         self.submitted = 0
         self.admitted = 0
         self.completed = 0
@@ -32,6 +41,10 @@ class ServeMetrics:
         self.preempted_blocks_reclaimed = 0
         self.admission_rejects = 0   # bounded-queue backpressure
         self.deadline_cancels = 0    # expired while QUEUED
+        #: migration seam traffic (docs/SERVING.md engine pool): requests
+        #: handed off to another scheduler / received from one
+        self.detaches = 0
+        self.adopts = 0
         self.tokens_generated = 0
         self.queue_depth = 0         # gauge, refreshed each step
         self.live = 0                # gauge, refreshed each step
@@ -187,6 +200,7 @@ class ServeMetrics:
             "preempted_blocks_reclaimed": self.preempted_blocks_reclaimed,
             "admission_rejects": self.admission_rejects,
             "deadline_cancels": self.deadline_cancels,
+            "detaches": self.detaches, "adopts": self.adopts,
             "tokens_generated": self.tokens_generated,
             "queue_depth": self.queue_depth, "live": self.live,
             "queue_peak": self.queue_peak,
@@ -203,14 +217,83 @@ class ServeMetrics:
     def events(self, step: int = 0) -> List[Event]:
         """``(label, value, step)`` tuples for ``MonitorMaster.write_events``
         — serving counters under ``serve/``, resilience counters under
-        ``serve/faults/``."""
-        return ([(f"serve/{k}", float(v), step)
+        ``serve/faults/``. With a ``replica_id`` the whole tree moves under
+        ``serve/replica<id>/`` (no aliasing across pool members)."""
+        p = ("serve/" if self.replica_id is None
+             else f"serve/replica{self.replica_id}/")
+        return ([(f"{p}{k}", float(v), step)
                  for k, v in sorted(self.summary().items())]
-                + [(f"serve/decode/{k}", float(v), step)
+                + [(f"{p}decode/{k}", float(v), step)
                    for k, v in sorted(self.decode.items())]
-                + [(f"serve/prefill/{k}", float(v), step)
+                + [(f"{p}prefill/{k}", float(v), step)
                    for k, v in sorted(self.prefill.items())]
-                + [(f"serve/spec/{k}", float(v), step)
+                + [(f"{p}spec/{k}", float(v), step)
                    for k, v in sorted(self.spec.items())]
-                + [(f"serve/faults/{k}", float(v), step)
+                + [(f"{p}faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])
+
+
+class PoolMetrics:
+    """Pool-level control-plane counters (docs/SERVING.md engine pool),
+    exported under ``serve/pool/*``. Per-replica serving counters live in
+    each replica's own :class:`ServeMetrics` (replica-labeled); this class
+    holds only what no single replica can know: placement quality,
+    migration traffic, drain/rolling-update progress, death absorption,
+    and the load-imbalance gauge."""
+
+    def __init__(self):
+        self.pool: Dict[str, float] = {
+            "placements": 0,          # routed submissions
+            "placement_hits": 0,      # placements with a prefix-affinity hit
+            "affinity_blocks": 0,     # full prompt blocks matched at placement
+            "migrations": 0,          # detach->adopt moves (any reason)
+            "rebalances": 0,          # migrations made by rebalance()
+            "drains": 0,              # replica drains completed
+            "drain_duration_s": 0.0,  # latest drain wall-clock (gauge)
+            "weight_swaps": 0,        # load_weights() on a drained replica
+            "replica_deaths": 0,      # losses absorbed cross-replica
+            "death_replays": 0,       # journal entries replayed on survivors
+            "death_cancelled": 0,     # deadline-expired during death replay
+            "imbalance": 0.0,         # gauge: max - min serving-replica load
+            "replicas_serving": 0.0,  # gauges: pool health view
+            "replicas_draining": 0.0,
+            "replicas_dead": 0.0,
+        }
+
+    def observe_placement(self, hit_blocks: int) -> None:
+        self.pool["placements"] += 1
+        if hit_blocks > 0:
+            self.pool["placement_hits"] += 1
+            self.pool["affinity_blocks"] += hit_blocks
+
+    def observe_migration(self, rebalance: bool = False) -> None:
+        self.pool["migrations"] += 1
+        if rebalance:
+            self.pool["rebalances"] += 1
+
+    def observe_drain(self, duration_s: float) -> None:
+        self.pool["drains"] += 1
+        self.pool["drain_duration_s"] = float(duration_s)
+
+    def observe_weight_swap(self) -> None:
+        self.pool["weight_swaps"] += 1
+
+    def observe_death(self, replayed: int, cancelled: int) -> None:
+        self.pool["replica_deaths"] += 1
+        self.pool["death_replays"] += replayed
+        self.pool["death_cancelled"] += cancelled
+
+    def observe_gauges(self, loads: List[int], serving: int, draining: int,
+                       dead: int) -> None:
+        self.pool["imbalance"] = float(
+            (max(loads) - min(loads)) if loads else 0)
+        self.pool["replicas_serving"] = float(serving)
+        self.pool["replicas_draining"] = float(draining)
+        self.pool["replicas_dead"] = float(dead)
+
+    def summary(self) -> Dict[str, float]:
+        return dict(self.pool)
+
+    def events(self, step: int = 0) -> List[Event]:
+        return [(f"serve/pool/{k}", float(v), step)
+                for k, v in sorted(self.pool.items())]

@@ -1,0 +1,506 @@
+"""Engine pool: data-parallel serving replicas behind one prefix-affinity
+router (docs/SERVING.md "Engine pool").
+
+One :class:`EnginePool` owns N ``(scheduler, engine)`` replicas and a
+:class:`~deepspeed_tpu.serve.router.Router`. The pool is the control
+plane; each replica keeps its own queue, journal, breaker, and metrics
+(labelled ``serve/replica<i>/...`` so N series never alias). Four verbs
+define it:
+
+- **place** — ``submit`` routes each request to the replica holding the
+  longest full-block prefix of its prompt (exact content-index probe),
+  falling back to least-loaded. Shared-prefix traffic concentrates where
+  its KV already lives instead of recomputing it N ways.
+- **migrate** — a request moves replicas by ``detach`` (preempt +
+  journal handoff) and ``adopt`` (re-admission through normal ``put``).
+  Under greedy decoding the continuation is bitwise identical to a
+  never-migrated run — the same preemption round-trip guarantee
+  engine-loss recovery rides. ``rebalance`` uses it to close load gaps.
+- **drain** — rolling weight updates: one replica at a time stops taking
+  traffic, its live requests migrate to survivors, ``load_params`` swaps
+  weights (same shapes — zero recompilation), and the replica rejoins.
+  v1 and v2 serve side by side; no admitted request is ever rejected.
+- **absorb** — a replica death (``UnrecoverableEngineError`` escalated
+  out of ``scheduler.step``) replays the dead replica's journal across
+  survivors under the POOL's :class:`RecoveryPolicy` budget. Per-replica
+  breakers keep recording incidents; :meth:`EnginePool.health` is the
+  pool-level view. With no survivors the pool delegates to the replica's
+  own in-place recovery (the single-engine path, unchanged).
+
+Determinism (DSTPU005): every pool decision — placement, rebalance
+victim, death-replay targeting — is a pure function of replica state in
+replica-id order; no wall clock, RNG, or set iteration on a decision
+path. A replayed trace routes identically.
+"""
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..analysis import sanitizer as _sanitizer
+from ..resilience.errors import (EngineUsageError, RequestFailedError,
+                                 UnrecoverableEngineError)
+from ..resilience.recovery import RecoveryPolicy
+from ..utils.logging import logger
+from .metrics import Event, PoolMetrics
+from .request import Request, RequestState
+from .router import Router
+from .scheduler import (ContinuousBatchScheduler, QueueFullError,
+                        SchedulerClosedError)
+
+#: replica lifecycle states (plain strings — they cross process/log
+#: boundaries in health views and events)
+SERVING = "serving"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class Replica:
+    """One pool member: a scheduler (which owns its engine) plus the
+    pool-side lifecycle state. The router duck-types this handle:
+    ``replica_id``, ``scheduler``, ``engine``."""
+
+    def __init__(self, replica_id: int,
+                 scheduler: ContinuousBatchScheduler):
+        self.replica_id = replica_id
+        self.scheduler = scheduler
+        self.state = SERVING
+
+    @property
+    def engine(self):
+        return self.scheduler.engine
+
+    def __repr__(self) -> str:
+        return (f"Replica(id={self.replica_id}, state={self.state}, "
+                f"live={self.scheduler.live_count}, "
+                f"queued={self.scheduler.queue_depth})")
+
+
+class EnginePool:
+    """N data-parallel scheduler+engine replicas behind one router.
+
+    Construct from pre-built schedulers (each already holding its engine
+    and journal), or via :meth:`build` from an engine factory. The pool
+    forces ``escalate_losses=True`` on every member: an engine loss
+    raises out of the replica's ``step`` and the pool decides — replay
+    across survivors (cross-replica absorption) or, with none left,
+    delegate to the replica's own in-place rebuild.
+
+    ``recovery`` is the POOL's rebuild/absorption budget, separate from
+    each replica's own policy (which only governs the no-survivor
+    delegation path)."""
+
+    def __init__(self, schedulers: List[ContinuousBatchScheduler], *,
+                 router: Optional[Router] = None,
+                 recovery: Optional[RecoveryPolicy] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        if not schedulers:
+            raise ValueError("EnginePool needs at least one scheduler")
+        self.replicas: List[Replica] = []
+        for i, sched in enumerate(schedulers):
+            rid = sched.replica_id if sched.replica_id is not None else i
+            sched.replica_id = rid
+            sched.metrics.replica_id = rid
+            sched.escalate_losses = True
+            self.replicas.append(Replica(rid, sched))
+        ids = [r.replica_id for r in self.replicas]
+        if len(dict.fromkeys(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.replicas.sort(key=lambda r: r.replica_id)
+        self.router = router or Router()
+        self.recovery = recovery or RecoveryPolicy()
+        self._clock = clock or schedulers[0]._clock
+        self.metrics = PoolMetrics()
+        #: uid -> replica_id, maintained by every placement/migration;
+        #: the sanitizer cross-checks it against the journals
+        self._owner: Dict[int, int] = {}
+        #: uid -> Request for every request the pool ever placed (the
+        #: result surface — survives migration and replica death)
+        self._requests: Dict[int, Request] = {}
+        self._closed = False
+
+    @classmethod
+    def build(cls, engine_factory, n_replicas: int, *,
+              router: Optional[Router] = None,
+              recovery: Optional[RecoveryPolicy] = None,
+              journal_factory=None,
+              clock: Callable[[], float] = time.monotonic,
+              **scheduler_kw) -> "EnginePool":
+        """Construct ``n_replicas`` schedulers over fresh engines.
+        ``engine_factory(i)`` returns replica *i*'s engine;
+        ``journal_factory(i)`` (optional) its journal — e.g. a
+        :class:`~deepspeed_tpu.resilience.DurableRequestJournal` per
+        replica. ``scheduler_kw`` is forwarded to every scheduler."""
+        scheds = []
+        for i in range(n_replicas):
+            kw = dict(scheduler_kw)
+            if journal_factory is not None:
+                kw["journal"] = journal_factory(i)
+            scheds.append(ContinuousBatchScheduler(
+                engine_factory(i), replica_id=i, escalate_losses=True,
+                clock=clock, **kw))
+        return cls(scheds, router=router, recovery=recovery, clock=clock)
+
+    # ------------------------------------------------------------------
+    # membership views
+    # ------------------------------------------------------------------
+    def replica(self, replica_id: int) -> Replica:
+        for rep in self.replicas:
+            if rep.replica_id == replica_id:
+                return rep
+        raise ValueError(f"no replica {replica_id} in this pool")
+
+    def _serving(self, exclude: Optional[Replica] = None) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state == SERVING and r is not exclude]
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def submit(self, prompt, **kw) -> Request:
+        """Route one request: prefix-affinity first, least-loaded
+        fallback (:class:`Router`). A replica rejecting on backpressure
+        (``QueueFullError``) is removed from the candidate set and the
+        placement retries; the error propagates only when EVERY serving
+        replica is full. ``SheddingError`` from an open breaker
+        propagates as-is — shedding is the replica saying shed, not
+        "try my neighbour"."""
+        if self._closed:
+            raise SchedulerClosedError("pool is closed to new admits")
+        candidates = self._serving()
+        while True:
+            rep, hits = self.router.place(prompt, candidates)
+            if rep is None:
+                raise QueueFullError(
+                    "every serving replica rejected this request")
+            try:
+                req = rep.scheduler.submit(prompt, **kw)
+            except QueueFullError:
+                candidates = [c for c in candidates if c is not rep]
+                continue
+            self._owner[req.uid] = rep.replica_id
+            self._requests[req.uid] = req
+            self.metrics.observe_placement(hits)
+            return req
+
+    # ------------------------------------------------------------------
+    # stepping
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One pool iteration: step every non-dead replica in id order;
+        an escalated engine loss routes to :meth:`_absorb_replica_loss`.
+        Returns True while any replica has work."""
+        work = False
+        for rep in self.replicas:
+            if rep.state == DEAD:
+                continue
+            try:
+                if rep.scheduler.step():
+                    work = True
+            except UnrecoverableEngineError as e:
+                self._absorb_replica_loss(rep, e)
+                work = True
+        for uid in [u for u, req in list(self._requests.items())
+                    if req.finished]:
+            self._owner.pop(uid, None)
+        self.metrics.observe_gauges(
+            [Router.load(r) for r in self.replicas if r.state != DEAD],
+            serving=sum(1 for r in self.replicas if r.state == SERVING),
+            draining=sum(1 for r in self.replicas if r.state == DRAINING),
+            dead=sum(1 for r in self.replicas if r.state == DEAD))
+        if _sanitizer.sanitize_enabled():
+            # checked mode: every live uid owned by exactly one replica,
+            # no journal entry orphaned or double-adopted
+            _sanitizer.check_pool_ownership(
+                [(r.replica_id, r.scheduler.journal, r.scheduler._all)
+                 for r in self.replicas if r.state != DEAD],
+                self._owner)
+        return work
+
+    def run_until_complete(self) -> None:
+        while self.step():
+            pass
+
+    def stream(self, req: Request):
+        """Yield ``req``'s tokens as generated, driving the POOL loop —
+        the request may migrate replicas mid-stream; the iterator
+        follows it (same ``Request`` object rides the journal entry)."""
+        while True:
+            for tok in req.new_tokens():
+                yield tok
+            if req.finished:
+                if req.error is not None:
+                    raise req.error
+                return
+            self.step()
+
+    # ------------------------------------------------------------------
+    # migration / rebalance
+    # ------------------------------------------------------------------
+    def migrate(self, uid: int, to_replica_id: int, *,
+                _rebalance: bool = False) -> Request:
+        """Move one live request between replicas: ``detach`` from its
+        owner (preempt + journal handoff) and ``adopt`` on the target,
+        which must be SERVING. Bitwise-lossless under greedy decoding."""
+        src_id = self._owner.get(uid)
+        if src_id is None:
+            raise ValueError(f"uid {uid} is not owned by this pool")
+        if src_id == to_replica_id:
+            return self._requests[uid]
+        dst = self.replica(to_replica_id)
+        if dst.state != SERVING:
+            raise EngineUsageError(
+                f"cannot migrate uid {uid} onto replica {to_replica_id} "
+                f"in state {dst.state}")
+        src = self.replica(src_id)
+        entry = src.scheduler.detach(uid)
+        try:
+            req = dst.scheduler.adopt(entry)
+        except Exception:
+            # restore ownership — a failed adopt must not strand the
+            # entry outside every journal
+            src.scheduler.adopt(entry)
+            raise
+        self._owner[uid] = to_replica_id
+        self.metrics.observe_migration(rebalance=_rebalance)
+        return req
+
+    def _pick_migratable(self, rep: Replica) -> Optional[int]:
+        """The cheapest request to move off ``rep``: the youngest queued
+        request (nothing resident to recompute), else the live request
+        with the least committed history (smallest replay prefill).
+        Deterministic: ties break on uid."""
+        queued = list(rep.scheduler._queue)
+        if queued:
+            return max(queued, key=lambda r: (r.arrival_time, r.uid)).uid
+        live = list(rep.scheduler._live.values())
+        if live:
+            return min(live, key=lambda r: (len(r.tokens), r.uid)).uid
+        return None
+
+    def rebalance(self, max_moves: int = 1) -> int:
+        """Close load gaps: while the busiest serving replica holds at
+        least 2 more requests than the idlest, migrate one off it.
+        Returns the number of moves made."""
+        moves = 0
+        while moves < max_moves:
+            serving = self._serving()
+            if len(serving) < 2:
+                break
+            hi = max(serving, key=lambda r: (Router.load(r), -r.replica_id))
+            lo = min(serving, key=lambda r: (Router.load(r), r.replica_id))
+            if Router.load(hi) - Router.load(lo) < 2:
+                break
+            uid = self._pick_migratable(hi)
+            if uid is None:
+                break
+            self.migrate(uid, lo.replica_id, _rebalance=True)
+            moves += 1
+        return moves
+
+    # ------------------------------------------------------------------
+    # drain / rolling weight update
+    # ------------------------------------------------------------------
+    def drain(self, replica_id: int) -> int:
+        """Take a replica out of rotation without rejecting anything:
+        mark it DRAINING (the router stops offering it), migrate every
+        request it owns onto survivors via the journal handoff, and
+        return the number moved. Requires at least one other SERVING
+        replica."""
+        rep = self.replica(replica_id)
+        if rep.state != SERVING:
+            raise EngineUsageError(
+                f"replica {replica_id} is {rep.state}, not serving")
+        survivors = self._serving(exclude=rep)
+        if not survivors:
+            raise EngineUsageError(
+                f"cannot drain replica {replica_id}: no other serving "
+                "replica to migrate its requests to")
+        t0 = time.perf_counter()
+        rep.state = DRAINING
+        moved = 0
+        for uid in list(rep.scheduler.journal.uids()):
+            entry = rep.scheduler.detach(uid)
+            target, _ = self.router.place(entry.replay_tokens(), survivors)
+            target.scheduler.adopt(entry)
+            self._owner[uid] = target.replica_id
+            self.metrics.observe_migration()
+            moved += 1
+        self.metrics.observe_drain(time.perf_counter() - t0)
+        if _sanitizer.sanitize_enabled():
+            # drained engine must hold zero sequences / block refs
+            _sanitizer.check_drained(rep.engine)
+        logger.info("pool: replica %d drained (%d request(s) migrated)",
+                    replica_id, moved)
+        return moved
+
+    def undrain(self, replica_id: int) -> None:
+        """Return a DRAINING replica to rotation."""
+        rep = self.replica(replica_id)
+        if rep.state != DRAINING:
+            raise EngineUsageError(
+                f"replica {replica_id} is {rep.state}, not draining")
+        rep.state = SERVING
+
+    def load_weights(self, replica_id: int, params,
+                     version=None) -> None:
+        """Swap a DRAINED replica's weights (same pytree shapes — zero
+        recompilation; the engine flushes its prefix cache so no KV from
+        the old weights survives)."""
+        rep = self.replica(replica_id)
+        if rep.state != DRAINING:
+            raise EngineUsageError(
+                f"load_weights needs replica {replica_id} draining "
+                f"(is {rep.state}) — live KV predates the new weights")
+        rep.engine.load_params(params, version=version)
+        self.metrics.observe_weight_swap()
+
+    def rolling_update(self, params, version=None,
+                       steps_between: int = 0) -> None:
+        """Rolling weight update: one serving replica at a time drains,
+        swaps to ``params``, and rejoins — v_old and v_new serve side by
+        side throughout and no admitted request is rejected.
+        ``steps_between`` pool steps run between replicas to let
+        migrated work make progress before the next drain."""
+        for rid in [r.replica_id for r in self.replicas
+                    if r.state == SERVING]:
+            self.drain(rid)
+            self.load_weights(rid, params, version=version)
+            self.undrain(rid)
+            for _ in range(steps_between):
+                self.step()
+
+    # ------------------------------------------------------------------
+    # replica-death absorption
+    # ------------------------------------------------------------------
+    def _absorb_replica_loss(self, rep: Replica,
+                             exc: BaseException) -> None:
+        """A replica's engine is lost. With survivors: mark it DEAD and
+        replay its journal across them under the pool's
+        :class:`RecoveryPolicy` budget (deadline-expired requests cancel
+        TYPED, exactly like single-engine recovery). Without survivors:
+        delegate to the replica's own in-place rebuild — the tested
+        single-engine path, budgeted by ITS policy."""
+        now = self._clock()
+        sched = rep.scheduler
+        survivors = self._serving(exclude=rep)
+        if not survivors:
+            sched._recover(exc, now)
+            return
+        sched.breaker.on_failure(now)
+        sched.metrics.faults["engine_losses"] += 1
+        if not self.recovery.admit(now, type(exc).__name__):
+            logger.error(
+                "pool: replica %d lost (%s) with the pool absorption "
+                "budget (%d) spent — escalating", rep.replica_id, exc,
+                self.recovery.max_consecutive_rebuilds)
+            raise exc
+        logger.warning(
+            "pool: replica %d lost (%s); %d journaled request(s) replay "
+            "across %d survivor(s)", rep.replica_id, exc,
+            len(sched.journal), len(survivors))
+        rep.state = DEAD
+        replayed = cancelled = 0
+        for uid in list(sched.journal.uids()):
+            # detach is loss-tolerant: preempt/flush on the dead engine
+            # absorb the error (the blocks died with it)
+            entry = sched.detach(uid)
+            req = entry.request
+            if (req is not None and req.deadline is not None
+                    and req.deadline <= now):
+                req.error = RequestFailedError(
+                    uid, f"deadline expired during replica "
+                    f"{rep.replica_id} loss (deadline {req.deadline:.3f} "
+                    f"<= now {now:.3f})")
+                req.state = RequestState.CANCELLED
+                req.cancel_reason = "deadline"
+                req.finish_time = now
+                self._owner.pop(uid, None)
+                cancelled += 1
+                continue
+            target, _ = self.router.place(entry.replay_tokens(),
+                                          survivors)
+            target.scheduler.adopt(entry)
+            self._owner[uid] = target.replica_id
+            replayed += 1
+        # the dead scheduler's residual host state is already empty
+        # (detach swept _all/_queue/_live); clear the recorded loss so a
+        # later explicit revive doesn't trip over it
+        sched._engine_dead = None
+        self.recovery.note_rebuilt(now, replayed, cancelled)
+        self.metrics.observe_death(replayed, cancelled)
+        logger.warning(
+            "pool: replica %d absorbed (#%d pool-wide): %d replaying on "
+            "survivors, %d cancelled past deadline", rep.replica_id,
+            self.recovery.rebuilds, replayed, cancelled)
+
+    def revive(self, replica_id: int) -> None:
+        """Bring a DEAD replica back: rebuild its engine (fresh pools,
+        same compiled programs) and rejoin rotation empty — its former
+        requests stay where absorption placed them."""
+        rep = self.replica(replica_id)
+        if rep.state != DEAD:
+            raise EngineUsageError(
+                f"replica {replica_id} is {rep.state}, not dead")
+        rep.engine.rebuild()
+        rep.scheduler._engine_dead = None
+        rep.scheduler.breaker.rearm_half_open(self._clock())
+        rep.state = SERVING
+
+    # ------------------------------------------------------------------
+    # observability / shutdown
+    # ------------------------------------------------------------------
+    def owner_of(self, uid: int) -> Optional[int]:
+        return self._owner.get(uid)
+
+    def health(self) -> Dict[str, object]:
+        """Pool-level health view: per-replica state, breaker gauge,
+        load, weights version; the pool recovery trail and metrics."""
+        return {
+            "replicas": [{
+                "replica_id": r.replica_id,
+                "state": r.state,
+                "breaker": r.scheduler.breaker.state_gauge,
+                "live": r.scheduler.live_count,
+                "queued": r.scheduler.queue_depth,
+                "rebuilds": r.scheduler.recovery.rebuilds,
+                "weights_version": getattr(r.engine, "weights_version",
+                                           None),
+            } for r in self.replicas],
+            "pool_recovery_trail": list(self.recovery.trail),
+            "pool": self.metrics.summary(),
+        }
+
+    def monitor_events(self, step: int = 0) -> List[Event]:
+        """Pool gauges (``serve/pool/*``) plus every non-dead replica's
+        replica-labelled serve + engine events in one list."""
+        out = self.metrics.events(step)
+        for rep in self.replicas:
+            if rep.state != DEAD:
+                out.extend(rep.scheduler.monitor_events(step))
+        return out
+
+    def close(self) -> None:
+        """Graceful pool drain: stop admissions, cancel never-admitted
+        queued requests, drive every replica to completion through the
+        POOL loop (so a replica death during shutdown still absorbs),
+        then close each scheduler."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self.replicas:
+            if rep.state == DEAD:
+                continue
+            for req in list(rep.scheduler._queue):
+                if req.admitted_time is None:
+                    rep.scheduler.cancel(req.uid, reason="drain")
+        while self.step():
+            pass
+        for rep in self.replicas:
+            if rep.state != DEAD:
+                rep.scheduler.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,57 @@
+"""Prefix-affinity request router for the engine pool (docs/SERVING.md).
+
+One placement decision per submission: which replica should own this
+request? Shared-prompt traffic (system prompts, few-shot headers — the
+dominant production shape, docs/PREFIX_CACHING.md) is only cheap when it
+lands where its KV blocks already live, so the router scores every
+serving replica by **exact prefix affinity**: the replica's engine walks
+its chained content index over the prompt's leading full blocks
+(``InferenceEngineV2.prefix_probe`` — read-only, no refcount or LRU
+perturbation) and reports how many it holds. Highest hit count wins;
+zero-hit placements (and ``affinity=False``, the A/B baseline) fall back
+to **least-loaded** (live + queued requests); remaining ties break on the
+lowest replica id.
+
+Determinism (DSTPU005): the decision is a pure function of the replicas'
+current state and the candidate prompt — no wall clock, no RNG, no set
+iteration. The caller passes replicas in id order and the tie-break is
+total, so the same pool state always places the same request on the same
+replica; a replayed trace routes identically.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+
+class Router:
+    """Placement policy over a list of replica handles.
+
+    A *replica handle* is duck-typed: ``replica_id`` (int, unique),
+    ``scheduler`` (exposes ``live_count`` / ``queue_depth``) and
+    ``engine`` (exposes ``prefix_probe``). ``affinity=False`` disables
+    the prefix score entirely — pure least-loaded, the bench's A/B
+    baseline."""
+
+    def __init__(self, *, affinity: bool = True):
+        self.affinity = affinity
+
+    @staticmethod
+    def load(replica) -> int:
+        """A replica's placement load: requests it owns that are not yet
+        terminal — live members plus its queue."""
+        return replica.scheduler.live_count + replica.scheduler.queue_depth
+
+    def place(self, prompt: Sequence[int], replicas: List[object],
+              ) -> Tuple[Optional[object], int]:
+        """Pick the owner for ``prompt`` among ``replicas`` (id order).
+        Returns ``(replica, hit_blocks)`` — ``hit_blocks`` is the winning
+        affinity score (0 on a least-loaded fallback) — or ``(None, 0)``
+        when no replica is offered."""
+        best = None
+        best_key: Optional[Tuple[int, int, int]] = None
+        best_hits = 0
+        for rep in replicas:
+            hits = rep.engine.prefix_probe(prompt) if self.affinity else 0
+            key = (-hits, self.load(rep), rep.replica_id)
+            if best_key is None or key < best_key:
+                best, best_key, best_hits = rep, key, hits
+        return best, best_hits

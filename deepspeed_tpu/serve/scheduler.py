@@ -148,8 +148,17 @@ class ContinuousBatchScheduler:
                  chunked_prefill: Optional[bool] = None,
                  proposer: Optional[DraftProposer] = None,
                  journal: Optional[RequestJournal] = None,
-                 recovery: Optional[RecoveryPolicy] = None):
+                 recovery: Optional[RecoveryPolicy] = None,
+                 replica_id: Optional[int] = None,
+                 escalate_losses: bool = False):
         self.engine = engine
+        #: pool membership (docs/SERVING.md engine pool): ``replica_id``
+        #: labels this scheduler's metrics/events so N replicas never alias
+        #: in one monitor stream; ``escalate_losses`` re-raises engine
+        #: losses out of :meth:`step` instead of recovering in place — the
+        #: pool routes them to cross-replica replay when survivors exist
+        self.replica_id = replica_id
+        self.escalate_losses = escalate_losses
         # chunked interleaved prefill (docs/SERVING.md): the default for
         # paged engines — admission registers the prompt, its chunks ride
         # the per-step mixed dispatch. False = monolithic drain at _start
@@ -211,7 +220,9 @@ class ContinuousBatchScheduler:
         self.retry = retry or RetryPolicy()
         self.breaker = breaker or CircuitBreaker()
         self.watchdog = watchdog or StepWatchdog()
-        self.journal = journal or RequestJournal()
+        # explicit None check: an EMPTY journal is falsy (__len__ == 0), and
+        # `journal or ...` would silently discard a caller's durable journal
+        self.journal = RequestJournal() if journal is None else journal
         self.recovery = recovery or RecoveryPolicy()
         #: an engine loss observed on a teardown path (flush/preempt inside
         #: cancel/finish) — recorded, not raised: the dead engine's pool is
@@ -219,7 +230,7 @@ class ContinuousBatchScheduler:
         #: and the NEXT step() runs recovery before touching the engine
         self._engine_dead: Optional[BaseException] = None
         self._sleep = sleep
-        self.metrics = ServeMetrics()
+        self.metrics = ServeMetrics(replica_id=replica_id)
         self._queue: Deque[Request] = deque()
         self._live: Dict[int, Request] = {}
         self._all: Dict[int, Request] = {}
@@ -292,6 +303,79 @@ class ContinuousBatchScheduler:
         if self.spec is not None:
             self.spec.forget(uid)
         return True
+
+    # ------------------------------------------------------------------
+    # migration seam (docs/SERVING.md engine pool)
+    # ------------------------------------------------------------------
+    def detach(self, uid: int):
+        """Hand a non-terminal request off this scheduler: preempt it out
+        of the engine (blocks freed; a dead or rebuilt engine makes this a
+        no-op — flush/preempt are idempotent), remove every host-side
+        reference, and return its :class:`JournalEntry` with the live
+        ``Request`` object attached. The entry is the migration token:
+        :meth:`adopt` on another scheduler re-admits it through the normal
+        ``put`` path, and greedy decoding makes the continuation bitwise
+        identical to a never-migrated run (the same preemption round-trip
+        guarantee engine-loss recovery rides). Raises ``ValueError`` for
+        unknown/finished uids — detach is a control-plane call, never a
+        race."""
+        req = self._all.get(uid)
+        if req is None or req.finished:
+            raise ValueError(f"uid {uid} is not live on this scheduler")
+        if req in self._queue:
+            self._queue.remove(req)
+        if uid in self._live:
+            self._engine_preempt(uid)  # absorbs an engine loss (recorded)
+            self._live.pop(uid, None)
+        if req.state in (RequestState.PREFILL, RequestState.DECODE):
+            # the legal eviction edge; the adopting side walks
+            # PREEMPTED -> QUEUED (QUEUED/PREEMPTED requests ride as-is)
+            req.state = RequestState.PREEMPTED
+            req.preemptions += 1
+        self._all.pop(uid, None)
+        if self.spec is not None:
+            self.spec.forget(uid)
+        entry = self.journal.detach(uid)
+        entry.request = req
+        self.metrics.detaches += 1
+        return entry
+
+    def adopt(self, entry) -> Request:
+        """Take ownership of a detached :class:`JournalEntry`: journal it
+        here (committed-token record preserved byte for byte), walk the
+        request onto the queue, and let normal admission replay
+        ``prompt + committed tokens`` through ``put``. The SAME ``Request``
+        object keeps serving when the entry carries one (streams survive
+        the move); a bare entry — e.g. replayed from a durable journal
+        after a host crash — reconstructs the request from the serialized
+        fields."""
+        if self._closed:
+            raise SchedulerClosedError(
+                "cannot adopt into a closed scheduler")
+        req = getattr(entry, "request", None)
+        if req is None:
+            req = Request(prompt=list(entry.prompt),
+                          max_new_tokens=entry.max_new_tokens,
+                          priority=entry.priority, deadline=entry.deadline,
+                          arrival_time=entry.arrival_time,
+                          eos_token=entry.eos_token, uid=entry.uid)
+            req.tokens = list(entry.tokens)
+            entry.request = req
+        if req.uid in self._all and not self._all[req.uid].finished:
+            raise ValueError(f"uid {req.uid} is already in flight here")
+        if (len(req.prompt) + req.max_new_tokens
+                > self.engine.max_seq_len):
+            raise ValueError(
+                f"uid {req.uid}: prompt({len(req.prompt)}) + "
+                f"max_new_tokens({req.max_new_tokens}) exceeds this "
+                f"engine's context {self.engine.max_seq_len}")
+        if req.state is RequestState.PREEMPTED:
+            req.state = RequestState.QUEUED
+        self._all[req.uid] = req
+        self._queue.append(req)
+        self.journal.adopt(entry)
+        self.metrics.adopts += 1
+        return req
 
     # ------------------------------------------------------------------
     # fault handling primitives (docs/RESILIENCE.md)
@@ -1015,6 +1099,8 @@ class ContinuousBatchScheduler:
         now = self._clock()
         if self._engine_dead is not None:
             exc, self._engine_dead = self._engine_dead, None
+            if self.escalate_losses:
+                raise exc
             self._recover(exc, now)
             now = self._clock()
         self.breaker.poll(now)
@@ -1025,6 +1111,12 @@ class ContinuousBatchScheduler:
                 self._absorb(self._engine_put([], []), now)
             self._decode_once(now)
         except UnrecoverableEngineError as e:
+            if self.escalate_losses:
+                # pool mode (docs/SERVING.md): the loss is the POOL's to
+                # absorb — survivors adopt this replica's journal instead
+                # of an in-place rebuild. Host state is left intact for
+                # the pool's detach sweep.
+                raise
             self._recover(e, now)
         self.metrics.observe_gauges(len(self._queue), len(self._live))
         self.metrics.observe_prefill_backlog(self._prefill_backlog())
@@ -1128,5 +1220,13 @@ class ContinuousBatchScheduler:
     def monitor_events(self, step: int = 0) -> List[Event]:
         """Serving counters (``serve/*`` and ``serve/faults/*``) plus the
         engine's prefix-cache counters as one event list for
-        ``MonitorMaster.write_events``."""
-        return self.metrics.events(step) + self.engine.monitor_events(step)
+        ``MonitorMaster.write_events``. With a ``replica_id`` the engine's
+        events are replica-prefixed too (``replica<id>/inference/...``):
+        the engine doesn't know its pool membership, and N unlabeled
+        prefix-cache series would alias exactly like the serve counters
+        the ``ServeMetrics`` label fixes."""
+        eng = self.engine.monitor_events(step)
+        if self.replica_id is not None:
+            eng = [(f"replica{self.replica_id}/{label}", v, s)
+                   for label, v, s in eng]
+        return self.metrics.events(step) + eng

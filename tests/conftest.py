@@ -146,6 +146,8 @@ _SANITIZE_FILES = (
     "test_recovery_soak.py",
     "test_train_resilience.py",
     "test_train_chaos_soak.py",
+    "test_pool.py",
+    "test_journal_durability.py",
 )
 
 

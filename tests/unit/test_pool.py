@@ -1,0 +1,836 @@
+"""Engine-pool tests (docs/SERVING.md "Engine pool"): prefix-affinity
+placement vs the least-loaded baseline, live migration (detach/adopt)
+bitwise vs a never-migrated twin at every lifecycle edge (mid-prefill,
+mid-decode, mid-speculation), rebalancing, the cross-replica ownership
+sanitizer (double adopt, orphans, owner-map drift), replica-death
+absorption across survivors bitwise vs a fault-free reference, rolling
+weight updates serving v1/v2 side by side without rejecting a request,
+and the replica-labelled metrics surface."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_pool_ownership)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience import (FaultInjector, FaultSpec,
+                                      RecoveryPolicy, RequestFailedError,
+                                      RetryPolicy, UnrecoverableEngineError)
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                 PromptLookupProposer, Request, RequestState,
+                                 Router, SchedulerClosedError)
+from deepspeed_tpu.serve.metrics import PoolMetrics
+from deepspeed_tpu.serve.pool import DEAD, DRAINING, SERVING
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _workload(seed=17, n=6, lo=8, hi=25, gen=6):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 128, int(rng.integers(lo, hi))).tolist()
+               for _ in range(n)]
+    uids = [9000 + i for i in range(n)]
+    return prompts, uids, gen
+
+
+_REF_MEMO = {}
+
+
+def _reference(m, params, prompts, uids, gen, **eng_kw):
+    """Fault-free single-engine run — the bitwise oracle (greedy decoding
+    makes placement/migration invisible in the tokens). Memoized per
+    workload: several tests share a workload and the oracle is pure."""
+    key = (tuple(map(tuple, prompts)), tuple(uids), gen,
+           tuple(sorted(eng_kw.items())))
+    if key in _REF_MEMO:
+        return _REF_MEMO[key]
+    sched = ContinuousBatchScheduler(
+        _engine(m, params, **eng_kw), retry=RetryPolicy(max_attempts=5),
+        sleep=lambda s: None)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=u)
+            for p, u in zip(prompts, uids)]
+    sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    _REF_MEMO[key] = {r.uid: list(r.tokens) for r in reqs}
+    return _REF_MEMO[key]
+
+
+def _pool(m, params, n, *, specs_for=None, eng_kw=None, router=None,
+          recovery=None, clock=None, **sched_kw):
+    """Build an n-replica pool; ``specs_for`` maps replica_id -> fault
+    specs (that replica's engine is injector-wrapped). Returns
+    (pool, raw_engines, injectors)."""
+    engines, injectors = {}, {}
+
+    def factory(i):
+        eng = _engine(m, params, **(eng_kw or {}))
+        engines[i] = eng
+        if specs_for and i in specs_for:
+            injectors[i] = FaultInjector(specs_for[i])
+            return injectors[i].wrap(eng)
+        return eng
+
+    sched_kw.setdefault("retry", RetryPolicy(max_attempts=5))
+    sched_kw.setdefault("sleep", lambda s: None)
+    kw = {} if clock is None else {"clock": clock}
+    pool = EnginePool.build(factory, n, router=router, recovery=recovery,
+                            **kw, **sched_kw)
+    return pool, engines, injectors
+
+
+def _assert_bounds(eng):
+    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+
+
+def _views(pool):
+    return [(r.replica_id, r.scheduler.journal, r.scheduler._all)
+            for r in pool.replicas if r.state != DEAD]
+
+
+# ---------------------------------------------------------------------------
+# router policy (pure: the router duck-types its replica handles)
+# ---------------------------------------------------------------------------
+
+class _StubSched:
+    def __init__(self, live=0, queued=0):
+        self.live_count = live
+        self.queue_depth = queued
+
+
+class _StubReplica:
+    """Duck-typed router handle (the protocol router.py documents):
+    ``replica_id``, ``scheduler`` with load counters, ``engine`` with
+    ``prefix_probe``. Lets the scoring rules be tested without engines."""
+
+    def __init__(self, rid, live=0, queued=0, hits=0):
+        self.replica_id = rid
+        self.scheduler = _StubSched(live, queued)
+        self._hits = hits
+        self.engine = self
+
+    def prefix_probe(self, prompt):
+        return self._hits
+
+
+class TestRouterPolicy:
+    def test_no_candidates_places_nowhere(self):
+        assert Router().place([1, 2, 3], []) == (None, 0)
+
+    def test_load_counts_live_plus_queued(self):
+        assert Router.load(_StubReplica(0, live=2, queued=3)) == 5
+
+    def test_tie_breaks_on_lowest_replica_id(self):
+        rep, hits = Router().place([1], [_StubReplica(1), _StubReplica(0)])
+        assert rep.replica_id == 0 and hits == 0
+
+    def test_least_loaded_wins_without_hits(self):
+        reps = [_StubReplica(0, live=3), _StubReplica(1, live=1)]
+        rep, _ = Router().place([1], reps)
+        assert rep.replica_id == 1
+
+    def test_affinity_outranks_load(self):
+        reps = [_StubReplica(0, live=5, hits=2), _StubReplica(1)]
+        rep, hits = Router().place([1], reps)
+        assert rep.replica_id == 0 and hits == 2
+
+    def test_higher_hit_count_wins(self):
+        reps = [_StubReplica(0, hits=1), _StubReplica(1, hits=3)]
+        rep, hits = Router().place([1], reps)
+        assert rep.replica_id == 1 and hits == 3
+
+    def test_affinity_off_never_probes(self):
+        # the A/B baseline: a cached replica loses to a less-loaded one
+        reps = [_StubReplica(0, live=5, hits=9), _StubReplica(1)]
+        rep, hits = Router(affinity=False).place([1], reps)
+        assert rep.replica_id == 1 and hits == 0
+
+
+class TestPoolMetricsCounters:
+    def test_placement_hit_accounting(self):
+        pm = PoolMetrics()
+        pm.observe_placement(0)
+        pm.observe_placement(3)
+        assert pm.pool["placements"] == 2
+        assert pm.pool["placement_hits"] == 1
+        assert pm.pool["affinity_blocks"] == 3
+
+    def test_rebalance_counts_as_migration_too(self):
+        pm = PoolMetrics()
+        pm.observe_migration()
+        pm.observe_migration(rebalance=True)
+        assert pm.pool["migrations"] == 2
+        assert pm.pool["rebalances"] == 1
+
+    def test_imbalance_gauge(self):
+        pm = PoolMetrics()
+        pm.observe_gauges([4, 1, 2], serving=2, draining=1, dead=0)
+        assert pm.pool["imbalance"] == 3.0
+        assert pm.pool["replicas_serving"] == 2.0
+        pm.observe_gauges([], serving=0, draining=0, dead=3)
+        assert pm.pool["imbalance"] == 0.0
+        assert pm.pool["replicas_dead"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# control-plane validation (real pools, no engine steps)
+# ---------------------------------------------------------------------------
+
+class TestControlPlaneValidation:
+    def test_empty_pool_rejected(self):
+        with pytest.raises(ValueError, match="at least one"):
+            EnginePool([])
+
+    def test_duplicate_replica_ids_rejected(self, setup):
+        m, params = setup
+        scheds = [ContinuousBatchScheduler(_engine(m, params), replica_id=0,
+                                           sleep=lambda s: None)
+                  for _ in range(2)]
+        with pytest.raises(ValueError, match="duplicate replica ids"):
+            EnginePool(scheds)
+
+    def test_unknown_replica_lookup_rejected(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        with pytest.raises(ValueError, match="no replica 7"):
+            pool.replica(7)
+        pool.close()
+
+    def test_migrate_unknown_uid_rejected(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        with pytest.raises(ValueError, match="not owned"):
+            pool.migrate(12345, 1)
+        pool.close()
+
+    def test_migrate_to_current_owner_is_noop(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit([1, 2, 3], max_new_tokens=2, uid=9960)
+        assert pool.migrate(req.uid, pool.owner_of(req.uid)) is req
+        assert pool.metrics.pool["migrations"] == 0
+        pool.close()
+
+    def test_rebalance_balanced_pool_is_noop(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        assert pool.rebalance(max_moves=4) == 0
+        pool.close()
+
+    def test_undrain_serving_replica_rejected(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        with pytest.raises(EngineUsageError, match="not draining"):
+            pool.undrain(0)
+        pool.close()
+
+    def test_revive_serving_replica_rejected(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        with pytest.raises(EngineUsageError, match="not dead"):
+            pool.revive(0)
+        pool.close()
+
+    def test_fresh_pool_health_and_gauges(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 3)
+        h = pool.health()
+        assert [r["state"] for r in h["replicas"]] == [SERVING] * 3
+        assert all(r["live"] == 0 and r["queued"] == 0
+                   for r in h["replicas"])
+        assert h["pool"]["placements"] == 0
+        assert h["pool_recovery_trail"] == []
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_least_loaded_fallback_spreads(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        prompts, uids, gen = _workload(n=4, gen=3)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        # cold caches: zero affinity everywhere, so pure least-loaded —
+        # submissions alternate 0,1,0,1
+        assert [pool.owner_of(r.uid) for r in reqs] == [0, 1, 0, 1]
+        pool.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert pool.metrics.pool["placements"] == 4
+        assert pool.metrics.pool["placement_hits"] == 0
+        pool.close()
+
+    def test_affinity_routes_to_cached_replica(self, setup):
+        """A prompt whose full-block prefix is cached on a replica lands
+        there even when that replica is the more loaded one."""
+        m, params = setup
+        pool, engines, _ = _pool(m, params, 2)
+        rng = np.random.default_rng(5)
+        shared = rng.integers(0, 128, 32).tolist()   # two full blocks
+        first = pool.submit(shared + [1, 2, 3], max_new_tokens=4, uid=9301)
+        assert pool.owner_of(9301) == 0             # tie-break: lowest id
+        pool.run_until_complete()                    # replica 0 caches prefix
+        assert engines[0].prefix_probe(shared) == 2
+        follow = pool.submit(shared + [9, 9, 9, 9], max_new_tokens=4,
+                             uid=9302)
+        assert pool.owner_of(9302) == 0             # affinity, not load
+        assert pool.metrics.pool["placement_hits"] == 1
+        assert pool.metrics.pool["affinity_blocks"] == 2
+        pool.run_until_complete()
+        assert first.state is follow.state is RequestState.DONE
+        pool.close()
+
+    @pytest.mark.slow
+    def test_affinity_beats_least_loaded_on_hit_rate(self, setup):
+        """The A/B the bench rides: a shared-prefix wave lands where its
+        KV lives under affinity, and the pool-wide prefix-cache hit
+        blocks strictly beat the affinity=False baseline."""
+        m, params = setup
+        rng = np.random.default_rng(11)
+        shared = rng.integers(0, 128, 32).tolist()   # two full blocks
+        tails = [rng.integers(0, 128, 6).tolist() for _ in range(4)]
+
+        def run(affinity):
+            pool, engines, _ = _pool(m, params, 2,
+                                     router=Router(affinity=affinity))
+            warm = pool.submit(shared + [7], max_new_tokens=2, uid=9400)
+            pool.run_until_complete()
+            reqs = [pool.submit(shared + t, max_new_tokens=2, uid=9401 + i)
+                    for i, t in enumerate(tails)]
+            pool.run_until_complete()
+            assert warm.state is RequestState.DONE
+            assert all(r.state is RequestState.DONE for r in reqs)
+            hits = sum(e.block_mgr.stats["hit_blocks"]
+                       for e in engines.values())
+            pool.close()
+            return hits, pool.metrics.pool["placement_hits"]
+
+        hits_on, placed_on = run(True)
+        hits_off, placed_off = run(False)
+        assert placed_on == 4 and placed_off == 0
+        assert hits_on > hits_off
+
+    def test_full_replicas_fall_through_then_reject(self, setup):
+        from deepspeed_tpu.serve import QueueFullError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2, max_queue=1)
+        pool.submit([1, 2, 3], max_new_tokens=2, uid=9450)
+        pool.submit([4, 5, 6], max_new_tokens=2, uid=9451)
+        with pytest.raises(QueueFullError):
+            pool.submit([7, 8, 9], max_new_tokens=2, uid=9452)
+        pool.run_until_complete()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# migration
+# ---------------------------------------------------------------------------
+
+class TestMigration:
+    @pytest.mark.parametrize("steps", [1, 4])
+    def test_migration_bitwise_vs_never_migrated(self, setup, steps):
+        """Mid-prefill (1 step: chunked prefill still feeding) and
+        mid-decode (4 steps: committed tokens exist) migration — the
+        moved request finishes bitwise identical to the reference."""
+        m, params = setup
+        prompts, uids, gen = _workload(n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _pool(m, params, 2)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        for _ in range(steps):
+            pool.step()
+        victim = reqs[0]
+        assert not victim.finished
+        src = pool.owner_of(victim.uid)
+        dst = 1 - src
+        pool.migrate(victim.uid, dst)
+        assert pool.owner_of(victim.uid) == dst
+        assert victim.uid in pool.replica(dst).scheduler.journal
+        assert victim.uid not in pool.replica(src).scheduler.journal
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["migrations"] == 1
+        pool.close()
+
+    @pytest.mark.slow
+    def test_mid_speculation_migration_bitwise(self, setup):
+        """A speculating request (fused verify in flight over drafted
+        tokens) migrates: only committed tokens ride the journal, and the
+        continuation on the target replica stays bitwise."""
+        m, params = setup
+        prompts, uids, gen = _workload(n=3, gen=8)
+        ref = _reference(m, params, prompts, uids, gen,
+                         decode_horizon=4)
+        scheds = [ContinuousBatchScheduler(
+            _engine(m, params, decode_horizon=4),
+            proposer=PromptLookupProposer(),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+            for _ in range(2)]
+        pool = EnginePool(scheds)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        for _ in range(3):
+            pool.step()
+        victim = next(r for r in reqs if not r.finished)
+        src = pool.owner_of(victim.uid)
+        pool.migrate(victim.uid, 1 - src)
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        for rep in pool.replicas:
+            _assert_bounds(rep.engine)
+        pool.close()
+
+    def test_rebalance_closes_load_gap(self, setup):
+        """All load piled on one replica (submitted while the other
+        drained): rebalance migrates the cheapest requests until the gap
+        closes, and everything still finishes bitwise."""
+        m, params = setup
+        prompts, uids, gen = _workload(n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _pool(m, params, 2)
+        pool.drain(1)           # replica 1 out of rotation (empty: 0 moved)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        assert all(pool.owner_of(u) == 0 for u in uids)
+        pool.undrain(1)
+        moved = pool.rebalance(max_moves=6)
+        r0, r1 = pool.replicas
+        assert moved > 0
+        assert abs(Router.load(r0) - Router.load(r1)) < 2
+        assert pool.metrics.pool["rebalances"] == moved
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        pool.close()
+
+    def test_migrate_to_non_serving_replica_rejected(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit([1, 2, 3, 4], max_new_tokens=4, uid=9500)
+        src = pool.owner_of(req.uid)
+        other = pool.replicas[1 - src]
+        other.state = DRAINING
+        with pytest.raises(EngineUsageError, match="draining"):
+            pool.migrate(req.uid, other.replica_id)
+        # ownership untouched by the refused move
+        assert pool.owner_of(req.uid) == src
+        assert req.uid in pool.replica(src).scheduler.journal
+        other.state = SERVING
+        pool.run_until_complete()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# ownership sanitizer
+# ---------------------------------------------------------------------------
+
+class TestPoolOwnership:
+    def test_double_adopt_across_replicas_detected(self, setup):
+        """The single-owner invariant: an entry adopted by a second
+        replica while the first still journals it is exactly the state
+        the sanitizer must refuse."""
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit([1, 2, 3, 4, 5], max_new_tokens=4, uid=9600)
+        src = pool.owner_of(req.uid)
+        entry = pool.replica(src).scheduler.detach(req.uid)
+        pool.replica(0).scheduler.adopt(entry)
+        # force the illegal state: the same entry journaled on BOTH
+        # replicas (bypassing the pool's migrate, which forbids this)
+        pool.replica(1).scheduler.journal.adopt(entry)
+        with pytest.raises(SanitizerError, match="double adopt"):
+            check_pool_ownership(_views(pool), pool._owner)
+        pool.replica(1).scheduler.journal.detach(req.uid)
+        pool._owner[req.uid] = 0
+        pool.run_until_complete()
+        pool.close()
+
+    def test_orphaned_entry_and_owner_drift_detected(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit([1, 2, 3, 4], max_new_tokens=4, uid=9610)
+        rid = pool.owner_of(req.uid)
+        # owner-map drift: the map says the OTHER replica
+        pool._owner[req.uid] = 1 - rid
+        with pytest.raises(SanitizerError, match="owner map"):
+            check_pool_ownership(_views(pool), pool._owner)
+        pool._owner[req.uid] = rid
+        # orphaned entry: journaled but unknown to the scheduler
+        pool.replica(rid).scheduler._all.pop(req.uid)
+        with pytest.raises(SanitizerError, match="orphaned entry"):
+            check_pool_ownership(_views(pool), pool._owner)
+        pool.replica(rid).scheduler._all[req.uid] = req
+        # orphaned request: live but unjournaled (write-ahead broken)
+        entry = pool.replica(rid).scheduler.journal.detach(req.uid)
+        with pytest.raises(SanitizerError, match="unreplayable"):
+            check_pool_ownership(_views(pool), pool._owner)
+        pool.replica(rid).scheduler.journal.adopt(entry)
+        check_pool_ownership(_views(pool), pool._owner)  # green again
+        pool.run_until_complete()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# replica death
+# ---------------------------------------------------------------------------
+
+class TestReplicaDeath:
+    def test_death_replays_across_two_survivors_bitwise(self, setup):
+        """The acceptance core: a replica dies mid-load in a 3-replica
+        pool; its journal replays across BOTH survivors and every request
+        completes bitwise identical to the fault-free single-engine
+        reference. Survivors' compiled-program bounds hold."""
+        m, params = setup
+        prompts, uids, gen = _workload(n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, engines, injectors = _pool(
+            m, params, 3,
+            specs_for={0: [FaultSpec(site="put", kind="device_lost",
+                                     nth=2)]})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert injectors[0].deaths == 1
+        assert pool.replica(0).state == DEAD
+        assert [pool.replica(i).state for i in (1, 2)] == [SERVING] * 2
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["replica_deaths"] == 1
+        assert pool.metrics.pool["death_replays"] == 2
+        assert pool.metrics.pool["death_cancelled"] == 0
+        events = [ev for _, ev in pool.recovery.trail]
+        assert any(ev.startswith("engine_lost:DeviceLostError")
+                   for ev in events)
+        assert any(ev.startswith("rebuilt:") for ev in events)
+        for i in (1, 2):
+            _assert_bounds(engines[i])
+        # the dead replica's journal is empty — everything transferred
+        assert len(pool.replica(0).scheduler.journal) == 0
+        pool.close()
+
+    def test_death_without_survivors_recovers_in_place(self, setup):
+        """A 1-replica pool degrades to the single-engine path: the
+        replica rebuilds itself under ITS recovery budget and stays
+        SERVING; the pool's absorption budget is untouched."""
+        m, params = setup
+        prompts, uids, gen = _workload(n=3, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, engines, injectors = _pool(
+            m, params, 1,
+            specs_for={0: [FaultSpec(site="put", kind="device_lost",
+                                     nth=2)]})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert injectors[0].deaths == 1 and injectors[0].revivals == 1
+        assert pool.replica(0).state == SERVING
+        assert engines[0].rebuilds == 1
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.recovery.trail == []
+        assert pool.replica(0).scheduler.recovery.rebuilds == 1
+        pool.close()
+
+    def test_death_budget_exhausted_escalates(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(
+            m, params, 2,
+            recovery=RecoveryPolicy(max_consecutive_rebuilds=0),
+            specs_for={0: [FaultSpec(site="put", kind="device_lost",
+                                     nth=1)]})
+        pool.submit([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4, uid=9700)
+        with pytest.raises(UnrecoverableEngineError):
+            pool.run_until_complete()
+
+    def test_deadline_expired_during_death_cancelled_typed(self, setup):
+        """A request whose deadline passes between its replica's last
+        deadline sweep and the pool's absorption (the engine-down window)
+        is cancelled TYPED during absorption (RequestFailedError on the
+        request), not replayed onto a survivor."""
+        from deepspeed_tpu.resilience import DeviceLostError
+
+        m, params = setup
+        t = [0.0]
+        pool, _, _ = _pool(m, params, 2, clock=lambda: t[0])
+        pool.drain(1)    # both requests must land on the doomed replica
+        doomed = pool.submit([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4,
+                             uid=9710, deadline=5.0)
+        safe = pool.submit([9, 8, 7, 6, 5, 4, 3, 2], max_new_tokens=4,
+                           uid=9711)
+        pool.undrain(1)
+        pool.step()      # both admitted at t=0, well inside the deadline
+        # the replica dies; by the time the pool observes the loss the
+        # clock has passed doomed's deadline — the window the replica's
+        # own sweep can never see (its engine is already gone)
+        t[0] = 10.0
+        pool._absorb_replica_loss(pool.replica(0),
+                                  DeviceLostError("simulated loss"))
+        assert pool.replica(0).state == DEAD
+        assert doomed.state is RequestState.CANCELLED
+        assert doomed.cancel_reason == "deadline"
+        assert isinstance(doomed.error, RequestFailedError)
+        assert pool.owner_of(9711) == 1
+        pool.run_until_complete()
+        assert safe.state is RequestState.DONE
+        assert pool.metrics.pool["death_cancelled"] == 1
+        assert pool.metrics.pool["death_replays"] == 1
+        pool.close()
+
+    def test_revive_rejoins_empty_and_serves(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(n=4, gen=3)
+        pool, _, _ = _pool(
+            m, params, 2,
+            specs_for={0: [FaultSpec(site="put", kind="device_lost",
+                                     nth=1)]})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert pool.replica(0).state == DEAD
+        pool.revive(0)
+        assert pool.replica(0).state == SERVING
+        late = pool.submit([5, 5, 5, 5, 5], max_new_tokens=3, uid=9800)
+        # the revived replica is empty — least-loaded sends work back
+        assert pool.owner_of(9800) == 0
+        pool.run_until_complete()
+        assert late.state is RequestState.DONE
+        assert all(r.state is RequestState.DONE for r in reqs)
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# drain / rolling weight update
+# ---------------------------------------------------------------------------
+
+class TestRollingUpdate:
+    def test_drain_migrates_all_and_rejoins(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, engines, _ = _pool(m, params, 2)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        for _ in range(2):
+            pool.step()
+        moved = pool.drain(0)
+        assert moved == 2                      # its two requests moved out
+        assert pool.replica(0).state == DRAINING
+        assert all(pool.owner_of(u) == 1 for u in uids)
+        assert len(pool.replica(0).scheduler.journal) == 0
+        pool.undrain(0)
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["drains"] == 1
+        assert pool.metrics.pool["drain_duration_s"] > 0
+        pool.close()
+
+    def test_drain_last_serving_replica_rejected(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.drain(0)
+        with pytest.raises(EngineUsageError, match="no other serving"):
+            pool.drain(1)
+        pool.undrain(0)
+        pool.close()
+
+    def test_load_weights_requires_drained_replica(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        with pytest.raises(EngineUsageError, match="draining"):
+            pool.load_weights(0, None, version="v2")
+        pool.close()
+
+    def test_rolling_update_v1_v2_side_by_side(self, setup):
+        """The rolling-update acceptance: with live traffic and per-request
+        deadlines, replicas swap to v2 one at a time — v1 and v2 serve
+        side by side mid-update, no admitted request is rejected or
+        deadline-cancelled, and every request completes."""
+        m, params = setup
+        params2 = m.init_params(jax.random.PRNGKey(1))
+        prompts, uids, gen = _workload(n=4, gen=5)
+        pool, engines, _ = _pool(m, params, 2)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u, deadline=1e9)
+                for p, u in zip(prompts, uids)]
+        for _ in range(2):
+            pool.step()
+        # replica 0 drains (its requests migrate, none rejected), swaps,
+        # rejoins — v2 next to replica 1's v1
+        pool.drain(0)
+        pool.load_weights(0, params2, version="v2")
+        pool.undrain(0)
+        assert engines[0].weights_version == "v2"
+        assert engines[1].weights_version is None      # v1 still serving
+        for _ in range(2):
+            pool.step()                                # side-by-side window
+        pool.drain(1)
+        pool.load_weights(1, params2, version="v2")
+        pool.undrain(1)
+        assert all(e.weights_version == "v2" for e in engines.values())
+        pool.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        for rep in pool.replicas:
+            ms = rep.scheduler.metrics
+            assert ms.admission_rejects == 0
+            assert ms.deadline_cancels == 0
+        assert pool.metrics.pool["weight_swaps"] == 2
+        assert pool.metrics.pool["drains"] == 2
+        pool.close()
+
+    def test_rolling_update_convenience_wrapper(self, setup):
+        m, params = setup
+        params2 = m.init_params(jax.random.PRNGKey(2))
+        prompts, uids, gen = _workload(n=4, gen=4)
+        pool, engines, _ = _pool(m, params, 2)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.rolling_update(params2, version="v2", steps_between=2)
+        assert all(e.weights_version == "v2" for e in engines.values())
+        pool.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        pool.close()
+
+    def test_load_params_flushes_stale_prefix_cache(self, setup):
+        """Direct engine contract: a weight swap must drop the prefix
+        content index — its KV was computed under the old weights and
+        serving it to post-swap prompts would mix versions."""
+        m, params = setup
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng, sleep=lambda s: None)
+        prompt = list(range(40))                     # two full blocks
+        sched.submit(prompt, max_new_tokens=2, uid=9900)
+        sched.run_until_complete()
+        assert eng.prefix_probe(prompt) == 2
+        eng.load_params(m.init_params(jax.random.PRNGKey(3)), version="v2")
+        assert eng.prefix_probe(prompt) == 0
+        sched.close()
+
+    def test_load_params_rejects_resident_sequences(self, setup):
+        from deepspeed_tpu.resilience import EngineUsageError
+
+        m, params = setup
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng, sleep=lambda s: None)
+        sched.submit(list(range(20)), max_new_tokens=6, uid=9910)
+        for _ in range(3):
+            sched.step()
+        with pytest.raises(EngineUsageError, match="drain"):
+            eng.load_params(params)
+        sched.run_until_complete()
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# observability / shutdown
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_replica_labels_do_not_alias(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        prompts, uids, gen = _workload(n=2, gen=3)
+        for p, u in zip(prompts, uids):
+            pool.submit(p, max_new_tokens=gen, uid=u)
+        pool.run_until_complete()
+        labels = [lab for lab, _, _ in pool.monitor_events(7)]
+        assert any(lab == "serve/replica0/submitted" for lab in labels)
+        assert any(lab == "serve/replica1/submitted" for lab in labels)
+        assert any(lab.startswith("serve/pool/") for lab in labels)
+        assert any(lab.startswith("replica0/inference/") for lab in labels)
+        # no unlabelled serve counters leak into the pool stream
+        assert not any(lab.startswith("serve/")
+                       and not lab.startswith(("serve/replica",
+                                               "serve/pool/"))
+                       for lab in labels)
+        assert len(labels) == len(set(labels)), "aliased event labels"
+        pool.close()
+
+    def test_unlabelled_scheduler_keeps_historical_labels(self, setup):
+        """Outside a pool nothing changes: a bare scheduler's metrics
+        stream is byte-identical to the pre-pool label scheme."""
+        m, params = setup
+        sched = ContinuousBatchScheduler(_engine(m, params),
+                                         sleep=lambda s: None)
+        sched.submit([1, 2, 3, 4], max_new_tokens=2, uid=9920)
+        sched.run_until_complete()
+        labels = [lab for lab, _, _ in sched.monitor_events(1)]
+        assert any(lab == "serve/submitted" for lab in labels)
+        assert not any("replica" in lab for lab in labels)
+        sched.close()
+
+    def test_health_view(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.submit([1, 2, 3, 4, 5], max_new_tokens=3, uid=9930)
+        pool.step()
+        h = pool.health()
+        assert [r["replica_id"] for r in h["replicas"]] == [0, 1]
+        assert all(r["state"] == SERVING for r in h["replicas"])
+        assert all(isinstance(r["breaker"], float) for r in h["replicas"])
+        assert h["pool"]["placements"] == 1
+        pool.run_until_complete()
+        pool.close()
+
+    @pytest.mark.slow
+    def test_stream_follows_migration(self, setup):
+        """A streaming consumer keeps receiving tokens across a
+        mid-stream migration — same Request object rides the journal."""
+        m, params = setup
+        prompt = list(range(12))
+        sched = ContinuousBatchScheduler(_engine(m, params),
+                                         sleep=lambda s: None)
+        ref = list(sched.stream(sched.submit(prompt, max_new_tokens=5,
+                                             uid=9940)))
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit(prompt, max_new_tokens=5, uid=9940)
+        got = []
+        for i, tok in enumerate(pool.stream(req)):
+            got.append(tok)
+            if i == 2:
+                pool.migrate(req.uid, 1 - pool.owner_of(req.uid))
+        assert got == ref and len(got) == 5
+        pool.close()
+
+    def test_close_rejects_new_and_drains(self, setup):
+        m, params = setup
+        pool, engines, _ = _pool(m, params, 2)
+        prompts, uids, gen = _workload(n=4, gen=3)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.close()
+        assert all(r.finished for r in reqs)
+        with pytest.raises(SchedulerClosedError):
+            pool.submit([1, 2, 3], max_new_tokens=2, uid=9950)
+        for eng in engines.values():
+            assert not eng.state.seqs
