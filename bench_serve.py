@@ -46,6 +46,14 @@ N ∈ {1, 2, 4} data-parallel replicas behind the prefix-affinity router,
 with an affinity-off baseline, a seeded replica kill mid-load (journal
 replay across the survivor, bitwise vs the fault-free reference), and
 compiled-program bounds held on every surviving engine.
+
+The ``kv_tier`` row (``--kv-tier``) is the two-tier KV cache's acceptance
+A/B (docs/PREFIX_CACHING.md "Two-tier cache"): the same overcommitted
+shared-prefix workload with the host-RAM spill tier on vs off at the same
+device pool size — LRU demotion/promotion plus swap-based preemption vs
+destroy-and-replay — tokens bitwise-asserted, reporting both arms'
+tokens/s, the swap/recompute preemption split, swap re-admission p50/p95
+and promotion traffic.
 """
 
 import json
@@ -67,7 +75,8 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              shared_prefix=None, priorities=None, fault_injector=None,
              breaker=None, retry=None, watchdog=None, on_submitted=None,
              collect_tokens=False, prompts=None, arrivals=None,
-             gen_targets=None, chunked_prefill=None, proposer=None):
+             gen_targets=None, chunked_prefill=None, proposer=None,
+             swap_preemption=None):
     """Drive the engine with Poisson arrivals until all requests finish —
     through ``ContinuousBatchScheduler``, so the bench exercises the
     production admit/preempt/decode path (docs/SERVING.md), not a private
@@ -90,7 +99,10 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     scheduler (None = its paged-mode default). ``proposer`` (a
     ``DraftProposer``/``SpecPolicy``) turns on speculative decoding — the
     engine must be compiled with ``decode_horizon > 1``; the ``serve/spec``
-    counters are reported under ``"spec"``.
+    counters are reported under ``"spec"``. ``swap_preemption`` forwards to
+    the scheduler (None = the auto swap-vs-recompute cost model); on a
+    host-tiered engine the ``serve/kvtier`` counters and swap re-admission
+    percentiles are reported under ``"kvtier"``.
     """
     import jax
 
@@ -121,7 +133,8 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     kw = {k: v for k, v in (("breaker", breaker), ("retry", retry),
                             ("watchdog", watchdog),
                             ("chunked_prefill", chunked_prefill),
-                            ("proposer", proposer))
+                            ("proposer", proposer),
+                            ("swap_preemption", swap_preemption))
           if v is not None}
     sched = ContinuousBatchScheduler(driven, max_queue=n_requests,
                                      clock=clock, **kw)
@@ -163,6 +176,18 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     if proposer is not None:
         # speculative-decoding acceptance accounting (serve/spec/*)
         out["spec"] = {k: float(v) for k, v in sched.metrics.spec.items()}
+    if getattr(engine, "host_tier_blocks", 0):
+        # two-tier cache traffic + the preemption-path split (serve/kvtier/*)
+        out["kvtier"] = {k: float(v) for k, v in sched.metrics.kvtier.items()}
+        rs = sched.metrics.swap_readmit_s
+        out["kvtier"]["swap_readmit_p50_ms"] = round(
+            float(np.percentile(rs, 50)) * 1000, 3) if rs else None
+        out["kvtier"]["swap_readmit_p95_ms"] = round(
+            float(np.percentile(rs, 95)) * 1000, 3) if rs else None
+        # the cost model's other arm: the per-token step-time EMA that
+        # prices a replay (docs/PREFIX_CACHING.md "Swap-based preemption")
+        out["kvtier"]["token_step_est_ms"] = round(
+            sched._token_est_s * 1000, 3)
     if sync_each_step:
         # decode-step latency == per-token latency (keys predate the
         # scheduler; sourced from its per-step samples now)
@@ -905,6 +930,101 @@ def run_pool_scaling(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_kv_tier(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """KV-cache tiering acceptance A/B (docs/PREFIX_CACHING.md "Two-tier
+    cache"): a shared-prefix priority-mix workload over a device pool sized
+    BELOW the working set — so LRU eviction and decode-time preemption carry
+    the load — served twice at the SAME device pool size: host tier ON
+    (eviction demotes to host RAM, preemption swaps under the auto
+    swap-vs-recompute cost model) vs OFF (eviction destroys, preemption
+    replays the prompt). The tier is a cache, never an authority: the two
+    arms' tokens are asserted bitwise identical. The tiered arm must
+    actually demote, promote and complete swap round trips, and the
+    compiled-program bounds must not move. Reports tokens/s both arms, the
+    swap/recompute preemption split, swap re-admission p50/p95 (the block
+    copy that replaces prompt replay), promotion traffic and the
+    host->device bandwidth EMA."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    size = os.environ.get("DSTPU_BENCH_GPT2", "350m")
+    overrides = json.loads(os.environ.get("DSTPU_BENCH_OVERRIDES", "{}"))
+    n_req = int(os.environ.get("DSTPU_BENCH_REQUESTS", "120"))
+    cfg = gpt2_config(size, max_seq_len=1024, **overrides)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    # working set: 256-token shared prefix (4 blocks, stored once) +
+    # U[32,128] tails + gen U[16,64] ≈ 7 blocks/seq cold. 2 blocks/seq is
+    # the priority_mix overcommit — preemption and cache reclaim both stay
+    # hot, which is the regime the host tier exists for.
+    blocks_per_seq = 2
+
+    def one_arm(host_tier_blocks: int) -> dict:
+        eng = InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=1024,
+            prefill_chunk=256, dtype=jnp.bfloat16, paged=True,
+            block_size=64, token_budget=256,
+            num_blocks=1 + max_seqs * blocks_per_seq,
+            prefix_cache=prefix_cache, host_tier_blocks=host_tier_blocks)
+        # one rng, fixed draw order -> bit-identical workload per arm
+        rng = np.random.default_rng(29)
+        prefix = rng.integers(0, cfg.vocab_size, 256).tolist()
+        prios = rng.integers(0, 3, n_req)
+        out = run_load(eng, n_requests=n_req, arrival_rate=200.0, rng=rng,
+                       shared_prefix=prefix, prompt_lo=32, prompt_hi=128,
+                       priorities=prios, collect_tokens=True)
+        out["prefix_cache_stats"] = eng.prefix_cache_stats()
+        out["compiled_programs"] = (eng.ragged_cache_size
+                                    + eng.fused_cache_size
+                                    + eng.verify_cache_size)
+        assert 1 <= eng.ragged_cache_size <= 2, eng.ragged_cache_size
+        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1, (
+            eng.fused_cache_size, eng.verify_cache_size)
+        return out
+
+    tiered = one_arm(4 * max_seqs)  # host tier sized to hold the spill
+    base = one_arm(0)
+    t_toks = tiered.pop("request_tokens")
+    t_states = tiered.pop("request_states")
+    b_toks = base.pop("request_tokens")
+    b_states = base.pop("request_states")
+    bitwise = t_toks == b_toks and t_states == b_states
+    assert bitwise, "host tier changed served tokens"
+    kvt = tiered["kvtier"]
+    stats = tiered["prefix_cache_stats"]
+    # the tier must have carried real traffic, or the A/B proves nothing
+    assert kvt["demotions"] >= 1 and kvt["promotions"] >= 1, kvt
+    assert kvt["swap_preemptions"] >= 1 and kvt["swap_in"] >= 1, kvt
+    speedup = (round(tiered["tokens_per_s"] / base["tokens_per_s"], 3)
+               if base["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "kv_tier", prefix_cache),
+        "value": tiered["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": speedup,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": f"gpt2-{size} bf16" + (f" {overrides}" if overrides
+                                            else ""),
+            "workload": ("Poisson arrivals, 256-tok shared system prompt + "
+                         "tails U[32,128], gen U[16,64], priorities U{0,1,2}"
+                         ", pool overcommitted 2 blocks/seq; host tier "
+                         f"{4 * max_seqs} blocks vs tier off, same device "
+                         "pool, bitwise-asserted"),
+            "tiered": tiered, "tier_off": base,
+            "tokens_bitwise_identical": bitwise,
+            "swap_readmit_p95_ms": kvt["swap_readmit_p95_ms"],
+            "promotion_hit_rate": (
+                round(stats["promoted_blocks"] / stats["demoted_blocks"], 3)
+                if stats.get("demoted_blocks") else None),
+            "compiled_programs": tiered["compiled_programs"],
+        },
+    }
+
+
 def _metric_name(mode: str, max_seqs: int, workload: str,
                  prefix_cache: bool) -> str:
     name = f"serve_{mode}_{max_seqs}seq"
@@ -952,6 +1072,12 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       on cache hit-blocks, and one seeded replica ``device_lost``
       mid-load absorbed by journal replay across the survivor, bitwise
       vs the fault-free single-engine reference.
+    - ``kv_tier`` (``--kv-tier``): the two-tier KV cache acceptance A/B
+      (docs/PREFIX_CACHING.md "Two-tier cache"): a shared-prefix
+      priority-mix workload over an overcommitted device pool, host tier
+      on (demotion + swap-based preemption) vs off at the same pool size,
+      tokens bitwise-asserted, reporting the swap/recompute split, swap
+      re-admission percentiles and promotion traffic.
     - ``chaos`` (``--faults``): the mixed workload under a seeded fault plan
       (transient bursts, latency spikes, one persistent per-request fault)
       vs its own fault-free reference, decoding speculatively so the site
@@ -989,6 +1115,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_spec_decode(max_seqs, prefix_cache)
     if workload == "pool_scaling":
         return run_pool_scaling(max_seqs, prefix_cache)
+    if workload == "kv_tier":
+        return run_kv_tier(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -1132,7 +1260,7 @@ CONFIGS = (
 )
 
 
-def main(faults: bool = False):
+def main(faults: bool = False, kv_tier: bool = False):
     # one subprocess per configuration: device-memory frees are asynchronous
     # through remote-device transports, so sequential engines in ONE process
     # can OOM on buffers that are already logically freed
@@ -1142,6 +1270,8 @@ def main(faults: bool = False):
     configs = CONFIGS + ((("paged", 32, "chaos", True),
                           ("paged", 32, "engine_loss", True)) if faults
                          else ())
+    if kv_tier:
+        configs = configs + (("paged", 32, "kv_tier", True),)
     results = []
     rows = {}
     for mode, max_seqs, workload, cache in configs:
@@ -1175,13 +1305,15 @@ def main(faults: bool = False):
 if __name__ == "__main__":
     import sys
 
-    argv = [a for a in sys.argv[1:] if a != "--faults"]
+    argv = [a for a in sys.argv[1:] if a not in ("--faults", "--kv-tier")]
     if len(argv) >= 2:
         print(json.dumps(run_config(
             argv[0], int(argv[1]),
             argv[2] if len(argv) > 2 else "mixed",
             bool(int(argv[3])) if len(argv) > 3 else True)))
     else:
-        # --faults appends the chaos (fault-injection) row to the standard
-        # suite; baseline rows must stay within noise of a fault-free run
-        main(faults="--faults" in sys.argv)
+        # --faults appends the chaos (fault-injection) rows to the standard
+        # suite, --kv-tier the two-tier KV cache A/B; baseline rows must
+        # stay within noise of a fault-free run
+        main(faults="--faults" in sys.argv,
+             kv_tier="--kv-tier" in sys.argv)

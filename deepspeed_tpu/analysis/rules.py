@@ -49,6 +49,13 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     # the read-only content-index probe it runs against every replica —
     # pool traffic multiplies both by requests/second × replicas
     "place", "probe", "prefix_probe",
+    # KV-tier data movement (docs/PREFIX_CACHING.md "Two-tier cache"):
+    # demotion/swap-out ride the decode loop and must stay dispatch-only
+    # (async copy, no host sync); promotion/swap-in carry the tier's ONE
+    # designed materialization sync each — anything beyond it is a
+    # regression DSTPU001 should catch
+    "_demote_block", "_scatter_blocks", "_drain_promotions",
+    "swap_out", "swap_in", "_swap_in_readmit", "_preempt", "_swap_wins",
 })
 
 #: where the hot-path rules (001/002) apply — ``resilience`` joined when

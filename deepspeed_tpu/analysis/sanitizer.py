@@ -352,6 +352,83 @@ def check_drained(engine) -> None:
                              + "; ".join(problems))
 
 
+def check_tier_conservation(engine) -> None:
+    """Two-tier cache conservation (docs/PREFIX_CACHING.md "Two-tier
+    cache"): between scheduler steps, every block the tiered allocator
+    knows about must live in EXACTLY one of four states —
+
+    - **free**: on the device free list,
+    - **device-LRU**: device-resident indexed prefix content, unreferenced,
+    - **host-tier**: demoted to host RAM (negative-id namespace),
+    - **referenced**: mapped by at least one live sequence.
+
+    On top of the partition: every content-index entry must resolve — a
+    device-id entry through the referenced/LRU sets, a demoted (negative)
+    entry through the host tier (a dangling demoted entry would let
+    ``lookup`` promote freed garbage into a live sequence); queued
+    promotions must target referenced blocks (the lookup that queued them
+    pinned the destination); and every swap entry must describe a
+    NON-resident sequence with exactly the at-rest block count its
+    committed history needs — swap payloads are a cache keyed by uid, and
+    a resident uid with a swap entry means a flush was skipped. No-op on
+    engines without a prefix cache."""
+    mgr = getattr(engine, "block_mgr", None)
+    if mgr is None or not getattr(mgr, "prefix_cache", False):
+        return
+    from ..inference.v2.ragged_manager import _ROOT
+
+    problems: List[str] = []
+    free, lru, ref = set(mgr._free), set(mgr._lru), set(mgr._ref)
+    host = set(mgr._host)
+    for overlap, name in ((free & ref, "free AND referenced"),
+                          (free & lru, "free AND device-LRU"),
+                          (ref & lru, "referenced AND device-LRU")):
+        if overlap:
+            problems.append(f"block(s) {sorted(overlap)} are {name}")
+    bad_ns = [b for b in host if b >= _ROOT]
+    if bad_ns:
+        problems.append(f"host-tier id(s) {sorted(bad_ns)} outside the "
+                        f"negative namespace (must be < {_ROOT})")
+    devices = free | ref | lru
+    expected = set(range(1, mgr.num_blocks))  # block 0 is the trash block
+    if devices != expected:
+        missing = sorted(expected - devices)
+        extra = sorted(devices - expected)
+        problems.append(f"device pool not conserved: missing {missing}, "
+                        f"unexpected {extra}")
+    cap = max(getattr(mgr, "host_tier_blocks", 0), 0)
+    if len(host) > cap:
+        problems.append(f"host tier over capacity: {len(host)} resident "
+                        f"> {cap}")
+    for key, b in mgr._index.items():
+        if b < _ROOT:
+            if b not in host:
+                problems.append(f"index entry {key} points at demoted "
+                                f"block {b} with no host-tier residence")
+        elif b not in ref and b not in lru:
+            problems.append(f"index entry {key} points at device block "
+                            f"{b} that is neither referenced nor cached")
+    for _, dst in getattr(mgr, "_pending_promotions", ()):
+        if dst not in ref:
+            problems.append(f"pending promotion targets block {dst} with "
+                            "no live reference pinning it")
+    seqs = getattr(getattr(engine, "state", None), "seqs", {})
+    for uid, entry in getattr(engine, "_swaps", {}).items():
+        if uid in seqs:
+            problems.append(f"uid {uid} is engine-resident AND holds a "
+                            "swap entry — swap_out must flush first")
+            continue
+        payloads, _, seen = entry
+        need = mgr.blocks_needed(seen)
+        if len(payloads) != need:
+            problems.append(f"swap entry uid {uid}: {len(payloads)} "
+                            f"payload block(s) for {seen} committed "
+                            f"tokens (needs {need})")
+    if problems:
+        raise SanitizerError("[sanitizer] tier conservation violated: "
+                             + "; ".join(problems))
+
+
 def check_recovery(journal, queued, all_requests: Dict[int, object]) -> None:
     """Post-recovery re-admission check (docs/RESILIENCE.md): immediately
     after an engine rebuild, every journaled live uid must be accounted

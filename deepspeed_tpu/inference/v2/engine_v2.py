@@ -53,7 +53,8 @@ class InferenceEngineV2:
                  max_seq_len: Optional[int] = None, prefill_chunk: int = 256,
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
                  num_blocks: Optional[int] = None, token_budget: int = 0,
-                 prefix_cache: bool = True, decode_horizon: int = 1):
+                 prefix_cache: bool = True, decode_horizon: int = 1,
+                 host_tier_blocks: int = 0):
         self.model = model
         self.cfg = model.config
         # default serving width: paged mode shares one block pool so 32 slots
@@ -108,6 +109,21 @@ class InferenceEngineV2:
         # so the previous dispatch has fully consumed its inputs.
         self._scratch: Dict[Tuple, Tuple[np.ndarray, ...]] = {}
         self.prefix_cache = bool(prefix_cache) and paged
+        # host-RAM KV tier (docs/PREFIX_CACHING.md "Two-tier cache"): spill
+        # capacity in blocks under the device pool. 0 = single-tier (the
+        # pre-tier behavior, byte-identical). Needs the prefix cache: the
+        # content index is what makes demoted blocks findable again.
+        self.host_tier_blocks = host_tier_blocks if self.prefix_cache else 0
+        self._tier_gather_fn = None
+        self._tier_scatter_fn = None
+        self._tier_buf: Optional[np.ndarray] = None
+        #: swapped-out preemption victims: uid -> (block payloads, history,
+        #: seen_tokens). Host-side cache only — engine loss, weight swaps,
+        #: and flushes drop entries; the scheduler then replays from its
+        #: journal exactly as before swap-preemption existed.
+        self._swaps: Dict[int, Tuple] = {}
+        self.swap_stats = {"swap_out": 0, "swap_in": 0,
+                           "swap_out_blocks": 0, "swap_in_blocks": 0}
         if paged:
             # paged-block pool (reference BlockedKVCache): total KV memory is
             # num_blocks*block_size tokens shared across sequences instead of
@@ -124,22 +140,31 @@ class InferenceEngineV2:
                 self.block_mgr = checked_cache_cls()(
                     num_blocks, block_size, max_blocks_per_seq,
                     prefix_cache=self.prefix_cache,
+                    host_tier_blocks=self.host_tier_blocks,
                     descs=lambda: self.state.seqs.values())
             else:
-                self.block_mgr = BlockedKVCache(num_blocks, block_size,
-                                                max_blocks_per_seq,
-                                                prefix_cache=self.prefix_cache)
+                self.block_mgr = BlockedKVCache(
+                    num_blocks, block_size, max_blocks_per_seq,
+                    prefix_cache=self.prefix_cache,
+                    host_tier_blocks=self.host_tier_blocks)
+            self.block_mgr.demote_fn = self._demote_block
             self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
+            #: device bytes of one block's K+V across all layers — the unit
+            #: of every tier/swap byte counter and of the scheduler's
+            #: swap-vs-recompute cost model
+            self.block_bytes = sum(int(a.nbytes) for a in self.kv) // num_blocks
             log_dist(
                 f"InferenceEngineV2(paged): blocks={num_blocks}x{block_size} "
                 f"seqs<={max_seqs} ctx={self.max_seq_len} chunk={prefill_chunk} "
                 f"token_budget={self.token_budget} "
                 f"decode_horizon={self.decode_horizon} "
-                f"prefix_cache={'on' if self.prefix_cache else 'off'}",
+                f"prefix_cache={'on' if self.prefix_cache else 'off'} "
+                f"host_tier_blocks={self.host_tier_blocks}",
                 ranks=[0],
             )
         else:
             self.block_mgr = None
+            self.block_bytes = 0
             # slot-pooled KV cache: (L, max_seqs, T, kvh, hd)
             self.kv = model.init_kv_cache(max_seqs, self.max_seq_len, dtype=dtype)
             log_dist(
@@ -179,8 +204,12 @@ class InferenceEngineV2:
         if self.paged:
             # the prefix content index holds KV computed under the OLD
             # weights — serving it to post-swap prompts would silently mix
-            # weight versions
+            # weight versions. flush_cache drops BOTH tiers: a host-tier
+            # survivor would promote stale old-weights KV straight back in.
             self.block_mgr.flush_cache()
+            # swapped-out victims' KV is old-weights too: drop the payloads
+            # so re-admission replays their prompts under the new weights
+            self._swaps.clear()
 
     def prefix_probe(self, tokens) -> int:
         """Read-only placement probe: leading full blocks of ``tokens``
@@ -305,6 +334,173 @@ class InferenceEngineV2:
             self._cow_fn = jax.jit(cow, donate_argnums=(0,))
         return self._cow_fn
 
+    # ------------------------------------------------------------------
+    # host-RAM KV tier: data movement (docs/PREFIX_CACHING.md)
+    # ------------------------------------------------------------------
+    def _get_tier_gather(self):
+        """Single fixed-shape block-gather program: pull pool block ``src``
+        out as one (2, L, kvh, BS, hd) array (K stacked on V). ``src`` is a
+        traced scalar — ONE compiled trace serves every demotion and
+        swap-out, so tier traffic adds data movement, not programs. No
+        donation: the pool stays live (the gather is dispatched alongside
+        decode steps that keep consuming it)."""
+        if self._tier_gather_fn is None:
+
+            def gather(kv, src):
+                k, v = kv  # (L, kvh, NB, BS, hd) each; block axis = 2
+                return jnp.stack((k[:, :, src], v[:, :, src]))
+
+            self._tier_gather_fn = jax.jit(gather)
+        return self._tier_gather_fn
+
+    def _get_tier_scatter(self):
+        """Single fixed-shape block-scatter program: write row ``row`` of a
+        staged (M, 2, L, kvh, BS, hd) batch into pool block ``dst``. Both
+        indices are traced scalars and the batch capacity M is fixed
+        (``max_blocks_per_seq``), so this compiles exactly ONCE — promotions
+        and swap-ins of any size ride the same trace."""
+        if self._tier_scatter_fn is None:
+
+            def scatter(kv, batch, row, dst):
+                k, v = kv
+                blk = jax.lax.dynamic_index_in_dim(batch, row, 0,
+                                                   keepdims=False)
+                k = k.at[:, :, dst].set(blk[0])
+                v = v.at[:, :, dst].set(blk[1])
+                return k, v
+
+            self._tier_scatter_fn = jax.jit(scatter, donate_argnums=(0,))
+        return self._tier_scatter_fn
+
+    def _tier_host_buf(self) -> np.ndarray:
+        """Reused fixed-capacity host staging buffer for promotion/swap-in
+        batches — (max_blocks_per_seq, 2, L, kvh, BS, hd), allocated once.
+        Fixed capacity keeps the scatter program's batch shape constant (no
+        retrace) and bounds staging memory; larger batches go in chunks."""
+        if self._tier_buf is None:
+            k = self.kv[0]
+            shape = ((self.block_mgr.max_blocks_per_seq, 2)
+                     + tuple(k.shape[:2]) + tuple(k.shape[3:]))
+            self._tier_buf = np.empty(shape, np.dtype(k.dtype))
+        return self._tier_buf
+
+    def _demote_block(self, block: int):
+        """The allocator's ``demote_fn``: async-gather one pool block to the
+        host. Dispatch-only — the gather program is enqueued and the
+        device→host copy started without waiting (``copy_to_host_async``),
+        so demotion never blocks the decode dispatch behind it. The payload
+        materializes lazily at promotion/eviction time."""
+        blk = self._get_tier_gather()(self.kv, jnp.int32(block))
+        blk.copy_to_host_async()
+        return blk
+
+    def _scatter_blocks(self, payloads, dsts) -> None:
+        """Land host payloads in pool blocks ``dsts``: stage up to
+        ``max_blocks_per_seq`` payloads in the reused host buffer, ship the
+        batch with ONE device_put per dispatch chunk (never one per block),
+        then scatter each row with the single compiled traced-index
+        program."""
+        if not payloads:
+            return
+        buf = self._tier_host_buf()
+        cap = buf.shape[0]
+        scatter = self._get_tier_scatter()
+        for base in range(0, len(dsts), cap):
+            chunk = range(base, min(base + cap, len(dsts)))
+            for i, j in enumerate(chunk):
+                # materializing the async gather is THE designed host sync
+                # of the tier: by now the copy has long completed in the
+                # background (it was started at demotion/swap-out time)
+                buf[i] = np.asarray(payloads[j])  # dstpu-lint: ignore[DSTPU001]
+            batch = jax.device_put(buf)
+            for i, j in enumerate(chunk):
+                self.kv = scatter(self.kv, batch, jnp.int32(i),
+                                  jnp.int32(dsts[j]))
+
+    def _drain_promotions(self) -> None:
+        """Land every queued host→device promotion before the next compiled
+        step reads the pool. A content-index hit on a demoted block rekeys
+        the bookkeeping synchronously (see ``BlockedKVCache._promote``) and
+        queues the data movement here — batched, one ``device_put`` per
+        dispatch chunk."""
+        if not self.host_tier_blocks:
+            return
+        orders = self.block_mgr.take_promotions()
+        if orders:
+            self._scatter_blocks([p for p, _ in orders],
+                                 [d for _, d in orders])
+
+    # ------------------------------------------------------------------
+    # swap-based preemption (docs/SERVING.md)
+    # ------------------------------------------------------------------
+    def swap_resident(self, uid: int) -> bool:
+        """True when ``uid``'s KV is parked in the host swap store."""
+        return uid in self._swaps
+
+    def swap_out(self, uid: int) -> bool:
+        """Preempt a live sequence by swapping its KV to the host instead of
+        discarding it: async-gather every held block, then flush the
+        sequence normally (slot + blocks reclaimed). Returns False — and
+        does nothing — when swapping does not apply (tier off, unknown uid,
+        pending prefill, or uncommitted speculation); the caller falls back
+        to plain flush-preemption + journal replay. The swap store is a
+        cache, never a source of truth: re-admission works identically if
+        the entry has vanished."""
+        if not self.host_tier_blocks:
+            return False
+        d = self.state.seqs.get(uid)
+        if (d is None or d.done or d.pending or d.uncommitted
+                or not d.blocks):
+            return False
+        gather = self._get_tier_gather()
+        payloads = []
+        for b in d.blocks:
+            blk = gather(self.kv, jnp.int32(b))
+            blk.copy_to_host_async()  # dispatch-only, like demotion
+            payloads.append(blk)
+        entry = (payloads, list(d.history), d.seen_tokens)
+        self.flush(uid)
+        self._swaps[uid] = entry
+        self.swap_stats["swap_out"] += 1
+        self.swap_stats["swap_out_blocks"] += len(payloads)
+        return True
+
+    def swap_in(self, uid: int) -> bool:
+        """Re-admit a swapped-out sequence by block copy instead of prompt
+        replay: allocate blocks, land the payloads (one ``device_put`` per
+        dispatch chunk), restore the descriptor exactly as it was at
+        swap-out, and re-register — the dedup pass folds the sequence back
+        onto canonical index blocks, restoring any sharing the swap
+        flattened. Returns False (with the entry consumed and all partial
+        state rolled back) when no slot or not enough blocks are free; the
+        caller replays the prompt instead — dropping the entry rather than
+        retrying it avoids swap-thrash under sustained pressure."""
+        entry = self._swaps.pop(uid, None)
+        if entry is None:
+            return False
+        payloads, history, seen = entry
+        if not self.state.can_allocate():
+            return False
+        desc = self.state.get_or_create_sequence(uid)
+        try:
+            self.block_mgr.ensure(desc, seen)
+        except (PoolExhaustedError, ContextOverflowError):
+            self.block_mgr.free(desc)
+            self.state.flush_sequence(uid)
+            return False
+        assert len(desc.blocks) == len(payloads), \
+            f"uid {uid}: swap-in geometry drift"
+        self._drain_promotions()  # keep pool writes in queue order
+        self._scatter_blocks(payloads, desc.blocks)
+        desc.history = list(history)
+        desc.seen_tokens = seen
+        desc.n_indexed = 0
+        if self.prefix_cache:
+            self.block_mgr.register(desc)
+        self.swap_stats["swap_in"] += 1
+        self.swap_stats["swap_in_blocks"] += len(payloads)
+        return True
+
     def _get_fused(self):
         """THE fused decode program: one compiled ``lax.scan`` over
         ``decode_horizon`` greedy rounds for the full ``max_seqs`` row batch
@@ -398,6 +594,9 @@ class InferenceEngineV2:
         iteration, so decode rounds and queued admissions never convoy
         behind a long prompt's full prefill. Partially-prefilled sequences
         simply keep their ``pending`` tail across calls."""
+        # land any queued host→device promotions (admission-time prefix hits
+        # on demoted blocks) before a program reads the pool
+        self._drain_promotions()
         steps = 0
         while max_steps is None or steps < max_steps:
             work = [d for d in self.state.seqs.values() if d.in_flight > 0]
@@ -710,6 +909,7 @@ class InferenceEngineV2:
         # pre-allocate the WHOLE horizon's blocks before dispatch (positions
         # seen .. seen+K-1); a PoolExhaustedError here leaves seen_tokens/
         # history untouched — allocated blocks are used by the retried step
+        self._drain_promotions()  # queued tier promotions land first
         for uid in tokens:
             d = self.state.seqs[uid]
             self.block_mgr.ensure(d, d.seen_tokens + K)
@@ -801,6 +1001,7 @@ class InferenceEngineV2:
                     f"uid {uid}: verify width {K} exceeds context "
                     f"({d.seen_tokens}+{K} > {self.max_seq_len}); collapse "
                     "to horizon 1 or flush the sequence", uid=uid)
+        self._drain_promotions()  # queued tier promotions land first
         for uid in tokens:
             d = self.state.seqs[uid]
             self.block_mgr.ensure(d, d.seen_tokens + K)
@@ -906,6 +1107,9 @@ class InferenceEngineV2:
         second ``block_mgr.free`` of the same descriptor would corrupt
         refcounts)."""
         if uid not in self.state.seqs:
+            if self._swaps.pop(uid, None) is not None:
+                # cancel/expiry of a swapped-out victim: drop its payload
+                return
             self.flush_noops += 1
             log_dist(f"flush({uid}): unknown uid (no-op #{self.flush_noops})",
                      ranks=[0], level=10)  # DEBUG
@@ -940,8 +1144,12 @@ class InferenceEngineV2:
         incarnations with zero recompilation and a rebuild costs one pool
         allocation, not a cold start. Resident sequences are NOT migrated —
         their KV died with the device; the scheduler replays them from its
-        journal through normal admission."""
+        journal through normal admission. The host KV tier and the swap
+        store die with the incarnation too (both are caches of pool content
+        that no longer exists — a swap-in after rebuild would resurrect KV
+        from the dead device): journal replay never consults either."""
         self.state = DSStateManager(self.max_seqs, self.max_seq_len)
+        self._swaps.clear()
         self.rebuilds += 1
         if not self.paged:
             self.kv = self.model.init_kv_cache(self.max_seqs,
@@ -957,11 +1165,14 @@ class InferenceEngineV2:
             self.block_mgr = checked_cache_cls()(
                 old.num_blocks, old.block_size, old.max_blocks_per_seq,
                 prefix_cache=self.prefix_cache,
+                host_tier_blocks=self.host_tier_blocks,
                 descs=lambda: self.state.seqs.values())
         else:
-            self.block_mgr = BlockedKVCache(old.num_blocks, old.block_size,
-                                            old.max_blocks_per_seq,
-                                            prefix_cache=self.prefix_cache)
+            self.block_mgr = BlockedKVCache(
+                old.num_blocks, old.block_size, old.max_blocks_per_seq,
+                prefix_cache=self.prefix_cache,
+                host_tier_blocks=self.host_tier_blocks)
+        self.block_mgr.demote_fn = self._demote_block
         self.kv = self.model.init_kv_pool(old.num_blocks, old.block_size,
                                           dtype=self.dtype)
         log_dist(
@@ -997,6 +1208,14 @@ class InferenceEngineV2:
         s["hit_rate"] = (s["hits"] / s["lookups"]) if s["lookups"] else 0.0
         s["cached_blocks"] = self.block_mgr.cached_blocks
         s["free_blocks"] = self.block_mgr.free_blocks
+        # host-RAM tier + swap-preemption counters (all zero with the tier
+        # off — dashboards can key on host_capacity_blocks)
+        s["host_blocks"] = self.block_mgr.host_blocks
+        s["host_capacity_blocks"] = self.host_tier_blocks
+        s["host_bytes"] = self.block_mgr.host_blocks * self.block_bytes
+        s.update(self.swap_stats)
+        s["swap_out_bytes"] = self.swap_stats["swap_out_blocks"] * self.block_bytes
+        s["swap_in_bytes"] = self.swap_stats["swap_in_blocks"] * self.block_bytes
         return s
 
     def monitor_events(self, step: int = 0) -> List[Tuple[str, float, int]]:

@@ -20,6 +20,22 @@ those tokens' prefill entirely. Unreferenced cached blocks park in an LRU and
 are reclaimed (leaf-first, so a chain never dangles) when the free list runs
 dry. All of this is host-side bookkeeping: device programs see only block
 tables, so the fixed-shape discipline of the ragged engine is untouched.
+
+Two-tier cache (docs/PREFIX_CACHING.md "Two-tier cache"): with
+``host_tier_blocks > 0`` the allocator grows a host-RAM spill tier under the
+device pool — the ZeRO-Infinity memory-wall move applied to inference KV.
+LRU reclaim then *demotes* a full prefix block to a pinned host buffer
+(``demote_fn``, an engine-supplied async gather) instead of destroying it,
+and a content-index hit on a demoted block *promotes* it back: the block is
+rekeyed onto a fresh device id immediately (bookkeeping is synchronous) while
+the data movement is queued in ``_pending_promotions`` for the engine to
+drain — batched, one ``device_put`` per dispatch — before the next program
+runs. Demoted blocks live in a disjoint negative-id namespace (< ``_ROOT``)
+so a recycled device id can never collide with a host-resident index entry;
+``_rekey`` rewrites the index/meta/children edges — including the children's
+own keys, which embed the parent id — whenever a block crosses the tier
+boundary. The host tier is a cache, never a source of truth: flushes drop it
+wholesale and recovery never consults it.
 """
 
 from collections import OrderedDict
@@ -69,11 +85,14 @@ class BlockedKVCache:
     step fills blocks, and ``free`` at flush."""
 
     def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, host_tier_blocks: int = 0):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.prefix_cache = prefix_cache
+        #: host-RAM spill tier capacity in blocks; 0 disables the tier and
+        #: keeps reclaim byte-identical to the single-tier allocator
+        self.host_tier_blocks = host_tier_blocks if prefix_cache else 0
         self._free: List[int] = list(range(1, num_blocks))[::-1]  # 0 reserved
         self._ref: Dict[int, int] = {}  # block -> refcount (present iff > 0)
         # content index: (parent block id | _ROOT, token tuple) -> block id.
@@ -84,9 +103,21 @@ class BlockedKVCache:
         self._children: Dict[int, set] = {}  # parent block -> indexed children
         #: cached-but-unreferenced blocks, insertion order = eviction order
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        #: host tier: host id (< _ROOT) -> opaque payload handle from
+        #: ``demote_fn``; insertion order = host-eviction order
+        self._host: "OrderedDict[int, object]" = OrderedDict()
+        self._next_host_id = _ROOT - 1
+        #: (payload, device_block) pairs the engine must scatter onto the
+        #: device before its next dispatch (see ``take_promotions``)
+        self._pending_promotions: List[Tuple[object, int]] = []
+        #: engine-supplied ``block_id -> payload`` async gather; when None the
+        #: tier tracks bookkeeping only (host-side unit tests)
+        self.demote_fn = None
         self.stats = {"lookups": 0, "hits": 0, "hit_blocks": 0,
                       "skipped_prefill_tokens": 0, "evicted_blocks": 0,
-                      "cow_copies": 0, "dedup_blocks": 0}
+                      "cow_copies": 0, "dedup_blocks": 0,
+                      "demoted_blocks": 0, "promoted_blocks": 0,
+                      "host_evicted_blocks": 0}
 
     @property
     def free_blocks(self) -> int:
@@ -95,8 +126,13 @@ class BlockedKVCache:
 
     @property
     def cached_blocks(self) -> int:
-        """Blocks currently holding indexed prefix content."""
+        """Blocks currently holding indexed prefix content (both tiers)."""
         return len(self._meta)
+
+    @property
+    def host_blocks(self) -> int:
+        """Blocks currently resident in the host-RAM spill tier."""
+        return len(self._host)
 
     def blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
@@ -138,30 +174,138 @@ class BlockedKVCache:
                     del self._children[parent]
         self._children.pop(block, None)
 
-    def _evict_one(self) -> bool:
-        """Reclaim one unreferenced cached block into the free list.
+    def _rekey(self, old: int, new: int):
+        """Move one indexed block to a new id across the tier boundary,
+        rewriting every edge that names it: its own index entry and meta, its
+        parent's children set, and — because a child's key embeds the parent
+        id — every child's index key and meta. Content-chain identity is
+        untouched: the key tokens never change, only the id they resolve to."""
+        key, parent = self._meta.pop(old)
+        self._index[key] = new
+        self._meta[new] = (key, parent)
+        if parent != _ROOT:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(old)
+                kids.add(new)
+        kids = self._children.pop(old, None)
+        if kids:
+            self._children[new] = kids
+            for c in kids:
+                ckey, _ = self._meta[c]
+                del self._index[ckey]
+                nkey = (new, ckey[1])
+                self._index[nkey] = c
+                self._meta[c] = (nkey, new)
+
+    def _evict_host_one(self) -> bool:
+        """Destroy one leaf block of the host tier (oldest first). Host-tier
+        eviction is the only place tiered content actually dies, so it stays
+        strictly leaf-first: a host block's children are themselves
+        host-resident (a device child pins its parent on device), and
+        children demote before parents, so leaves sit at the old end."""
+        for b in self._host:  # oldest → newest
+            if not self._children.get(b):
+                self._unindex(b)
+                del self._host[b]
+                self.stats["host_evicted_blocks"] += 1
+                return True
+        # every resident block has children (a promotion holds one leaf out
+        # of the scan): tell the caller to fall back to a hard evict
+        return False
+
+    def _demote(self, b: int) -> bool:
+        """Spill device block ``b``'s content to the host tier: gather its KV
+        asynchronously (``demote_fn`` must never block the decode dispatch)
+        and rekey its index entries onto a fresh host id. Returns False when
+        the host tier cannot make room, in which case the caller destroys the
+        block the single-tier way."""
+        while len(self._host) >= self.host_tier_blocks:
+            if not self._evict_host_one():
+                return False
+        payload = self.demote_fn(b) if self.demote_fn is not None else None
+        hid = self._next_host_id
+        self._next_host_id -= 1
+        self._rekey(b, hid)
+        self._host[hid] = payload
+        self.stats["demoted_blocks"] += 1
+        return True
+
+    def _promote(self, hid: int, uid: int):
+        """Bring demoted block ``hid`` back onto the device: allocate a device
+        block (refcount 1, for the caller's chain), rekey the index entries
+        onto it, and queue the data movement for the engine to drain before
+        its next dispatch. Returns the device id, or None when the device
+        pool cannot host it (the hit chain is truncated there — the tokens
+        recompute, correctness is unaffected)."""
+        payload = self._host.pop(hid)
+        try:
+            dst = self._allocate(uid)
+        except PoolExhaustedError:
+            self._host[hid] = payload  # re-shelve (MRU end) and give up
+            return None
+        self._rekey(hid, dst)
+        self._pending_promotions.append((payload, dst))
+        self.stats["promoted_blocks"] += 1
+        return dst
+
+    def take_promotions(self) -> List[Tuple[object, int]]:
+        """Hand the engine the queued ``(payload, device_block)`` promotion
+        orders and clear the queue. The engine batches them into one
+        ``device_put`` and scatters per block with a single compiled
+        traced-index program — before any dispatch that reads the pool."""
+        orders, self._pending_promotions = self._pending_promotions, []
+        return orders
+
+    def _evict_one(self, demote: bool = None) -> bool:
+        """Reclaim one unreferenced cached block into the free list — by
+        demotion to the host tier when one is configured, destructively
+        otherwise (``demote=False`` forces the destructive path; flushes use
+        it so dropped content cannot resurface by promotion).
 
         Leaf-first among the LRU: evicting an interior block would leave its
         indexed children keyed on a dead parent id. An unreferenced block's
         descendants are all unreferenced too (a sequence holding a child holds
         the whole chain), so every LRU subtree has its leaves in the LRU and
-        the scan below always finds one."""
+        the scan below always finds one. With the tier on, "leaf" means no
+        *device-resident* children — host-resident children were demoted
+        first and ``_rekey`` keeps their keys valid across the move."""
+        if demote is None:
+            demote = self.host_tier_blocks > 0
         for b in self._lru:  # oldest → newest
-            if not self._children.get(b):
-                self._unindex(b)
+            kids = self._children.get(b)
+            if kids and (not demote or any(c >= 0 for c in kids)):
+                continue
+            if demote and self._demote(b):
                 del self._lru[b]
                 self._free.append(b)
-                self.stats["evicted_blocks"] += 1
                 return True
-        if self._lru:  # unreachable unless an invariant broke; stay safe
+            if kids:
+                # demotion failed (host tier wedged) and b still anchors
+                # host-resident children: destroying it would dangle them
+                continue
+            del self._lru[b]
+            self._unindex(b)
+            self.stats["evicted_blocks"] += 1
+            self._free.append(b)
+            return True
+        if self._lru:
+            if demote:  # wedged host tier: surface as capacity, not corruption
+                return False
+            # unreachable unless an invariant broke; stay safe
             raise AssertionError("prefix-cache LRU holds only interior blocks")
         return False
 
     def flush_cache(self):
         """Force-evict every cached (unreferenced) block back to the free
-        pool — drops all prefix reuse state held beyond live sequences."""
+        pool — drops all prefix reuse state held beyond live sequences,
+        *including the entire host tier*: a flush marks the content stale
+        (e.g. a weight swap), so nothing may survive to promote back in."""
+        while self._host:
+            if not self._evict_host_one():  # pragma: no cover - defensive
+                raise AssertionError("host tier wedged during flush")
         while self._lru:
-            self._evict_one()
+            self._evict_one(demote=False)
 
     def _allocate(self, uid: int) -> int:
         while not self._free:
@@ -256,13 +400,20 @@ class BlockedKVCache:
             b = self._index.get(key)
             if b is None:
                 break
+            if b < _ROOT:
+                # hit on a demoted block: promote it back onto the device.
+                # The chain built so far is refcounted, so the allocation
+                # inside _promote can never demote or evict it from under us.
+                b = self._promote(b, desc.uid)
+                if b is None:  # no device room: truncate the hit here
+                    break
+            else:
+                self._incref(b)
             chain.append(b)
             parent = b
         if not chain:
             return 0
         skipped = min(len(chain) * bs, len(tokens) - 1)
-        for b in chain:
-            self._incref(b)
         desc.blocks = list(chain)
         desc.n_indexed = len(chain)
         self.stats["hits"] += 1
@@ -276,7 +427,14 @@ class BlockedKVCache:
         Walks the same root-anchored chain as :meth:`lookup` but touches
         nothing — no refcounts, no LRU order, no stats — so a router may
         score every replica per placement without perturbing any cache.
-        Deterministic: the exact chained index, not a hash sketch."""
+        Deterministic: the exact chained index, not a hash sketch.
+
+        The probe sees BOTH tiers: demoted blocks keep their index entries
+        (at negative host ids, with child keys rechained by ``_rekey``), so
+        the walk crosses device->host boundaries transparently and the
+        affinity score counts content one promotion away — exactly what a
+        placement should weigh, since a hit on a demoted block is a block
+        copy, not a recompute."""
         if not self.prefix_cache:
             return 0
         bs = self.block_size
@@ -324,7 +482,15 @@ class BlockedKVCache:
             key = (parent, tuple(desc.history[j * bs:(j + 1) * bs]))
             own = desc.blocks[j]
             existing = self._index.get(key)
-            if existing is not None and existing != own:
+            if existing is not None and existing < _ROOT:
+                # identical content sits demoted in the host tier; our copy
+                # is freshly written on device and bitwise the same, so adopt
+                # it as the canonical block: drop the host payload and rekey
+                # the demoted id (and any host children) onto our block.
+                self._host.pop(existing, None)
+                self._rekey(existing, own)
+                self.stats["dedup_blocks"] += 1
+            elif existing is not None and existing != own:
                 self._incref(existing)
                 self._decref(own)  # own is unindexed → straight to free list
                 desc.blocks[j] = existing
@@ -343,22 +509,35 @@ class BlockedKVCache:
         """Raise AssertionError if internal bookkeeping is inconsistent."""
         assert all(r > 0 for r in self._ref.values()), "non-positive refcount"
         free, lru, ref = set(self._free), set(self._lru), set(self._ref)
+        host = set(self._host)
         assert not (free & lru) and not (free & ref) and not (lru & ref), \
             "block in more than one pool"
         assert len(free) == len(self._free), "duplicate block in free list"
         assert 0 not in free | lru | ref, "trash block 0 escaped reservation"
         assert len(free | lru | ref) <= self.num_blocks - 1, "phantom block"
+        assert all(b < _ROOT for b in host), "device id in the host tier"
+        assert len(host) <= max(self.host_tier_blocks, 0), "host tier overfull"
+        for b in host:
+            assert b in self._meta, "host-tier block missing from the index"
+            kids = self._children.get(b, ())
+            assert all(c < _ROOT for c in kids), \
+                "host-tier block anchors a device-resident child"
         for key, b in self._index.items():
             assert self._meta.get(b, (None,))[0] == key, "index/meta mismatch"
             parent = key[0]
             assert parent == _ROOT or parent in self._meta, \
                 "indexed block chained on an unindexed parent"
+            assert b >= 0 or b in host, \
+                "index entry at a demoted block with no host-tier residence"
         for b in self._meta:
-            assert b in ref or b in lru, "indexed block is in the free list"
+            assert b in ref or b in lru or b in host, \
+                "indexed block is in the free list"
         for parent, kids in self._children.items():
             for c in kids:
                 assert self._meta.get(c, (None, None))[1] == parent, \
                     "children edge without matching meta parent"
+        for _, dst in self._pending_promotions:
+            assert dst in ref, "pending promotion targets an unreferenced block"
         descs = list(descs)
         if descs:
             counted: Dict[int, int] = {}

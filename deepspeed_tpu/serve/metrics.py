@@ -87,6 +87,31 @@ class ServeMetrics:
             "steps": 0, "proposed_tokens": 0, "accepted_tokens": 0,
             "bonus_tokens": 0, "rollback_tokens": 0, "degraded_steps": 0,
             "acceptance_rate": 0.0, "draft_horizon": 0.0}
+        #: KV-tier counters (docs/PREFIX_CACHING.md "Two-tier cache"),
+        #: exported under ``serve/kvtier/*``: engine-side tier traffic
+        #: (demotions/promotions/host evictions, swap round trips and their
+        #: byte volumes, host-tier occupancy gauges) synced from
+        #: ``prefix_cache_stats()`` each step, plus the scheduler's own
+        #: preemption-path split (``swap_preemptions`` vs
+        #: ``recompute_preemptions``) and the transfer-bandwidth EMA gauge
+        #: the swap-vs-recompute cost model runs on. All zeros when the
+        #: engine has no host tier.
+        self.kvtier: Dict[str, float] = {
+            "demotions": 0,             # device blocks demoted to host RAM
+            "promotions": 0,            # host blocks promoted on index hits
+            "host_evictions": 0,        # blocks destroyed out of the host LRU
+            "host_blocks": 0.0,         # gauge: host-tier resident blocks
+            "host_bytes": 0.0,          # gauge: host-tier resident bytes
+            "swap_out": 0, "swap_in": 0,
+            "swap_out_bytes": 0.0, "swap_in_bytes": 0.0,
+            "swap_preemptions": 0,      # victims preempted by KV swap-out
+            "recompute_preemptions": 0,  # victims preempted onto replay
+            "bw_bytes_per_s": 0.0,      # gauge: host->device bandwidth EMA
+        }
+        #: swap re-admission wall-clock samples (swap_in transfer + restore);
+        #: the bench's re-admission p95 and the ``serve/kvtier`` percentile
+        #: events come from here
+        self.swap_readmit_s: List[float] = []
         #: resilience counters, exported under ``serve/faults/*``
         #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
         #: step, the rest are incremented by the scheduler as faults land
@@ -149,6 +174,34 @@ class ServeMetrics:
     def observe_spec_degraded(self) -> None:
         """A fused dispatch ran because speculation was collapsed/empty."""
         self.spec["degraded_steps"] += 1
+
+    def observe_kvtier(self, stats: Dict[str, float]) -> None:
+        """Sync engine-side tier counters from ``prefix_cache_stats()`` —
+        called once per step, gauge-style (the engine owns the running
+        totals; this mirrors them into the event stream)."""
+        for src, dst in (("demoted_blocks", "demotions"),
+                         ("promoted_blocks", "promotions"),
+                         ("host_evicted_blocks", "host_evictions"),
+                         ("host_blocks", "host_blocks"),
+                         ("host_bytes", "host_bytes"),
+                         ("swap_out", "swap_out"), ("swap_in", "swap_in"),
+                         ("swap_out_bytes", "swap_out_bytes"),
+                         ("swap_in_bytes", "swap_in_bytes")):
+            if src in stats:
+                self.kvtier[dst] = float(stats[src])
+
+    def observe_swap_preemption(self, swapped: bool) -> None:
+        """One preemption on a tiered engine: which path the cost model
+        (or the forced ``swap_preemption`` setting) took."""
+        self.kvtier["swap_preemptions" if swapped
+                    else "recompute_preemptions"] += 1
+
+    def observe_swap_readmit(self, latency_s: float,
+                             bw_bytes_per_s: float) -> None:
+        """One swap-based re-admission: the host->device transfer+restore
+        wall clock, and the bandwidth EMA it updated."""
+        self.swap_readmit_s.append(latency_s)
+        self.kvtier["bw_bytes_per_s"] = float(bw_bytes_per_s)
 
     def observe_prefill_chunk(self, n_tokens: int, interleaved: bool) -> None:
         """One dispatch that consumed ``n_tokens`` prompt tokens;
@@ -229,6 +282,14 @@ class ServeMetrics:
                    for k, v in sorted(self.prefill.items())]
                 + [(f"{p}spec/{k}", float(v), step)
                    for k, v in sorted(self.spec.items())]
+                + [(f"{p}kvtier/{k}", float(v), step)
+                   for k, v in sorted({
+                       **self.kvtier,
+                       "swap_readmit_p50_ms": round(
+                           self._pct(self.swap_readmit_s, 50) * 1000, 3),
+                       "swap_readmit_p95_ms": round(
+                           self._pct(self.swap_readmit_s, 95) * 1000, 3),
+                   }.items())]
                 + [(f"{p}faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])
 

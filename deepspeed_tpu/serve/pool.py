@@ -344,8 +344,12 @@ class EnginePool:
     def load_weights(self, replica_id: int, params,
                      version=None) -> None:
         """Swap a DRAINED replica's weights (same pytree shapes — zero
-        recompilation; the engine flushes its prefix cache so no KV from
-        the old weights survives)."""
+        recompilation). ``engine.load_params`` flushes the prefix cache
+        across BOTH tiers and drops the swap store: a device-only flush
+        would let a later index hit promote stale old-weights KV back
+        from host RAM, or a swap-in re-admit a victim's old-weights
+        blocks — the silent-wrong-logits failure mode the v1→v2 rolling
+        update regression test plants."""
         rep = self.replica(replica_id)
         if rep.state != DRAINING:
             raise EngineUsageError(

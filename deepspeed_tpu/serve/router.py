@@ -7,7 +7,10 @@ lands where its KV blocks already live, so the router scores every
 serving replica by **exact prefix affinity**: the replica's engine walks
 its chained content index over the prompt's leading full blocks
 (``InferenceEngineV2.prefix_probe`` — read-only, no refcount or LRU
-perturbation) and reports how many it holds. Highest hit count wins;
+perturbation) and reports how many it holds. With a host KV tier the
+probe counts demoted blocks too (docs/PREFIX_CACHING.md "Two-tier
+cache"): a prefix parked in host RAM is one batched promotion away, so
+it scores the same as device-resident content. Highest hit count wins;
 zero-hit placements (and ``affinity=False``, the A/B baseline) fall back
 to **least-loaded** (live + queued requests); remaining ties break on the
 lowest replica id.

@@ -35,7 +35,16 @@ The engine exposes the mechanism (``put`` / ``decode_step`` / ``flush`` /
   (flush parks them in the LRU) so the replay maps them straight back into
   the block table at near-zero cost. Greedy decoding makes the round trip
   bitwise-lossless: the re-admitted request continues with exactly the
-  tokens an unpreempted run would have produced.
+  tokens an unpreempted run would have produced. On an engine with a host
+  KV tier (``host_tier_blocks > 0``, docs/PREFIX_CACHING.md "Two-tier
+  cache") a swap-vs-recompute cost model picks the cheaper exit per
+  victim: ``engine.swap_out`` parks the victim's KV in host RAM so
+  re-admission is one batched host->device block copy (``swap_in``)
+  instead of a prompt replay — swap wins when
+  ``2 x blocks x block_bytes x s_per_byte_EMA <
+  replay_tokens x token_EMA``. ``swap_preemption`` forces either path;
+  the swap store is a cache, never a source of truth: a rebuild drops it
+  and re-admission falls back to the journal replay unchanged.
 - **failure containment** (docs/RESILIENCE.md): engine faults are typed
   (``deepspeed_tpu.resilience.errors``) and no longer unwind the whole
   serving loop. Transient faults are retried with bounded exponential
@@ -150,7 +159,8 @@ class ContinuousBatchScheduler:
                  journal: Optional[RequestJournal] = None,
                  recovery: Optional[RecoveryPolicy] = None,
                  replica_id: Optional[int] = None,
-                 escalate_losses: bool = False):
+                 escalate_losses: bool = False,
+                 swap_preemption: Optional[bool] = None):
         self.engine = engine
         #: pool membership (docs/SERVING.md engine pool): ``replica_id``
         #: labels this scheduler's metrics/events so N replicas never alias
@@ -212,6 +222,14 @@ class ContinuousBatchScheduler:
             self.spec = (proposer if isinstance(proposer, SpecPolicy)
                          else SpecPolicy(proposer))
         self._token_est_s = 0.0  # EMA per-token dispatch wall (deadline guard)
+        # swap-based preemption (docs/PREFIX_CACHING.md "Two-tier cache"):
+        # None = cost model (per victim, needs a host tier), True = always
+        # swap when the engine can, False = always flush+replay. The
+        # bandwidth EMA is seconds/byte measured around engine.swap_in (the
+        # one designed host sync on this path); it starts empty and the
+        # first swap in auto mode is the probe that fills it.
+        self.swap_preemption = swap_preemption
+        self._swap_s_per_byte = 0.0
         self.max_queue = max_queue
         self.age_weight = age_weight
         self.deadline_weight = deadline_weight
@@ -327,6 +345,12 @@ class ContinuousBatchScheduler:
         if uid in self._live:
             self._engine_preempt(uid)  # absorbs an engine loss (recorded)
             self._live.pop(uid, None)
+        else:
+            # a swap-preempted victim waiting in the queue still owns a
+            # host-side swap entry on THIS engine; flush drops it (silent
+            # no-op otherwise). Swap payloads never cross engines — the
+            # adopting scheduler replays from the journal entry.
+            self._engine_flush(uid)
         if req.state in (RequestState.PREFILL, RequestState.DECODE):
             # the legal eviction edge; the adopting side walks
             # PREEMPTED -> QUEUED (QUEUED/PREEMPTED requests ride as-is)
@@ -437,6 +461,25 @@ class ContinuousBatchScheduler:
                 return 0
             except TransientEngineError as e:
                 if not self._retry_transient("preempt", attempt, e):
+                    raise
+                attempt += 1
+
+    def _engine_swap_out(self, uid: int) -> bool:
+        """``engine.swap_out`` with the same fault contract as
+        ``_engine_preempt``: an engine loss is absorbed (the victim replays
+        from the journal after recovery — the swap entry would have died
+        with the incarnation anyway), transients retry. False means the
+        engine declined (pending prefill tokens, uncommitted speculation,
+        no tier) and the caller takes the flush+replay path."""
+        attempt = 0
+        while True:
+            try:
+                return self.engine.swap_out(uid)
+            except UnrecoverableEngineError as e:
+                self._note_engine_lost(e)
+                return False
+            except TransientEngineError as e:
+                if not self._retry_transient("swap_out", attempt, e):
                     raise
                 attempt += 1
 
@@ -614,15 +657,54 @@ class ContinuousBatchScheduler:
                                          -self._blocks_held(r.uid),
                                          len(r.tokens)))
 
+    def _swap_wins(self, req: Request, held: int) -> bool:
+        """Swap-vs-recompute cost model (docs/PREFIX_CACHING.md "Two-tier
+        cache"). Swapping moves the victim's KV across the interconnect
+        twice (out now, back in at re-admission); recompute replays
+        ``prompt + generated`` through prefill. Per victim:
+
+            swap:      2 x held x block_bytes x s_per_byte_EMA
+            recompute: len(replay_tokens) x token_EMA
+
+        ``swap_preemption`` True/False forces the path. In auto mode an
+        empty token EMA (nothing decoded yet) means no evidence recompute
+        is expensive — replay; an empty bandwidth EMA with a live token EMA
+        takes one swap as the probe that measures it."""
+        if not getattr(self.engine, "host_tier_blocks", 0):
+            return False
+        if self.swap_preemption is False:
+            return False
+        # only a fully-prefilled, decoded-at-least-once victim has swappable
+        # at-rest KV; mid-prefill victims (pending engine-side tokens) replay
+        if held == 0 or req.state is not RequestState.DECODE:
+            return False
+        if self.swap_preemption:
+            return True
+        if self._token_est_s == 0.0:
+            return False
+        if self._swap_s_per_byte == 0.0:
+            return True  # bandwidth probe: the swap_in measures the EMA
+        swap_s = (2.0 * held * getattr(self.engine, "block_bytes", 0)
+                  * self._swap_s_per_byte)
+        recompute_s = len(req.replay_tokens()) * self._token_est_s
+        return swap_s < recompute_s
+
     def _preempt(self, req: Request) -> None:
-        freed = self._engine_preempt(req.uid)
+        held = self._blocks_held(req.uid)
+        swapped = self._swap_wins(req, held) and self._engine_swap_out(
+            req.uid)
+        freed = held if swapped else self._engine_preempt(req.uid)
+        if getattr(self.engine, "host_tier_blocks", 0):
+            self.metrics.observe_swap_preemption(swapped)
         self._live.pop(req.uid, None)
         req.state = RequestState.PREEMPTED
         req.preemptions += 1
         self.metrics.preemptions += 1
         self.metrics.preempted_blocks_reclaimed += freed
-        logger.debug("serve: preempted uid %d (freed %d blocks, %d generated)",
-                     req.uid, freed, len(req.tokens))
+        logger.debug("serve: preempted uid %d (%s, freed %d blocks, %d "
+                     "generated)", req.uid,
+                     "swapped" if swapped else "flushed", freed,
+                     len(req.tokens))
         # PREEMPTED -> QUEUED: original arrival time is kept, so the victim
         # carries its full age into re-admission scoring (anti-thrash)
         req.state = RequestState.QUEUED
@@ -674,8 +756,81 @@ class ContinuousBatchScheduler:
                     return
                 self._preempt(victim)
                 continue  # re-check capacity; may need more than one victim
+            if (getattr(self.engine, "host_tier_blocks", 0)
+                    and self.engine.swap_resident(best.uid)):
+                # a swap-preempted victim re-admits by block copy, but only
+                # once its full at-rest footprint PLUS one growth block fit
+                # — restoring into an exactly-full pool re-creates the very
+                # pressure that evicted it (readmit→exhaust→preempt, no row
+                # ever advancing). While live decodes are draining the pool
+                # organically, hold the restore; if nothing is decoding (or
+                # the footprint can never fit), fall through and let
+                # _swap_in_readmit's gate drop the entry onto the replay
+                # path, which allocates lazily and defers under pressure.
+                mgr = self.engine.block_mgr
+                need = mgr.blocks_needed(
+                    len(best.prompt) + len(best.tokens)) + 1
+                if (mgr.free_blocks < need
+                        and need <= mgr.num_blocks - 1
+                        and any(r.state is RequestState.DECODE
+                                for r in self._live.values())):
+                    return
             self._queue.remove(best)
             self._start(best, now)
+
+    def _swap_in_readmit(self, req: Request) -> bool:
+        """Re-admit a swap-preempted victim by block copy: ``engine.swap_in``
+        restores the at-rest KV (one batched device_put) and the request
+        resumes decoding exactly where it left off — no replay dispatch at
+        all. The transfer wall clock feeds the bandwidth EMA the cost model
+        runs on (``swap_in``'s materialization is the designed host sync on
+        this path, so measuring around it is honest). False — the entry died
+        with a rebuild, or the pool can't hold the blocks right now — falls
+        back to the normal replay admission; transients retry, a loss is
+        recorded and the replay path surfaces it.
+
+        Headroom gate: the restore is refused unless the pool holds the
+        victim's at-rest blocks PLUS one to grow into. A swap-in that
+        exactly fills the pool guarantees the next block-boundary crossing
+        re-preempts someone before any row advances — the
+        readmit→exhaust→preempt livelock. Replay has no such failure mode
+        (chunked prefill allocates lazily and defers under pressure), so
+        under that much pressure the entry is dropped and recompute wins
+        regardless of what the byte-cost model says."""
+        mgr = getattr(self.engine, "block_mgr", None)
+        if mgr is not None:
+            need = mgr.blocks_needed(len(req.prompt) + len(req.tokens))
+            if mgr.free_blocks < need + 1:
+                self._engine_flush(req.uid)  # drop the cached swap entry
+                return False
+        attempt = 0
+        while True:
+            try:
+                t0 = time.perf_counter()
+                ok = self.engine.swap_in(req.uid)
+                break
+            except UnrecoverableEngineError as e:
+                self._note_engine_lost(e)
+                return False
+            except TransientEngineError as e:
+                if not self._retry_transient("swap_in", attempt, e):
+                    raise
+                attempt += 1
+        if not ok:
+            return False
+        dt = time.perf_counter() - t0
+        nbytes = self._blocks_held(req.uid) * getattr(
+            self.engine, "block_bytes", 0)
+        if nbytes and dt > 0:
+            spb = dt / nbytes
+            self._swap_s_per_byte = (
+                spb if self._swap_s_per_byte == 0.0
+                else 0.5 * self._swap_s_per_byte + 0.5 * spb)
+            self.metrics.observe_swap_readmit(dt, 1.0 / self._swap_s_per_byte)
+        req.state = RequestState.DECODE
+        logger.debug("serve: swap-in re-admitted uid %d (%d blocks, %.3fms)",
+                     req.uid, self._blocks_held(req.uid), dt * 1e3)
+        return True
 
     def _start(self, req: Request, now: float) -> None:
         req.state = RequestState.PREFILL
@@ -683,6 +838,10 @@ class ContinuousBatchScheduler:
             req.admitted_time = now
         self._live[req.uid] = req
         self.metrics.admitted += 1
+        if (getattr(self.engine, "host_tier_blocks", 0)
+                and self.engine.swap_resident(req.uid)
+                and self._swap_in_readmit(req)):
+            return  # resumed in place: next decode round feeds tokens[-1]
         if self.chunked_prefill:
             # register + prefix-cache lookup only (max_steps=0): the
             # prompt's chunks ride this step's mixed dispatch and onward —
@@ -1122,6 +1281,8 @@ class ContinuousBatchScheduler:
         self.metrics.observe_prefill_backlog(self._prefill_backlog())
         self.metrics.observe_resilience(self.breaker, self.watchdog)
         self.metrics.faults["journal_live"] = float(len(self.journal))
+        if getattr(self.engine, "host_tier_blocks", 0):
+            self.metrics.observe_kvtier(self.engine.prefix_cache_stats())
         if _sanitizer.sanitize_enabled():
             # checked mode (docs/ANALYSIS.md): between steps, every pending
             # backlog row must belong to a live request and every live
@@ -1131,6 +1292,9 @@ class ContinuousBatchScheduler:
             # rolled back — uncommitted draft positions crossing a step
             # boundary would let the prefix index cover unverified tokens
             _sanitizer.check_speculation_commit(self.engine)
+            # with a host tier: every block in exactly one tier state, and
+            # demoted index entries must resolve through the host tier
+            _sanitizer.check_tier_conservation(self.engine)
         return bool(self._queue or self._live)
 
     def run_until_complete(self) -> None:

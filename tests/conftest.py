@@ -148,6 +148,7 @@ _SANITIZE_FILES = (
     "test_train_chaos_soak.py",
     "test_pool.py",
     "test_journal_durability.py",
+    "test_kv_tier.py",
 )
 
 
