@@ -40,6 +40,15 @@ verification vs the K=8 fused decode baseline, on a drafting-friendly
 single-stream workload (the ISSUE 8 >2.5x gate) and a natural batched one,
 tokens bitwise-asserted and ``serve/spec/*`` acceptance counters reported.
 
+The ``sampling`` row is stochastic decoding's acceptance A/B
+(docs/SAMPLING.md): the same batched workload greedy vs per-request
+temperature/top-p sampling (tokens/s delta at held compiled-program
+bounds), a replay twin under one seeded engine loss that must reproduce
+the sampled tokens bitwise (journaled ``SamplingParams`` + counter-based
+keys), and speculation under temperature at three target entropies
+(top_k ∈ {1, 2, ∞}) with the honest acceptance-rate column, every arm
+token-for-token vs its non-speculative sampled stream.
+
 The ``pool_scaling`` row is the engine pool's acceptance A/B
 (docs/SERVING.md "Engine pool"): one shared-prefix workload served at
 N ∈ {1, 2, 4} data-parallel replicas behind the prefix-affinity router,
@@ -76,7 +85,7 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
              breaker=None, retry=None, watchdog=None, on_submitted=None,
              collect_tokens=False, prompts=None, arrivals=None,
              gen_targets=None, chunked_prefill=None, proposer=None,
-             swap_preemption=None):
+             swap_preemption=None, sampling=None):
     """Drive the engine with Poisson arrivals until all requests finish —
     through ``ContinuousBatchScheduler``, so the bench exercises the
     production admit/preempt/decode path (docs/SERVING.md), not a private
@@ -102,7 +111,11 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     counters are reported under ``"spec"``. ``swap_preemption`` forwards to
     the scheduler (None = the auto swap-vs-recompute cost model); on a
     host-tiered engine the ``serve/kvtier`` counters and swap re-admission
-    percentiles are reported under ``"kvtier"``.
+    percentiles are reported under ``"kvtier"``. ``sampling`` is an
+    optional per-request sequence of ``SamplingParams`` (or None entries)
+    forwarded to ``submit`` — the stochastic-decoding workload
+    (docs/SAMPLING.md); the ``serve/sampling`` counters are reported under
+    ``"sampling"``.
     """
     import jax
 
@@ -142,7 +155,8 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     for i in range(n_requests):
         reqs.append(sched.submit(
             prompts[i], max_new_tokens=int(gen_targets[i]),
-            priority=int(prios[i]), arrival_time=float(arrivals[i])))
+            priority=int(prios[i]), arrival_time=float(arrivals[i]),
+            sampling=None if sampling is None else sampling[i]))
     if on_submitted is not None:
         on_submitted(sched, reqs)
     while sched.step():
@@ -176,6 +190,10 @@ def run_load(engine, *, n_requests, arrival_rate, rng, prompt_lo=32,
     if proposer is not None:
         # speculative-decoding acceptance accounting (serve/spec/*)
         out["spec"] = {k: float(v) for k, v in sched.metrics.spec.items()}
+    if sampling is not None and any(s is not None for s in sampling):
+        # stochastic-decoding accounting (serve/sampling/*)
+        out["sampling"] = {k: float(v)
+                           for k, v in sched.metrics.sampling.items()}
     if getattr(engine, "host_tier_blocks", 0):
         # two-tier cache traffic + the preemption-path split (serve/kvtier/*)
         out["kvtier"] = {k: float(v) for k, v in sched.metrics.kvtier.items()}
@@ -624,6 +642,211 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_sampling(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The stochastic-decoding acceptance row (docs/SAMPLING.md): per-request
+    sampling vs the greedy baseline, replay determinism under an engine
+    loss, and speculation under temperature — four arms on one micro model.
+
+    - ``greedy`` vs ``sampled``: the SAME batched workload (``max_seqs``
+      random prompts, fused K=8 decode) run greedy and then with
+      per-request ``SamplingParams(temperature=0.8, top_p=0.9, seed=...)``.
+      The delta is the device-side cost of the sampling path (bias add +
+      top-k/top-p filter + categorical draw per committed token) — the
+      guardrail that sampling stays a runtime branch, not a recompile:
+      both arms must hold the same compiled-program bounds.
+    - ``replay twin``: the sampled workload re-run under one seeded
+      whole-engine death (``device_lost`` mid-load). The journal persists
+      each request's ``SamplingParams`` (``record.v2``) and replay re-folds
+      the same counter-based keys, so the faulted run must reproduce the
+      fault-free sampled tokens BITWISE — the acceptance gate for
+      stochastic replay (docs/SAMPLING.md "Replay determinism").
+    - ``spec under temperature``: the drafting-friendly single-stream
+      repetition shape from the ``spec_decode`` row, decoded at
+      temperature 0.8 with prompt-lookup drafting + rejection-sampling
+      verification, at three target entropies (top_k ∈ {1, 2, ∞}).
+      Deterministic specialization means spec-on must match the
+      non-speculative sampled stream token for token (same seed, same
+      positions, same keys) in EVERY arm; the reported column is the
+      honest acceptance rate per arm — ~1 when the constrained target
+      collapses to argmax (the draft source), falling with target entropy
+      to ~0 unconstrained. Speculation under sampling is a pure
+      throughput lever: it may only change tokens/s, never the stream.
+
+    Same micro-model regime as ``decode_horizon``/``spec_decode`` (host
+    overhead comparable to device compute); warmup passes pay every compile
+    off the clock, measured numbers are best-of-3."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.resilience import (CircuitBreaker, FaultInjector,
+                                          RetryPolicy, StepWatchdog)
+    from deepspeed_tpu.serve import PromptLookupProposer
+    from deepspeed_tpu.serve.sampling import SamplingParams
+
+    cfg = gpt2_config("125m", max_seq_len=512, hidden_size=128, num_layers=2,
+                      num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    K = 8
+
+    def engine(n_seqs, k=K):
+        return InferenceEngineV2(
+            model, params, max_seqs=n_seqs, max_seq_len=512,
+            prefill_chunk=64, dtype=jnp.bfloat16, paged=True, block_size=32,
+            token_budget=64, num_blocks=1 + n_seqs * 16, decode_horizon=k,
+            prefix_cache=prefix_cache)
+
+    def measure(eng, prompts, gens, sampling=None, passes=3, proposer=None):
+        best = None
+        for i in range(passes + 1):  # pass 0 = warmup (compiles, cold cache)
+            for uid in list(eng.state.seqs):
+                eng.flush(uid)
+            r = run_load(eng, n_requests=len(prompts), arrival_rate=1e9,
+                         rng=np.random.default_rng(3),
+                         prompts=[list(p) for p in prompts],
+                         arrivals=np.zeros(len(prompts)),
+                         gen_targets=np.asarray(gens, dtype=int),
+                         collect_tokens=True, sampling=sampling,
+                         proposer=proposer)
+            if i and (best is None or r["tokens_per_s"] > best["tokens_per_s"]):
+                best = r
+        toks = best.pop("request_tokens")
+        best.pop("request_states")
+        return best, toks
+
+    rng = np.random.default_rng(37)
+
+    # --- greedy vs sampled A/B: max_seqs concurrent random prompts, fused
+    # K=8 decode, identical workload both arms ---
+    prompts = [rng.integers(0, 1024, int(rng.integers(32, 129))).tolist()
+               for _ in range(max_seqs)]
+    gens = [96] * max_seqs
+    sp = [SamplingParams(temperature=0.8, top_p=0.9, seed=100 + i)
+          for i in range(max_seqs)]
+    eng = engine(max_seqs)
+    greedy, greedy_toks = measure(eng, prompts, gens)
+    sampled, sampled_toks = measure(eng, prompts, gens, sampling=sp)
+    # sampling must actually sample (any tie-free logit row diverges from
+    # argmax almost surely at temperature 0.8)
+    assert sampled_toks != greedy_toks
+    assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1 \
+        and eng.verify_cache_size <= 1, (
+            eng.ragged_cache_size, eng.fused_cache_size,
+            eng.verify_cache_size)
+    programs = (eng.ragged_cache_size + eng.fused_cache_size
+                + eng.verify_cache_size)
+
+    # --- replay twin: same sampled workload, one seeded engine death; the
+    # journal carries SamplingParams (record.v2) so the rebuilt engine's
+    # replay must land on the SAME counter-based keys → bitwise tokens ---
+    rebuilds_before = eng.rebuilds
+    injector = FaultInjector(seed=41)
+    injector.inject(site="put", kind="device_lost", nth=3)
+    faulted = run_load(
+        eng, n_requests=len(prompts), arrival_rate=1e9,
+        rng=np.random.default_rng(3), prompts=[list(p) for p in prompts],
+        arrivals=np.zeros(len(prompts)),
+        gen_targets=np.asarray(gens, dtype=int), collect_tokens=True,
+        sampling=sp, fault_injector=injector,
+        breaker=CircuitBreaker(failure_threshold=3, cooldown_s=0.5,
+                               shed_priority_floor=1),
+        retry=RetryPolicy(max_attempts=5, base_s=0.005, cap_s=0.05, seed=7),
+        watchdog=StepWatchdog())
+    faulted_toks = faulted.pop("request_tokens")
+    faulted_states = faulted.pop("request_states")
+    replay_bitwise = (all(s == "done" for s in faulted_states)
+                      and faulted_toks == sampled_toks)
+    deaths = injector.deaths
+    rebuilds = eng.rebuilds - rebuilds_before
+    del eng
+    gc.collect()
+
+    # --- spec under temperature: single repetition stream (prompt seeded
+    # with the model's own greedy continuation, off the clock), sampled at
+    # temperature 0.8 with and without prompt-lookup drafting ---
+    base = [rng.integers(0, 1024, 16).tolist()]
+    eng_p = engine(1)
+    _, pilot = measure(eng_p, base, [48], passes=1)
+    rep_prompts = [base[0] + pilot[0]]
+    del eng_p
+    gc.collect()
+    GEN = 160  # a multiple of both horizons: no partial-round tail
+    spec_by_arm = {}
+    spec_parity = True
+    eng_s = engine(1, k=16)
+    # acceptance tracks the ENTROPY of the target distribution, not the
+    # temperature knob per se: on this random-init micro model the logits
+    # are nearly flat, so any real temperature diverges from the prompt's
+    # greedy continuation immediately (acceptance ~0). Narrowing top-k at
+    # the same temperature walks the target from flat to argmax and the
+    # acceptance column with it — top_k=1 is the argmax-equivalent stream
+    # (draft source matches, acceptance ~1), top_k=2 a coin flip per
+    # token, unconstrained the honest worst case.
+    for label, arm_sp in (
+            ("top_k=1", SamplingParams(temperature=0.8, top_k=1, seed=31)),
+            ("top_k=2", SamplingParams(temperature=0.8, top_k=2, seed=31)),
+            ("unconstrained", SamplingParams(temperature=0.8, seed=31))):
+        rep_plain, rep_plain_toks = measure(eng_s, rep_prompts, [GEN],
+                                            sampling=[arm_sp])
+        rep_spec, rep_spec_toks = measure(eng_s, rep_prompts, [GEN],
+                                          sampling=[arm_sp],
+                                          proposer=PromptLookupProposer())
+        spec_parity = spec_parity and rep_spec_toks == rep_plain_toks
+        spec_by_arm[label] = {
+            "non_spec": rep_plain, "speculative": rep_spec,
+            "tokens_token_for_token": rep_spec_toks == rep_plain_toks,
+            "acceptance_rate": rep_spec["spec"]["acceptance_rate"],
+        }
+    assert eng_s.ragged_cache_size <= 4 and eng_s.fused_cache_size <= 1 \
+        and eng_s.verify_cache_size <= 1, (
+            eng_s.ragged_cache_size, eng_s.fused_cache_size,
+            eng_s.verify_cache_size)
+    del eng_s
+    gc.collect()
+
+    # acceptance gates: stochastic replay is bitwise, speculation under
+    # temperature is a pure throughput lever (never changes the stream)
+    assert deaths >= 1 and rebuilds == deaths, (deaths, rebuilds)
+    assert replay_bitwise
+    assert spec_parity
+    ratio = (sampled["tokens_per_s"] / greedy["tokens_per_s"]
+             if greedy["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "sampling", prefix_cache),
+        "value": sampled["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(ratio, 2) if ratio else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-spec-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': 1024} "
+                      "ctx=512 (host-overhead-bound decode)"),
+            "workload": (f"A/B: {max_seqs} random prompts U[32,128], gen 96, "
+                         "fused K=8, greedy vs temperature 0.8 / top-p 0.9 "
+                         "per-request seeds; replay twin: sampled workload "
+                         "under 1 seeded device_lost; spec: 1 repetition "
+                         f"stream, gen {GEN}, temperature 0.8 at top_k in "
+                         "{1, 2, inf}, prompt-lookup K=16 vs non-spec "
+                         "sampled"),
+            "greedy": greedy, "sampled": sampled,
+            "sampled_vs_greedy_tokens_per_s": round(ratio, 3)
+            if ratio else None,
+            "replay_twin": {
+                "faulted": faulted, "engine_deaths": deaths,
+                "engine_rebuilds": rebuilds,
+                "tokens_bitwise_identical": replay_bitwise,
+            },
+            "spec_under_temperature": spec_by_arm,
+            "acceptance_rate_by_arm": {
+                k: v["acceptance_rate"] for k, v in spec_by_arm.items()},
+            "compiled_programs": programs,
+        },
+    }
+
+
 def run_prefill_convoy(max_seqs: int, prefix_cache: bool = True) -> dict:
     """The chunked-prefill acceptance row (docs/SERVING.md): a handful of
     long prompts (U[1024, 2048]) arriving into a live decode batch, with a
@@ -1065,6 +1288,13 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       the K=8 fused baseline on a drafting-friendly single stream (the
       >2.5x ISSUE 8 gate) plus a natural batched workload, both greedy and
       bitwise-asserted, with ``serve/spec/*`` acceptance counters.
+    - ``sampling``: the stochastic-decoding acceptance A/B
+      (docs/SAMPLING.md): greedy vs per-request temperature/top-p on the
+      same workload (tokens/s delta, compiled-program bounds held), a
+      bitwise replay twin under one seeded engine loss, and speculation
+      under temperature at top_k ∈ {1, 2, ∞} with its acceptance-rate
+      column, every arm token-for-token vs the non-speculative sampled
+      stream.
     - ``pool_scaling``: the engine-pool acceptance A/B (docs/SERVING.md
       "Engine pool"): a shared-prefix workload on an ``EnginePool`` at
       N ∈ {1, 2, 4} replicas (``max_seqs`` seats each) — aggregate
@@ -1113,6 +1343,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_prefill_convoy(max_seqs, prefix_cache)
     if workload == "spec_decode":
         return run_spec_decode(max_seqs, prefix_cache)
+    if workload == "sampling":
+        return run_sampling(max_seqs, prefix_cache)
     if workload == "pool_scaling":
         return run_pool_scaling(max_seqs, prefix_cache)
     if workload == "kv_tier":
@@ -1256,6 +1488,7 @@ CONFIGS = (
     ("paged", 4, "decode_horizon", True),
     ("paged", 16, "prefill_convoy", True),
     ("paged", 4, "spec_decode", True),
+    ("paged", 4, "sampling", True),
     ("paged", 4, "pool_scaling", True),
 )
 

@@ -21,7 +21,13 @@ families over the tree:
   ``bool()`` concretization of traced values.
 - **DSTPU005** nondeterminism in scheduler/resilience decision logic:
   ``time.time()``, unseeded ``random.*`` / global ``np.random.*`` state,
-  and direct iteration over sets.
+  and direct iteration over sets. Additionally, across the
+  serve/inference/resilience layers, ``jax.random.PRNGKey``/``split``
+  calls whose key material flows from wall clock, process entropy, or
+  global RNG state — sampled decoding's bitwise-replay contract
+  (docs/SAMPLING.md) requires counter-based keys
+  (``fold_in(PRNGKey(seed), position)``), which the check recognizes as
+  safe (constants, carried names, and ``fold_in`` chains never flag).
 
 Suppression is two-tier: an inline ``# dstpu-lint: ignore[DSTPU00X]``
 pragma on the flagged line for sites whose justification belongs in the
@@ -36,8 +42,10 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .rules import (ALLOC_NAMES, ARRAY_ROOTS, HOT_FUNCTIONS, RULES,
-                    SEEDED_RNG, SYNC_ATTRS, SYNC_DOTTED, UNTYPED_RAISES)
+from .rules import (ALLOC_NAMES, ARRAY_ROOTS, HOT_FUNCTIONS,
+                    KEY_HAZARD_CALLS, RNG_KEY_BASES, RNG_KEY_SCOPE, RULES,
+                    SEEDED_RNG, STDLIB_RANDOM_LEAVES, SYNC_ATTRS,
+                    SYNC_DOTTED, UNTYPED_RAISES)
 
 _PRAGMA = re.compile(r"#\s*dstpu-lint:\s*ignore(?:\[([A-Za-z0-9,\s]+)\])?")
 
@@ -369,7 +377,46 @@ class _FileLint(ast.NodeVisitor):
                 self._emit(node, "DSTPU005",
                            f"global-state RNG `{d}(...)` — use a seeded "
                            "np.random.default_rng instance")
+
+        if "DSTPU005" in self.rule_ids and d is not None:
+            # jax PRNG-key determinism check (docs/SAMPLING.md): its own
+            # scope — key hygiene matters wherever sampled decode runs,
+            # not just where scheduling decisions live
+            base, _, leaf = d.rpartition(".")
+            if (leaf in ("PRNGKey", "split", "key") and base in RNG_KEY_BASES
+                    and _in_scope(self.parts, RNG_KEY_SCOPE)):
+                hazard = self._key_material_hazard(node)
+                if hazard is not None:
+                    self._emit(node, "DSTPU005",
+                               f"`{d}(...)` key material flows from "
+                               f"nondeterministic `{hazard}(...)` — sampled "
+                               "tokens could never replay bitwise; derive "
+                               "keys counter-based: "
+                               "fold_in(PRNGKey(request_seed), position)")
         self.generic_visit(node)
+
+    @staticmethod
+    def _key_material_hazard(node: ast.Call) -> Optional[str]:
+        """First nondeterministic source call found in the key-material
+        argument expressions of a PRNGKey/split call, or None. Constants,
+        carried names, arithmetic, and counter-based ``fold_in`` chains
+        all pass — only a hazard CALL in the dataflow flags."""
+        for arg in (*node.args, *(kw.value for kw in node.keywords)):
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Call):
+                    continue
+                sd = _dotted(sub.func)
+                if sd is None:
+                    continue
+                if sd in KEY_HAZARD_CALLS:
+                    return sd
+                root, _, sleaf = sd.partition(".")
+                if root == "random" and sleaf in STDLIB_RANDOM_LEAVES:
+                    return sd
+                if (sd.startswith(("np.random.", "numpy.random."))
+                        and sd.split(".")[-1] not in SEEDED_RNG):
+                    return sd
+        return None
 
     def visit_Raise(self, node: ast.Raise) -> None:
         if self._enabled("DSTPU003") and node.exc is not None:

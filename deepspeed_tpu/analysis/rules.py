@@ -100,6 +100,39 @@ SEEDED_RNG: FrozenSet[str] = frozenset({
     "default_rng", "Generator", "SeedSequence", "PCG64", "Philox",
 })
 
+#: DSTPU005's jax PRNG-key check (docs/SAMPLING.md): in the serving /
+#: inference layers, ``jax.random.PRNGKey``/``split`` key material must be
+#: replay-derivable — a constant, a carried seed, or a counter-based
+#: ``fold_in(PRNGKey(seed), position)`` chain. Key material that flows
+#: from wall clock, process entropy, or global RNG state makes every
+#: sampled token irreproducible across preempt/re-admit, journal replay,
+#: engine rebuild, pool migration, and KV swap-in — silently, because the
+#: greedy paths stay bitwise.
+RNG_KEY_SCOPE = ("serve", "inference", "resilience")
+#: module spellings a flagged ``PRNGKey``/``split`` call may hang off
+#: (plain ``random.split`` is string .split in disguise only when the
+#: base is not a Name — the linter resolves dotted chains, so ``"a,b"
+#: .split`` never reaches this set)
+RNG_KEY_BASES: FrozenSet[str] = frozenset({
+    "jax.random", "jrandom", "jr", "random",
+})
+#: nondeterministic key-material sources: any of these calls appearing in
+#: the argument expression of a PRNGKey/split call is a finding
+KEY_HAZARD_CALLS: FrozenSet[str] = frozenset({
+    "time.time", "time.time_ns", "time.perf_counter", "time.monotonic",
+    "os.urandom", "os.getrandom", "os.getpid",
+    "uuid.uuid1", "uuid.uuid4",
+    "secrets.token_bytes", "secrets.randbits", "secrets.randbelow",
+    "id", "hash",
+})
+#: stdlib-``random`` leaves treated as hazardous key material (the jax
+#: alias spelling ``random.fold_in``/``random.PRNGKey`` is NOT in here —
+#: counter-based derivation is exactly the safe pattern)
+STDLIB_RANDOM_LEAVES: FrozenSet[str] = frozenset({
+    "random", "randint", "randrange", "getrandbits", "randbytes",
+    "uniform", "choice", "gauss", "betavariate", "expovariate",
+})
+
 RULES: Dict[str, Rule] = {r.id: r for r in (
     Rule(
         id="DSTPU001",
@@ -138,8 +171,10 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
         id="DSTPU005",
         title="nondeterminism in scheduler/resilience decision logic",
         hint="use the injectable clock (time.monotonic default), a seeded "
-             "np.random.default_rng, and ordered containers — decisions "
-             "must replay bit-for-bit (docs/ANALYSIS.md#dstpu005)",
+             "np.random.default_rng, ordered containers, and counter-based "
+             "jax PRNG keys (fold_in(PRNGKey(seed), position), "
+             "docs/SAMPLING.md) — decisions and sampled tokens must replay "
+             "bit-for-bit (docs/ANALYSIS.md#dstpu005)",
         scope=DECISION_SCOPE,
     ),
 )}

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...analysis.sanitizer import checked_cache_cls, sanitize_enabled
+from ...models.transformer import sample_or_argmax
 from ...resilience.errors import (ContextOverflowError, EngineUsageError,
                                   PoolExhaustedError)
 from ...utils.logging import log_dist
@@ -124,6 +125,19 @@ class InferenceEngineV2:
         self._swaps: Dict[int, Tuple] = {}
         self.swap_stats = {"swap_out": 0, "swap_in": 0,
                            "swap_out_blocks": 0, "swap_in_blocks": 0}
+        # per-request sampling (docs/SAMPLING.md): duck-typed params records
+        # (the engine reads .seed/.temperature/.top_k/.top_p — it never
+        # imports serve) ride every greedy-mode dispatch as RUNTIME per-row
+        # arrays, so sampled rows add zero compiled traces. Bias rows are
+        # device-resident in a (max_seqs, V) per-SLOT pool updated only at
+        # (re-)registration through one traced-slot scatter program — the
+        # steady-state decode loop ships no bias bytes.
+        self._sampling: Dict[int, object] = {}
+        self._bias_rows: Dict[int, np.ndarray] = {}   # uid -> host row
+        self._bias_slots: Dict[int, int] = {}         # uid -> installed slot
+        self._bias_pool = None                        # lazy (max_seqs, V) f32
+        self._bias_set_fn = None
+        self._bias_zero: Optional[np.ndarray] = None
         if paged:
             # paged-block pool (reference BlockedKVCache): total KV memory is
             # num_blocks*block_size tokens shared across sequences instead of
@@ -300,20 +314,27 @@ class InferenceEngineV2:
             return self._prefill_fns["ragged"]
         model = self.model
 
-        def ragged(params, pool, ids, tables, starts, logit_rows, greedy):
+        def ragged(params, pool, ids, tables, starts, logit_rows,
+                   slots, seeds, poss, temps, top_ks, top_ps, bias_pool,
+                   greedy):
             # ids (T, 1): every row is its own length-1 "sequence" against the
             # shared pool; only the (max_seqs,) logit_rows are projected
             # through the vocab head (reference ragged_ops/logits_gather)
             lg, pool = model.forward_paged(params, ids, pool, tables, starts,
                                            logit_rows=logit_rows)
             if greedy:
-                # device-side greedy sampling: ship (R,) token ids instead of
+                # device-side token selection: ship (R,) token ids instead of
                 # (R, V) fp32 logits — the host↔device transfer is the serving
-                # loop's latency floor on remote-device transports
-                return jnp.argmax(lg, axis=-1).astype(jnp.int32), pool
+                # loop's latency floor on remote-device transports. Sampling
+                # params are RUNTIME per-row arrays (all-zero = plain argmax,
+                # bit-identical to the legacy greedy program; a batch-level
+                # cond inside sample_or_argmax skips the sampling math when
+                # every row is greedy), so sampled traffic adds no trace.
+                return sample_or_argmax(lg + bias_pool[slots], seeds, poss,
+                                        temps, top_ks, top_ps), pool
             return lg, pool
 
-        fn = jax.jit(ragged, donate_argnums=(1,), static_argnums=(6,))
+        fn = jax.jit(ragged, donate_argnums=(1,), static_argnums=(13,))
         self._prefill_fns["ragged"] = fn
         return fn
 
@@ -497,23 +518,32 @@ class InferenceEngineV2:
         desc.n_indexed = 0
         if self.prefix_cache:
             self.block_mgr.register(desc)
+        if self._bias_rows:
+            self._install_bias(desc)  # re-bind bias to the fresh slot
         self.swap_stats["swap_in"] += 1
         self.swap_stats["swap_in_blocks"] += len(payloads)
         return True
 
     def _get_fused(self):
         """THE fused decode program: one compiled ``lax.scan`` over
-        ``decode_horizon`` greedy rounds for the full ``max_seqs`` row batch
+        ``decode_horizon`` rounds for the full ``max_seqs`` row batch
         (inactive rows carry the all-zero table → trash block 0). Compiled
         for exactly ONE horizon (the engine's ``decode_horizon``), so it adds
-        exactly one shape to the compiled-program bound."""
+        exactly one shape to the compiled-program bound. Sampling params
+        ride as runtime per-row arrays with the per-position key folded
+        INSIDE the scan (docs/SAMPLING.md) — all-zero rows select argmax,
+        bit-identical to the legacy greedy program, and no second trace
+        ever exists."""
         if self._fused_fn is None:
             model = self.model
             K = self.decode_horizon
 
-            def fused(params, pool, toks, tables, starts):
-                return model.decode_paged_multi(params, pool, toks, tables,
-                                                starts, K)
+            def fused(params, pool, toks, tables, starts,
+                      slots, seeds, temps, top_ks, top_ps, bias_pool):
+                return model.decode_paged_multi(
+                    params, pool, toks, tables, starts, K,
+                    sampling=(seeds, temps, top_ks, top_ps,
+                              bias_pool[slots]))
 
             self._fused_fn = jax.jit(fused, donate_argnums=(1,))
         return self._fused_fn
@@ -521,19 +551,130 @@ class InferenceEngineV2:
     def _get_verify(self):
         """THE speculative-verification program: the target model over
         ``(max_seqs, decode_horizon)`` proposed-token segments in one
-        position-parallel forward, per-position greedy argmax out
+        position-parallel forward, per-position target selection out
         (docs/SERVING.md). Like the fused program it is compiled for exactly
         ONE shape — the engine's ``decode_horizon`` — so it adds one trace
-        to the compiled-program bound (``verify_cache_size <= 1``)."""
+        to the compiled-program bound (``verify_cache_size <= 1``). Sampled
+        rows get the target's own counter-based per-position sample at
+        every draft position (rejection sampling's deterministic
+        specialization, docs/SAMPLING.md); all-zero sampling rows select
+        argmax, bit-identical to the legacy program."""
         if self._verify_fn is None:
             model = self.model
 
-            def verify(params, pool, segs, tables, starts):
-                return model.verify_paged_multi(params, pool, segs, tables,
-                                                starts)
+            def verify(params, pool, segs, tables, starts,
+                       slots, seeds, temps, top_ks, top_ps, bias_pool):
+                return model.verify_paged_multi(
+                    params, pool, segs, tables, starts,
+                    sampling=(seeds, temps, top_ks, top_ps,
+                              bias_pool[slots]))
 
             self._verify_fn = jax.jit(verify, donate_argnums=(1,))
         return self._verify_fn
+
+    # ------------------------------------------------------------------
+    # per-request sampling state (docs/SAMPLING.md)
+    # ------------------------------------------------------------------
+    def _bias(self):
+        """Lazy device-resident (max_seqs, vocab) f32 per-SLOT bias pool.
+        Zero rows are the common case and leave selection untouched
+        (``argmax(lg + 0) == argmax(lg)`` bitwise on token ids)."""
+        if self._bias_pool is None:
+            self._bias_pool = jnp.zeros(
+                (self.max_seqs, self.cfg.vocab_size), jnp.float32)
+        return self._bias_pool
+
+    def _get_bias_set(self):
+        """Single traced-slot row-scatter program for the bias pool — like
+        the COW program, ONE compiled trace serves every slot, so bias
+        installs never add to the step-program bound."""
+        if self._bias_set_fn is None:
+
+            def setrow(bp, slot, row):
+                return bp.at[slot].set(row)
+
+            self._bias_set_fn = jax.jit(setrow, donate_argnums=(0,))
+        return self._bias_set_fn
+
+    def _zero_row(self) -> np.ndarray:
+        if self._bias_zero is None:
+            self._bias_zero = np.zeros(self.cfg.vocab_size, np.float32)
+        return self._bias_zero
+
+    def _install_bias(self, d) -> None:
+        """Scatter ``d.uid``'s pending bias row into its slot's pool row,
+        once per (uid, slot) binding — re-registration after preemption,
+        swap-in, or rebuild re-installs into the new slot."""
+        row = self._bias_rows.get(d.uid)
+        if row is None or self._bias_slots.get(d.uid) == d.slot:
+            return
+        self._bias_pool = self._get_bias_set()(self._bias(),
+                                               jnp.int32(d.slot), row)
+        self._bias_slots[d.uid] = d.slot
+
+    def _drop_bias(self, uid: int) -> None:
+        slot = self._bias_slots.pop(uid, None)
+        if slot is not None and self._bias_pool is not None:
+            self._bias_pool = self._get_bias_set()(
+                self._bias(), jnp.int32(slot), self._zero_row())
+
+    def set_sampling(self, uid: int, params, bias_row=None) -> None:
+        """Register (or with ``params=None`` clear) a request's sampling
+        state before its tokens are fed. ``params`` is duck-typed — the
+        engine reads ``.seed``/``.temperature``/``.top_k``/``.top_p`` —
+        so the serving layer owns the record type. ``bias_row`` is the
+        combined logit-bias/processor row ((vocab,) f32) or None; it is
+        installed into the slot pool at registration (and re-installed on
+        every slot re-binding). Cleared automatically by :meth:`flush`;
+        re-admission re-registers, which is what keeps every replay path's
+        sampled continuation bitwise (the keys depend only on seed and
+        absolute position, both replay-derived)."""
+        if not self.paged:
+            raise ValueError("set_sampling is paged-mode only (sampled "
+                             "selection rides the ragged/fused/verify "
+                             "programs)")
+        if params is None:
+            self._sampling.pop(uid, None)
+            self._bias_rows.pop(uid, None)
+            self._drop_bias(uid)
+            return
+        self._sampling[uid] = params
+        if bias_row is None:
+            self._bias_rows.pop(uid, None)
+            self._drop_bias(uid)
+        else:
+            self._bias_rows[uid] = np.asarray(bias_row, np.float32)
+            d = self.state.seqs.get(uid)
+            if d is not None:
+                self._install_bias(d)
+
+    def refresh_bias(self, uid: int, bias_row) -> None:
+        """Replace a resident request's bias row (dynamic logit processors
+        recompute per committed token). Forces a re-scatter even when the
+        slot binding is unchanged."""
+        if bias_row is None:
+            self._bias_rows.pop(uid, None)
+            self._drop_bias(uid)
+            return
+        self._bias_rows[uid] = np.asarray(bias_row, np.float32)
+        self._bias_slots.pop(uid, None)
+        d = self.state.seqs.get(uid)
+        if d is not None:
+            self._install_bias(d)
+
+    def _fill_sampling(self, d, i, slots, seeds, temps, top_ks, top_ps,
+                       poss=None, pos=0) -> None:
+        """Fill row ``i`` of the per-dispatch sampling scratch from
+        ``d.uid``'s registered params (zeros — plain argmax — otherwise)."""
+        slots[i] = d.slot
+        sp = self._sampling.get(d.uid)
+        if sp is not None:
+            seeds[i] = sp.seed
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+            if poss is not None:
+                poss[i] = pos
 
     def _scratch_for(self, key: Tuple, shapes,
                      dtypes=None) -> Tuple[np.ndarray, ...]:
@@ -665,10 +806,13 @@ class InferenceEngineV2:
                             src, dst = self.block_mgr.copy_on_write(d, j)
                             self.kv = self._get_cow()(
                                 self.kv, jnp.int32(src), jnp.int32(dst))
-            ids, tables, starts, logit_rows = self._scratch_for(
+            M = self.max_seqs
+            (ids, tables, starts, logit_rows, slots, seeds, poss, top_ks,
+             temps, top_ps) = self._scratch_for(
                 ("ragged", T),
                 ((T, 1), (T, self.block_mgr.max_blocks_per_seq), (T,),
-                 (self.max_seqs,)))
+                 (M,), (M,), (M,), (M,), (M,), (M,), (M,)),
+                dtypes=(np.int32,) * 8 + (np.float32, np.float32))
             finals = []
             r = 0
             for d, take in plan:
@@ -685,6 +829,12 @@ class InferenceEngineV2:
                     r += 1
                 if completes:
                     logit_rows[len(finals)] = r - 1
+                    # the produced token's absolute index is the consumed
+                    # count — seen_tokens is pre-advance here, so the
+                    # counter-based key position is seen + take
+                    self._fill_sampling(d, len(finals), slots, seeds, temps,
+                                        top_ks, top_ps, poss=poss,
+                                        pos=d.seen_tokens + take)
                     finals.append(d)
                 if self.prefix_cache:
                     d.history.extend(d.pending[:take])
@@ -693,7 +843,10 @@ class InferenceEngineV2:
             fn = self._get_ragged()
             lg, self.kv = fn(self.params, self.kv, jnp.asarray(ids),
                              jnp.asarray(tables), jnp.asarray(starts),
-                             jnp.asarray(logit_rows), greedy)
+                             jnp.asarray(logit_rows), jnp.asarray(slots),
+                             jnp.asarray(seeds), jnp.asarray(poss),
+                             jnp.asarray(temps), jnp.asarray(top_ks),
+                             jnp.asarray(top_ps), self._bias(), greedy)
             if self.prefix_cache:
                 # the step's writes are dispatched: every block it filled now
                 # holds valid prefix content — publish to the content index
@@ -743,6 +896,11 @@ class InferenceEngineV2:
         # 1. register / extend sequences
         for uid, toks in zip(batch_uids, batch_tokens):
             desc = self.state.get_or_create_sequence(uid)
+            if self._bias_rows:
+                # (re-)bind any pending logit-bias row to this uid's slot —
+                # covers fresh admission, preempt→re-admit, and post-rebuild
+                # replay (cheap per-uid dict probe when no bias is registered)
+                self._install_bias(desc)
             if toks is not None and len(toks):
                 fresh = (self.prefix_cache and desc.seen_tokens == 0
                          and not desc.blocks and not desc.pending)
@@ -928,15 +1086,24 @@ class InferenceEngineV2:
                         self.kv = self._get_cow()(
                             self.kv, jnp.int32(src), jnp.int32(dst))
         B = self.max_seqs
-        toks, tables, starts = self._scratch_for(
-            ("fused", B), ((B,), (B, self.block_mgr.max_blocks_per_seq), (B,)))
+        toks, tables, starts, slots, seeds, top_ks, temps, top_ps = \
+            self._scratch_for(
+                ("fused", B),
+                ((B,), (B, self.block_mgr.max_blocks_per_seq), (B,),
+                 (B,), (B,), (B,), (B,), (B,)),
+                dtypes=(np.int32,) * 6 + (np.float32, np.float32))
         for r, d in enumerate(descs):
             toks[r] = tokens[d.uid]
             self.block_mgr.fill_table_row(d, tables[r])  # in place, no temp
             starts[r] = d.seen_tokens
+            # per-position keys are folded inside the scan from (seed,
+            # starts+round+1) — no per-round host state (docs/SAMPLING.md)
+            self._fill_sampling(d, r, slots, seeds, temps, top_ks, top_ps)
         ys, self.kv = self._get_fused()(
             self.params, self.kv, jnp.asarray(toks), jnp.asarray(tables),
-            jnp.asarray(starts))
+            jnp.asarray(starts), jnp.asarray(slots), jnp.asarray(seeds),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            self._bias())
         ys = np.asarray(ys)  # (max_seqs, K); one transfer per K tokens
         out: Dict[int, List[int]] = {}
         for r, d in enumerate(descs):
@@ -1020,9 +1187,12 @@ class InferenceEngineV2:
                         self.kv = self._get_cow()(
                             self.kv, jnp.int32(src), jnp.int32(dst))
         B = self.max_seqs
-        segs, tables, starts = self._scratch_for(
-            ("verify", B, K),
-            ((B, K), (B, self.block_mgr.max_blocks_per_seq), (B,)))
+        segs, tables, starts, slots, seeds, top_ks, temps, top_ps = \
+            self._scratch_for(
+                ("verify", B, K),
+                ((B, K), (B, self.block_mgr.max_blocks_per_seq), (B,),
+                 (B,), (B,), (B,), (B,), (B,)),
+                dtypes=(np.int32,) * 6 + (np.float32, np.float32))
         fed: Dict[int, List[int]] = {}
         for r, d in enumerate(descs):
             row = [int(tokens[d.uid])] + [int(t) for t in drafts.get(d.uid, ())]
@@ -1031,9 +1201,15 @@ class InferenceEngineV2:
                 segs[r, j] = t           # (zeroed pad — always rolled back)
             self.block_mgr.fill_table_row(d, tables[r])  # in place, no temp
             starts[r] = d.seen_tokens
+            # sampled rows: each position j gets the target's own sample
+            # under key (seed, starts+j+1) — the token sequential sampled
+            # decode emits there, which is what draft prefix-matching needs
+            self._fill_sampling(d, r, slots, seeds, temps, top_ks, top_ps)
         ys, self.kv = self._get_verify()(
             self.params, self.kv, jnp.asarray(segs), jnp.asarray(tables),
-            jnp.asarray(starts))
+            jnp.asarray(starts), jnp.asarray(slots), jnp.asarray(seeds),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            self._bias())
         # (max_seqs, K); ONE designed transfer per verified horizon — the
         # same budget as the fused path's result ship
         ys = np.asarray(ys)  # dstpu-lint: ignore[DSTPU001]
@@ -1106,6 +1282,11 @@ class InferenceEngineV2:
         cancel/preempt/complete races must never double-free blocks (a
         second ``block_mgr.free`` of the same descriptor would corrupt
         refcounts)."""
+        # sampling state is per-residency: re-admission re-registers it (the
+        # scheduler's _start), so dropping here keeps slot bias rows exact
+        self._sampling.pop(uid, None)
+        self._bias_rows.pop(uid, None)
+        self._drop_bias(uid)
         if uid not in self.state.seqs:
             if self._swaps.pop(uid, None) is not None:
                 # cancel/expiry of a swapped-out victim: drop its payload
@@ -1150,6 +1331,13 @@ class InferenceEngineV2:
         from the dead device): journal replay never consults either."""
         self.state = DSStateManager(self.max_seqs, self.max_seq_len)
         self._swaps.clear()
+        # sampling state is per-residency (slot bindings died with the state
+        # manager): replay re-registers through set_sampling + put, and the
+        # counter-based keys make the replayed samples bitwise identical
+        self._sampling.clear()
+        self._bias_rows.clear()
+        self._bias_slots.clear()
+        self._bias_pool = None
         self.rebuilds += 1
         if not self.paged:
             self.kv = self.model.init_kv_cache(self.max_seqs,

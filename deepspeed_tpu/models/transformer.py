@@ -1107,11 +1107,12 @@ class TransformerLM:
         lg = self._head(params, x_last[:, None])[:, 0]
         return lg, (nkp, nvp)
 
-    def decode_paged_multi(self, params, kv_pool, toks, tables, starts, k: int):
-        """Fused K-step greedy decode against the blocked pool: a single
+    def decode_paged_multi(self, params, kv_pool, toks, tables, starts, k: int,
+                           sampling=None):
+        """Fused K-step decode against the blocked pool: a single
         ``lax.scan`` over ``k`` rounds, each running the length-1
-        ``forward_paged`` for all rows and feeding the on-device argmax back
-        as the next round's input — one dispatch and one (B, k) int32
+        ``forward_paged`` for all rows and feeding the on-device selection
+        back as the next round's input — one dispatch and one (B, k) int32
         transfer per k tokens instead of k of each (the per-token host
         round-trip is steady-state serving's latency floor).
 
@@ -1121,20 +1122,34 @@ class TransformerLM:
         must already cover positions ``starts .. starts+k-1``. Returns
         ``((B, k) sampled tokens, new pool)``. Each round computes exactly
         what the ragged decode-round program computes per row (same S=1
-        ``forward_paged``, same argmax), so a k-step fused decode is bitwise
-        identical under greedy to k single steps."""
+        ``forward_paged``, same selection), so a k-step fused decode is
+        bitwise identical to k single steps — under greedy AND under
+        sampling, because the per-position key is folded INSIDE the loop.
+
+        ``sampling``: ``None`` = greedy argmax (the legacy program,
+        unchanged); else ``(seeds, temps, top_ks, top_ps, bias)`` per-row
+        arrays — (B,) i32/f32/i32/f32 and a (B, V) additive bias — and
+        each round selects via :func:`sample_or_argmax` with the
+        counter-based key for absolute position ``pos + 1`` (the produced
+        token's index; docs/SAMPLING.md)."""
 
         def round_(carry, _):
             pool, t, pos = carry
             lg, pool = self.forward_paged(params, t[:, None], pool, tables, pos)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            if sampling is None:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            else:
+                seeds, temps, top_ks, top_ps, bias = sampling
+                nxt = sample_or_argmax(lg + bias, seeds, pos + 1,
+                                       temps, top_ks, top_ps)
             return (pool, nxt, pos + 1), nxt
 
         (kv_pool, _, _), ys = jax.lax.scan(
             round_, (kv_pool, toks, starts), None, length=int(k))
         return ys.T, kv_pool  # (B, k)
 
-    def verify_paged_multi(self, params, kv_pool, segs, tables, starts):
+    def verify_paged_multi(self, params, kv_pool, segs, tables, starts,
+                           sampling=None):
         """Speculative-decoding batch verification against the blocked pool
         (docs/SERVING.md): run B sequences' K-token segments — each row's
         last sampled token followed by K−1 draft tokens — in ONE forward and
@@ -1156,14 +1171,35 @@ class TransformerLM:
 
         ``segs`` (B, K) int32 (rows past a row's real draft are padding —
         the caller rolls their positions back); ``tables`` (B, MAXB);
-        ``starts`` (B,) the first segment position per row."""
+        ``starts`` (B,) the first segment position per row.
+
+        ``sampling``: ``None`` = greedy argmax at every position (the
+        legacy program); else ``(seeds, temps, top_ks, top_ps, bias)``
+        per-ROW arrays as in :meth:`decode_paged_multi`, broadcast across
+        the row's K positions. Output ``[r, j]`` is then the TARGET's own
+        sample under the counter-based key for absolute position
+        ``starts[r] + j + 1`` — exactly the token the sequential sampled
+        decode emits at that position given the same history, which is
+        what makes draft acceptance-by-prefix-match rejection sampling's
+        deterministic specialization (docs/SAMPLING.md) and keeps
+        speculative output token-for-token equal to the non-speculative
+        sampled stream."""
         B, K = segs.shape
         ids = segs.reshape(B * K, 1)
         tab = jnp.repeat(tables, K, axis=0)  # (B*K, MAXB): row j shares r's table
         pos = (starts[:, None]
                + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(B * K)
         lg, kv_pool = self.forward_paged(params, ids, kv_pool, tab, pos)
-        return jnp.argmax(lg, axis=-1).astype(jnp.int32).reshape(B, K), kv_pool
+        if sampling is None:
+            ys = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        else:
+            seeds, temps, top_ks, top_ps, bias = sampling
+            ys = sample_or_argmax(
+                lg + jnp.repeat(bias, K, axis=0),
+                jnp.repeat(seeds, K), pos + 1,
+                jnp.repeat(temps, K), jnp.repeat(top_ks, K),
+                jnp.repeat(top_ps, K))
+        return ys.reshape(B, K), kv_pool
 
     def draft_greedy(self, params, window, n_valid, k: int):
         """Greedy ``k``-token continuation for a DRAFT model
@@ -1192,6 +1228,60 @@ class TransformerLM:
         x, new_kv = self._trunk_with_cache(params, input_ids, kv_cache,
                                            cache_index, positions)
         return self._head(params, x[:, -1:, :])[:, 0, :], new_kv
+
+
+def sample_or_argmax(lg, seeds, positions, temps, top_ks, top_ps):
+    """Per-row token selection shared by greedy and sampled serving
+    (docs/SAMPLING.md): for each logit row, ``temps[r] == 0`` selects
+    plain argmax — bit-identical to the legacy greedy programs — and
+    ``temps[r] > 0`` draws one categorical sample from the
+    temperature/top-k/top-p-shaped distribution under the **counter-based
+    key** ``fold_in(PRNGKey(seeds[r]), positions[r])``. ``positions`` is
+    the produced token's 0-based absolute index over prompt + generated,
+    so a replay that re-feeds the committed history lands on the same
+    (seed, position) pairs and reproduces every sample bitwise — the
+    property all five replay paths (preempt/re-admit, journal replay,
+    engine rebuild, pool migration, KV swap-in) certify.
+
+    A batch-level ``lax.cond`` on ``any(temps > 0)`` skips the sampling
+    math (one descending sort per row, shared by top-k and top-p) when
+    every row is greedy, so pure-greedy traffic keeps today's compute
+    path inside the same compiled program — no new static mode, no new
+    trace. Lives here rather than in ``serve`` because the paged multi
+    ops close over it and ``models`` must stay importable without the
+    serving stack; ``deepspeed_tpu.serve.sampling`` re-exports it.
+
+    ``lg`` (R, V) logits (bias already added by the caller); ``seeds``/
+    ``positions``/``top_ks`` (R,) int32; ``temps``/``top_ps`` (R,)
+    float32. Returns (R,) int32 token ids. Zero-filled padding rows are
+    safe: temp 0 routes them through argmax."""
+
+    def _greedy(args):
+        return jnp.argmax(args[0], axis=-1).astype(jnp.int32)
+
+    def _sampled(args):
+        lg, seeds, positions, temps, top_ks, top_ps = args
+
+        def one(lg_r, seed, pos, temp, tk, tp):
+            greedy_tok = jnp.argmax(lg_r, axis=-1).astype(jnp.int32)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+            x = lg_r.astype(jnp.float32) / jnp.where(temp > 0.0, temp, 1.0)
+            # one descending sort serves both filters
+            srt = jnp.sort(x)[::-1]
+            kth = srt[jnp.clip(tk - 1, 0, x.shape[-1] - 1)]
+            x = jnp.where((tk > 0) & (x < kth), -jnp.inf, x)
+            probs = jax.nn.softmax(srt)
+            keep = (jnp.cumsum(probs) - probs) < tp
+            keep = keep.at[0].set(True)  # nucleus is never empty
+            thr = jnp.min(jnp.where(keep, srt, jnp.inf))
+            x = jnp.where((tp < 1.0) & (x < thr), -jnp.inf, x)
+            tok = jax.random.categorical(key, x).astype(jnp.int32)
+            return jnp.where(temp > 0.0, tok, greedy_tok)
+
+        return jax.vmap(one)(lg, seeds, positions, temps, top_ks, top_ps)
+
+    return jax.lax.cond(jnp.any(temps > 0.0), _sampled, _greedy,
+                        (lg, seeds, positions, temps, top_ks, top_ps))
 
 
 def build_model(preset: str, **overrides) -> TransformerLM:

@@ -30,6 +30,13 @@ meta → CRC32-verified manifest written LAST) to an append-only stream:
   ``resolve`` and the ownership-transfer pair ``detach`` / ``adopt``
   (an adopt logs the FULL entry, so each replica's log is self-contained
   — replaying one file never needs another replica's).
+- **versioned entry kinds**: an entry carrying sampling params
+  (docs/SAMPLING.md) is written as ``record.v2`` / ``adopt.v2`` with a
+  ``sampling`` field; plain greedy entries keep emitting the original
+  kinds byte-for-byte, so pre-sampling logs replay unchanged and logs
+  written by this version are readable by pre-sampling readers for
+  every greedy request (v2 kinds fold to nothing there — the documented
+  unknown-kind rule — losing only the sampled requests they describe).
 
 Writes are flushed per append (the commit path is the per-token hot path
 the DSTPU rules police: one buffered ``write`` + ``flush``, no fsync by
@@ -119,13 +126,20 @@ class DurableRequestJournal(RequestJournal):
 
     def _fold(self, rec: dict) -> None:
         kind = rec["kind"]
-        if kind in ("record", "adopt"):
+        if kind in ("record", "adopt", "record.v2", "adopt.v2"):
+            sampling = None
+            if "sampling" in rec:
+                # lazy import: resilience stays importable without serve
+                # (module-level would be a serve<->resilience cycle)
+                from ..serve.sampling import SamplingParams
+                sampling = SamplingParams.from_dict(rec["sampling"])
             e = JournalEntry(
                 uid=rec["uid"], prompt=list(rec["prompt"]),
                 tokens=list(rec["tokens"]),
                 max_new_tokens=rec["max_new_tokens"],
                 priority=rec["priority"], deadline=rec["deadline"],
-                arrival_time=rec["arrival_time"], eos_token=rec["eos_token"])
+                arrival_time=rec["arrival_time"], eos_token=rec["eos_token"],
+                sampling=sampling)
             self._entries[e.uid] = e
         elif kind == "commit":
             e = self._entries.get(rec["uid"])
@@ -149,11 +163,18 @@ class DurableRequestJournal(RequestJournal):
 
     @staticmethod
     def _entry_rec(kind: str, e: JournalEntry) -> dict:
-        return {"kind": kind, "uid": e.uid, "prompt": list(e.prompt),
-                "tokens": list(e.tokens),
-                "max_new_tokens": e.max_new_tokens, "priority": e.priority,
-                "deadline": e.deadline, "arrival_time": e.arrival_time,
-                "eos_token": e.eos_token}
+        rec = {"kind": kind, "uid": e.uid, "prompt": list(e.prompt),
+               "tokens": list(e.tokens),
+               "max_new_tokens": e.max_new_tokens, "priority": e.priority,
+               "deadline": e.deadline, "arrival_time": e.arrival_time,
+               "eos_token": e.eos_token}
+        sp = getattr(e, "sampling", None)
+        if sp is not None:
+            # versioned kind: ONLY sampled entries pay the format bump —
+            # greedy logs stay byte-identical to the pre-sampling framing
+            rec["kind"] = kind + ".v2"
+            rec["sampling"] = sp.to_dict()
+        return rec
 
     def record(self, req) -> JournalEntry:
         e = super().record(req)

@@ -51,6 +51,13 @@ class JournalEntry:
     deadline: Optional[float]
     arrival_time: float
     eos_token: Optional[int]
+    #: per-request decoding policy (a ``serve.sampling.SamplingParams`` —
+    #: typed ``object`` because resilience never imports serve): replayed
+    #: sampling re-derives every token's PRNG key from (seed, absolute
+    #: position), so carrying the params IS the whole reproducibility
+    #: contract — ``None`` stays plain greedy and serializes exactly as
+    #: the pre-sampling journal format did
+    sampling: Optional[object] = None
     commits: int = field(default=0, compare=False)  # commit points synced
     #: migration payload (docs/SERVING.md engine pool): ``detach`` attaches
     #: the live ``Request`` object so the adopting scheduler keeps serving
@@ -104,7 +111,8 @@ class RequestJournal:
                          max_new_tokens=req.max_new_tokens,
                          priority=req.priority, deadline=req.deadline,
                          arrival_time=req.arrival_time,
-                         eos_token=req.eos_token)
+                         eos_token=req.eos_token,
+                         sampling=getattr(req, "sampling", None))
         self._entries[req.uid] = e
         self.records += 1
         return e

@@ -17,6 +17,8 @@ from .metrics import PoolMetrics, ServeMetrics  # noqa: F401
 from .pool import EnginePool, Replica  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .router import Router  # noqa: F401
+from .sampling import (LogitProcessor, SamplingParams,  # noqa: F401
+                       StopScanner, combined_bias)
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401
                         SchedulerClosedError)
 from .speculation import (DraftModelProposer, DraftProposer,  # noqa: F401

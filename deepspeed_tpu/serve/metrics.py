@@ -112,6 +112,18 @@ class ServeMetrics:
         #: the bench's re-admission p95 and the ``serve/kvtier`` percentile
         #: events come from here
         self.swap_readmit_s: List[float] = []
+        #: sampling counters (docs/SAMPLING.md), exported under
+        #: ``serve/sampling/*``: ``sampled_requests`` admissions that
+        #: registered engine-side sampling state (every re-admission counts
+        #: — replay paths re-register), ``sampled_tokens`` tokens selected
+        #: by categorical sampling rather than argmax, ``fanout_streams``
+        #: sibling streams created by ``n > 1`` fanout, ``stop_hits``
+        #: requests finished by a stop sequence (overrun tokens past the
+        #: match land in ``serve/decode/rollback_tokens``), and
+        #: ``bias_refreshes`` dynamic logit-processor row re-scatters.
+        self.sampling: Dict[str, float] = {
+            "sampled_requests": 0, "sampled_tokens": 0,
+            "fanout_streams": 0, "stop_hits": 0, "bias_refreshes": 0}
         #: resilience counters, exported under ``serve/faults/*``
         #: (docs/RESILIENCE.md); breaker_* are synced from the breaker each
         #: step, the rest are incremented by the scheduler as faults land
@@ -174,6 +186,23 @@ class ServeMetrics:
     def observe_spec_degraded(self) -> None:
         """A fused dispatch ran because speculation was collapsed/empty."""
         self.spec["degraded_steps"] += 1
+
+    def observe_sampling_admit(self, params) -> None:
+        """One admission that pushed sampling state to the engine (initial
+        or replay re-registration)."""
+        self.sampling["sampled_requests"] += 1
+
+    def observe_sampled_token(self) -> None:
+        self.sampling["sampled_tokens"] += 1
+
+    def observe_fanout(self, n: int) -> None:
+        self.sampling["fanout_streams"] += n
+
+    def observe_stop_hit(self) -> None:
+        self.sampling["stop_hits"] += 1
+
+    def observe_bias_refresh(self) -> None:
+        self.sampling["bias_refreshes"] += 1
 
     def observe_kvtier(self, stats: Dict[str, float]) -> None:
         """Sync engine-side tier counters from ``prefix_cache_stats()`` —
@@ -282,6 +311,8 @@ class ServeMetrics:
                    for k, v in sorted(self.prefill.items())]
                 + [(f"{p}spec/{k}", float(v), step)
                    for k, v in sorted(self.spec.items())]
+                + [(f"{p}sampling/{k}", float(v), step)
+                   for k, v in sorted(self.sampling.items())]
                 + [(f"{p}kvtier/{k}", float(v), step)
                    for k, v in sorted({
                        **self.kvtier,

@@ -61,6 +61,12 @@ class Request:
     uid: int = field(default_factory=lambda: next(_uid_counter))
     #: streaming callback, invoked as ``on_token(request, token)`` per token
     on_token: Optional[Callable[["Request", int], None]] = None
+    #: per-request decoding policy (docs/SAMPLING.md): a
+    #: ``serve.sampling.SamplingParams`` record, or None for plain greedy.
+    #: Always a CONCRETE single-stream record here (``n == 1``): submit()
+    #: expands ``n > 1`` fanout into sibling requests with derived seeds
+    #: before any Request exists, so replay never re-fans-out.
+    sampling: Optional[object] = None
 
     # -- runtime state (scheduler-owned) --------------------------------
     state: RequestState = RequestState.QUEUED

@@ -110,6 +110,7 @@ from ..resilience.watchdog import StepWatchdog
 from ..utils.logging import logger
 from .metrics import Event, ServeMetrics
 from .request import Request, RequestState
+from .sampling import SamplingParams, StopScanner, combined_bias
 from .speculation import DraftProposer, SpecPolicy
 
 
@@ -127,9 +128,13 @@ class ContinuousBatchScheduler:
     ``clock`` is the *scheduling* time source (arrivals, aging, deadlines,
     TTFT, breaker cooldowns) and is injectable for deterministic tests /
     simulated arrival processes; decode-step latency and watchdog budgets
-    are always measured with ``time.perf_counter``. Sampling is greedy
-    (argmax) — the property the preemption round trip's bitwise guarantee
-    rests on.
+    are always measured with ``time.perf_counter``. Token selection is
+    greedy argmax by default; a request submitted with
+    :class:`~deepspeed_tpu.serve.sampling.SamplingParams` samples under
+    counter-based per-(seed, position) keys (docs/SAMPLING.md), which
+    keeps the preemption round trip's bitwise guarantee — replay
+    recomputes the same keys from the committed history, exactly as
+    argmax recomputes the same tokens.
 
     ``retry`` / ``breaker`` / ``watchdog`` default to always-on instances
     whose thresholds only matter once faults actually occur (the watchdog
@@ -252,6 +257,11 @@ class ContinuousBatchScheduler:
         self._queue: Deque[Request] = deque()
         self._live: Dict[int, Request] = {}
         self._all: Dict[int, Request] = {}
+        #: host-side stop-sequence scan state, one per live sampled request
+        #: with stop sequences. Built lazily from committed history, so
+        #: preemption/migration/replay reconstruct it exactly (and pool
+        #: migration never ships it — the adopting side rebuilds)
+        self._stop_scanners: Dict[int, StopScanner] = {}
         #: an admitted request's prefill hit pool exhaustion; its pending
         #: tokens sit inside the engine and must drain before it decodes
         self._stalled = False
@@ -264,10 +274,19 @@ class ContinuousBatchScheduler:
                deadline: Optional[float] = None,
                arrival_time: Optional[float] = None,
                on_token=None, uid: Optional[int] = None,
-               eos_token: Optional[int] = None) -> Request:
+               eos_token: Optional[int] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         """Enqueue a request; raises :class:`QueueFullError` on backpressure,
         :class:`SheddingError` while the circuit breaker sheds load, and
-        :class:`SchedulerClosedError` after :meth:`close`."""
+        :class:`SchedulerClosedError` after :meth:`close`.
+
+        ``sampling`` carries the per-request decoding policy
+        (docs/SAMPLING.md). ``sampling.n > 1`` fans out into ``n`` sibling
+        requests sharing the prompt (the paged prefix cache COW-shares its
+        full blocks); the returned request is stream 0 (it keeps ``uid`` /
+        ``on_token``) with the whole sibling list attached as ``.fanout``.
+        Each sibling is journaled with its own concrete derived-seed params,
+        so replay never re-fans-out."""
         if self._closed:
             raise SchedulerClosedError("scheduler is closed to new admits")
         if self.breaker.should_shed(priority, self._clock()):
@@ -283,16 +302,51 @@ class ContinuousBatchScheduler:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds engine context {self.engine.max_seq_len}")
-        if len(self._queue) >= self.max_queue:
-            self.metrics.admission_rejects += 1
-            raise QueueFullError(
-                f"serve queue full ({self.max_queue}); request rejected")
+        if sampling is not None:
+            if sampling.needs_engine and not getattr(self.engine, "paged",
+                                                     False):
+                raise ValueError(
+                    "sampling with temperature / logit-bias / processors "
+                    "requires a paged engine; slot-mode engines only "
+                    "support greedy decoding (stop sequences alone are "
+                    "host-side and allowed)")
+            if sampling.logit_bias:
+                vs = getattr(getattr(self.engine, "cfg", None),
+                             "vocab_size", None)
+                if vs is not None and sampling.logit_bias[-1][0] >= vs:
+                    raise ValueError(
+                        f"logit_bias token id {sampling.logit_bias[-1][0]} "
+                        f">= engine vocab size {vs}")
+            if sampling.n > 1:
+                # atomic fanout admission: all n streams or none — a
+                # partial fanout would leave best-of with missing arms
+                if len(self._queue) + sampling.n > self.max_queue:
+                    self.metrics.admission_rejects += 1
+                    raise QueueFullError(
+                        f"serve queue full ({self.max_queue}); fanout of "
+                        f"{sampling.n} rejected")
+                at = self._clock() if arrival_time is None else arrival_time
+                siblings = [
+                    self.submit(prompt, max_new_tokens=max_new_tokens,
+                                priority=priority, deadline=deadline,
+                                arrival_time=at,
+                                on_token=(on_token if i == 0 else None),
+                                uid=(uid if i == 0 else None),
+                                eos_token=eos_token,
+                                sampling=sampling.child(i))
+                    for i in range(sampling.n)]
+                first = siblings[0]
+                first.fanout = siblings
+                self.metrics.observe_fanout(sampling.n)
+                return first
+            sampling = sampling.child(0)  # normalize best_of off the record
         kw = {} if uid is None else {"uid": uid}
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       priority=priority, deadline=deadline,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time),
-                      on_token=on_token, eos_token=eos_token, **kw)
+                      on_token=on_token, eos_token=eos_token,
+                      sampling=sampling, **kw)
         if req.uid in self._all and not self._all[req.uid].finished:
             raise ValueError(f"uid {req.uid} is already in flight")
         self._all[req.uid] = req
@@ -312,6 +366,7 @@ class ContinuousBatchScheduler:
         if req in self._queue:
             self._queue.remove(req)
         self._live.pop(uid, None)
+        self._stop_scanners.pop(uid, None)
         self._engine_flush(uid)  # no-op when not resident (idempotent)
         req.state = RequestState.CANCELLED
         req.cancel_reason = reason
@@ -357,6 +412,7 @@ class ContinuousBatchScheduler:
             req.state = RequestState.PREEMPTED
             req.preemptions += 1
         self._all.pop(uid, None)
+        self._stop_scanners.pop(uid, None)  # adopting side rebuilds lazily
         if self.spec is not None:
             self.spec.forget(uid)
         entry = self.journal.detach(uid)
@@ -382,9 +438,16 @@ class ContinuousBatchScheduler:
                           max_new_tokens=entry.max_new_tokens,
                           priority=entry.priority, deadline=entry.deadline,
                           arrival_time=entry.arrival_time,
-                          eos_token=entry.eos_token, uid=entry.uid)
+                          eos_token=entry.eos_token, uid=entry.uid,
+                          sampling=getattr(entry, "sampling", None))
             req.tokens = list(entry.tokens)
             entry.request = req
+        sp = getattr(req, "sampling", None)
+        if (sp is not None and sp.needs_engine
+                and not getattr(self.engine, "paged", False)):
+            raise ValueError(
+                f"uid {req.uid}: sampled request cannot be adopted by a "
+                f"slot-mode (non-paged) engine")
         if req.uid in self._all and not self._all[req.uid].finished:
             raise ValueError(f"uid {req.uid} is already in flight here")
         if (len(req.prompt) + req.max_new_tokens
@@ -507,6 +570,7 @@ class ContinuousBatchScheduler:
         """Quarantine ``req``: terminal FAILED, blocks flushed, streaming
         consumers unblocked with the error (``stream`` re-raises it)."""
         self._live.pop(req.uid, None)
+        self._stop_scanners.pop(req.uid, None)
         if req in self._queue:
             self._queue.remove(req)
         self._engine_flush(req.uid)
@@ -838,6 +902,18 @@ class ContinuousBatchScheduler:
             req.admitted_time = now
         self._live[req.uid] = req
         self.metrics.admitted += 1
+        sp = req.sampling
+        if sp is not None and sp.needs_engine:
+            # (re-)register with the engine BEFORE any admission path:
+            # flush/preempt/swap_out all dropped the engine's per-residency
+            # sampling state, so every (re-)admission pushes it fresh —
+            # including the swap-in fast path below, whose restored rows
+            # must sample under this request's keys on the very next step
+            self.engine.set_sampling(
+                req.uid, sp,
+                bias_row=combined_bias(sp, self.engine.cfg.vocab_size,
+                                       req.replay_tokens()))
+            self.metrics.observe_sampling_admit(sp)
         if (getattr(self.engine, "host_tier_blocks", 0)
                 and self.engine.swap_resident(req.uid)
                 and self._swap_in_readmit(req)):
@@ -919,18 +995,45 @@ class ContinuousBatchScheduler:
 
     def _emit_token(self, req: Request, tok: int, now: float) -> bool:
         """Deliver one kept token; True when it finishes the request
-        (max_new_tokens reached, or the stop token was emitted)."""
+        (max_new_tokens reached, EOS, or a stop sequence completed — the
+        matching tokens ARE emitted, like ``eos_token``)."""
         if req.first_token_time is None:
             req.first_token_time = now
             self.metrics.ttft_s.append(now - req.arrival_time)
         req.state = RequestState.DECODE
+        sp = req.sampling
+        scan = None
+        if sp is not None and sp.stop:
+            scan = self._stop_scanners.get(req.uid)
+            if scan is None:
+                # built lazily from the PRE-emit committed history, so a
+                # re-admitted / migrated / replayed request reconstructs
+                # the exact tail state its tokens imply — a stop match
+                # spanning a preemption boundary still fires
+                scan = StopScanner(sp.stop, history=req.tokens)
+                self._stop_scanners[req.uid] = scan
         req._emit(tok)
         # commit point: the journal's committed-token record extends by this
         # token, so a later engine loss replays exactly the emitted history
         self.journal.commit(req)
         self.metrics.tokens_generated += 1
-        return req.remaining == 0 or (req.eos_token is not None
-                                      and tok == req.eos_token)
+        stop_hit = scan is not None and scan.push(tok) > 0
+        if stop_hit:
+            self.metrics.observe_stop_hit()
+        finished = (req.remaining == 0 or stop_hit
+                    or (req.eos_token is not None and tok == req.eos_token))
+        if sp is not None:
+            if not sp.is_greedy:
+                self.metrics.observe_sampled_token()
+            if sp.dynamic and not finished:
+                # dynamic logit processors re-mask per committed token; the
+                # horizon is collapsed to 1 for them, so the refreshed row
+                # lands before the next dispatch samples this request
+                self.engine.refresh_bias(
+                    req.uid, combined_bias(sp, self.engine.cfg.vocab_size,
+                                           req.replay_tokens()))
+                self.metrics.observe_bias_refresh()
+        return finished
 
     def _absorb(self, out: Dict[int, np.ndarray], now: float) -> None:
         for uid, val in out.items():
@@ -986,6 +1089,7 @@ class ContinuousBatchScheduler:
     def _finish(self, req: Request, now: float) -> None:
         self._engine_flush(req.uid)
         self._live.pop(req.uid, None)
+        self._stop_scanners.pop(req.uid, None)
         req.state = RequestState.DONE
         req.finish_time = now
         self.journal.resolve(req.uid)
@@ -1036,6 +1140,11 @@ class ContinuousBatchScheduler:
         for uid in feed:
             req = self._live[uid]
             if req.remaining < K:
+                return 1
+            if req.sampling is not None and req.sampling.dynamic:
+                # a dynamic logit processor re-masks after every committed
+                # token, and a K-step on-device scan cannot re-enter the
+                # host mid-loop — single-step is the correctness price
                 return 1
             d = self.engine.state.seqs.get(uid)
             if d is not None and d.seen_tokens + K > self.engine.max_seq_len:

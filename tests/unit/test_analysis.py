@@ -416,6 +416,58 @@ def pick_victim(live, clock=time.monotonic):
         assert rules_of(src) == []
 
 
+class TestRngKeyMaterial:
+    """DSTPU005's jax PRNG-key check (docs/SAMPLING.md): key material in
+    serve/inference must be replay-derivable — never wall clock, process
+    entropy, or global RNG state."""
+
+    BAD = """
+import time, random
+import numpy as np
+import jax.random as jrandom
+
+def make_keys(seed):
+    k1 = jrandom.PRNGKey(int(time.time()))
+    k2 = jrandom.PRNGKey(np.random.randint(0, 2**31))
+    k3 = jrandom.split(jrandom.PRNGKey(hash(seed)))
+    k4 = jrandom.PRNGKey(random.getrandbits(31))
+    return k1, k2, k3, k4
+"""
+
+    def test_flags_entropy_sourced_keys(self):
+        # k2 carries np.random.randint itself (an unseeded-global finding)
+        # on top of the key-material finding, hence 5 for 4 bad keys
+        assert rules_of(self.BAD, path=INFER).count("DSTPU005") >= 4
+
+    def test_silent_outside_rng_scope(self):
+        assert rules_of(self.BAD, path=TRAIN) == []
+
+    def test_counter_based_fold_in_chain_is_fine(self):
+        src = """
+import jax.random as jrandom
+
+def key_for(seed, position):
+    base = jrandom.PRNGKey(seed)
+    return jrandom.fold_in(base, position)
+
+def keys_for(seed, n):
+    return jrandom.split(jrandom.PRNGKey(seed), n)
+"""
+        assert rules_of(src, path=INFER) == []
+
+    def test_constant_seed_and_str_split_are_fine(self):
+        src = """
+import jax.random as jrandom
+
+def draft_key():
+    return jrandom.PRNGKey(0)
+
+def parse(s):
+    return s.split(",")
+"""
+        assert rules_of(src, path=INFER) == []
+
+
 # ---------------------------------------------------------------------------
 # suppression: inline pragma + baseline
 # ---------------------------------------------------------------------------

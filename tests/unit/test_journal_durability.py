@@ -15,7 +15,7 @@ from deepspeed_tpu.resilience import (DurableRequestJournal, RequestJournal,
                                       RetryPolicy)
 from deepspeed_tpu.resilience.journal_store import _frame, _unframe
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, Request,
-                                 RequestState)
+                                 RequestState, SamplingParams)
 
 
 @pytest.fixture(scope="module")
@@ -203,6 +203,76 @@ class TestCorruptTail:
             assert j2.uids() == [a.uid]
 
 
+class TestVersionedSamplingRecords:
+    def test_greedy_framing_is_byte_pinned_to_legacy(self, tmp_path):
+        """Format pinning (docs/SAMPLING.md): a greedy request's log lines
+        carry the ORIGINAL kinds with no sampling field — byte-identical
+        to what a pre-sampling writer emitted, so old readers replay new
+        greedy logs unchanged."""
+        path = str(tmp_path / "j.log")
+        r = _req([1, 2, 3])
+        with DurableRequestJournal(path) as j:
+            e = j.record(r)
+            j.detach(r.uid)
+            j.adopt(e)
+        with open(path, encoding="utf-8") as f:
+            recs = [_unframe(ln) for ln in f]
+        assert [rec["kind"] for rec in recs] == ["record", "detach", "adopt"]
+        assert all("sampling" not in rec for rec in recs)
+
+    def test_sampled_record_v2_round_trip(self, tmp_path):
+        """A sampled entry is written as ``record.v2`` carrying the
+        params; reopening reconstructs the full SamplingParams — the
+        whole replay-reproducibility contract rides the journal."""
+        path = str(tmp_path / "j.log")
+        sp = SamplingParams(temperature=0.8, top_k=40, top_p=0.9, seed=77,
+                            stop=((5, 6),), logit_bias={3: -2.0})
+        r = _req([1, 2, 3], sampling=sp)
+        with DurableRequestJournal(path) as j:
+            j.record(r)
+        with open(path, encoding="utf-8") as f:
+            (rec,) = [_unframe(ln) for ln in f]
+        assert rec["kind"] == "record.v2" and "sampling" in rec
+        with DurableRequestJournal(path) as j2:
+            e = j2.live()[0]
+            assert e.sampling == sp
+
+    def test_sampled_adopt_v2_across_files(self, tmp_path):
+        """Migration of a sampled request: the adopting file logs
+        ``adopt.v2`` with the params, self-contained — the target replica
+        re-derives the same keys without the source's log."""
+        pa, pb = str(tmp_path / "a.log"), str(tmp_path / "b.log")
+        sp = SamplingParams(temperature=1.2, seed=5)
+        r = _req([9, 8], sampling=sp)
+        with DurableRequestJournal(pa) as ja, DurableRequestJournal(pb) as jb:
+            ja.record(r)
+            jb.adopt(ja.detach(r.uid))
+        with open(pb, encoding="utf-8") as f:
+            (rec,) = [_unframe(ln) for ln in f]
+        assert rec["kind"] == "adopt.v2"
+        with DurableRequestJournal(pb) as jb2:
+            assert jb2.live()[0].sampling == sp
+
+    def test_v2_kind_folds_to_nothing_for_old_reader(self, tmp_path):
+        """Back-compat contract both ways: the unknown-kind rule means a
+        pre-sampling reader folds ``record.v2`` to nothing (loses only
+        the sampled request), and THIS reader must skip a hypothetical
+        ``record.v3`` the same way — never a tear, never a wedge."""
+        import json
+
+        path = str(tmp_path / "j.log")
+        a = _req([1, 2])
+        with DurableRequestJournal(path) as j:
+            j.record(a)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(_frame(json.dumps({"kind": "record.v3", "uid": 4242,
+                                       "exotic": True})))
+        with DurableRequestJournal(path) as j2:
+            assert j2.corrupt_tail_truncations == 0
+            assert j2.replayed_records == 2
+            assert j2.uids() == [a.uid]
+
+
 class TestOwnershipTransfer:
     def test_detach_adopt_across_files(self, tmp_path):
         """The migration pair on disk: after a detach+adopt, each file
@@ -234,24 +304,30 @@ class TestOwnershipTransfer:
 
 
 class TestHostCrashReplay:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "temp0.8"])
     def test_scheduler_replays_bitwise_after_host_loss(self, setup,
-                                                       tmp_path):
+                                                       tmp_path, sampled):
         """The durability acceptance: a scheduler journaling to disk is
         killed mid-flight (host process loss — nothing in memory
         survives). A FRESH scheduler opens the log, adopts every live
         entry (bare entries — requests reconstruct from serialized
         fields), and finishes each request bitwise identical to an
-        uninterrupted reference run."""
+        uninterrupted reference run. The sampled twin rides the
+        ``record.v2`` kinds: the reloaded params re-derive every key."""
         m, params = setup
         rng = np.random.default_rng(23)
         prompts = [rng.integers(0, 128, int(rng.integers(8, 25))).tolist()
                    for _ in range(4)]
         uids = [9100 + i for i in range(4)]
+        sp = ({u: SamplingParams(temperature=0.8, seed=u) for u in uids}
+              if sampled else {})
 
         ref_sched = ContinuousBatchScheduler(
             _engine(m, params), retry=RetryPolicy(max_attempts=5),
             sleep=lambda s: None)
-        refs = [ref_sched.submit(p, max_new_tokens=6, uid=u)
+        refs = [ref_sched.submit(p, max_new_tokens=6, uid=u,
+                                 sampling=sp.get(u))
                 for p, u in zip(prompts, uids)]
         ref_sched.run_until_complete()
         assert all(r.state is RequestState.DONE for r in refs)
@@ -262,7 +338,7 @@ class TestHostCrashReplay:
             _engine(m, params), journal=j1,
             retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
         for p, u in zip(prompts, uids):
-            s1.submit(p, max_new_tokens=6, uid=u)
+            s1.submit(p, max_new_tokens=6, uid=u, sampling=sp.get(u))
         for _ in range(6):   # partial progress: some tokens committed
             s1.step()
         j1.close()           # host dies here; s1 is never touched again

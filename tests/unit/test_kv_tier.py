@@ -23,7 +23,7 @@ from deepspeed_tpu.inference.v2.ragged_manager import (_ROOT, BlockedKVCache,
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.resilience import FaultInjector, RetryPolicy
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
-                                 RequestState)
+                                 RequestState, SamplingParams)
 from deepspeed_tpu.serve.metrics import ServeMetrics
 
 
@@ -55,7 +55,7 @@ def _pressure_workload():
 
 
 def _run_sched(m, params, *, num_blocks, host_tier_blocks, swap=None,
-               wrap=None, **sched_kw):
+               wrap=None, sampled=False, **sched_kw):
     eng = _engine(m, params, num_blocks=num_blocks,
                   host_tier_blocks=host_tier_blocks)
     sched_kw.setdefault("retry", RetryPolicy(max_attempts=5))
@@ -63,7 +63,10 @@ def _run_sched(m, params, *, num_blocks, host_tier_blocks, swap=None,
         eng if wrap is None else wrap(eng), sleep=lambda s: None,
         swap_preemption=swap, **sched_kw)
     prompts, gen = _pressure_workload()
-    reqs = [sched.submit(p, max_new_tokens=gen, uid=100 + i)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=100 + i,
+                         sampling=(SamplingParams(temperature=0.8,
+                                                  seed=200 + i)
+                                   if sampled else None))
             for i, p in enumerate(prompts)]
     return sched, eng, reqs
 
@@ -71,17 +74,18 @@ def _run_sched(m, params, *, num_blocks, host_tier_blocks, swap=None,
 _BASELINE = {}
 
 
-def _baseline(m, params):
+def _baseline(m, params, sampled=False):
     """Untiered, unpressured oracle for the pressure workload (memoized:
-    greedy decoding makes pool size and preemption invisible in tokens)."""
-    if "ref" not in _BASELINE:
+    the counter-based per-request keys make pool size and preemption
+    invisible in tokens, greedy or sampled — docs/SAMPLING.md)."""
+    if sampled not in _BASELINE:
         sched, _, reqs = _run_sched(m, params, num_blocks=41,
-                                    host_tier_blocks=0)
+                                    host_tier_blocks=0, sampled=sampled)
         sched.run_until_complete()
         assert all(r.state is RequestState.DONE for r in reqs)
         assert sched.metrics.preemptions == 0  # truly unpressured
-        _BASELINE["ref"] = {r.uid: list(r.tokens) for r in reqs}
-    return _BASELINE["ref"]
+        _BASELINE[sampled] = {r.uid: list(r.tokens) for r in reqs}
+    return _BASELINE[sampled]
 
 
 def _assert_bounds(eng):
@@ -376,19 +380,26 @@ class TestEngineTier:
 # ---------------------------------------------------------------------------
 
 class TestSwapPreemption:
-    @pytest.mark.parametrize("swap", [True, None, False],
-                             ids=["forced-swap", "auto", "forced-recompute"])
-    def test_pressure_workload_bitwise(self, setup, swap):
+    @pytest.mark.parametrize("swap,sampled",
+                             [(True, False), (None, False), (False, False),
+                              (True, True)],
+                             ids=["forced-swap", "auto", "forced-recompute",
+                                  "forced-swap-temp0.8"])
+    def test_pressure_workload_bitwise(self, setup, swap, sampled):
         """The acceptance core: a 12-block pool forces decode-time
         preemption on the pressure workload; with the host tier on, all
         three ``swap_preemption`` modes emit tokens bitwise identical to
         the unpressured untiered baseline. Forced-swap must complete a real
         swap_out -> hold -> swap_in round trip; auto's first swap is the
-        bandwidth probe; forced-recompute must never touch the swap path."""
+        bandwidth probe; forced-recompute must never touch the swap path.
+        The sampled forced-swap twin proves swap-in resumes the stochastic
+        stream bitwise (docs/SAMPLING.md: keys derive from position, not
+        residency)."""
         m, params = setup
-        ref = _baseline(m, params)
+        ref = _baseline(m, params, sampled=sampled)
         sched, eng, reqs = _run_sched(m, params, num_blocks=13,
-                                      host_tier_blocks=32, swap=swap)
+                                      host_tier_blocks=32, swap=swap,
+                                      sampled=sampled)
         sched.run_until_complete()
         assert all(r.state is RequestState.DONE for r in reqs)
         assert {r.uid: list(r.tokens) for r in reqs} == ref

@@ -20,7 +20,7 @@ from deepspeed_tpu.resilience import (FaultInjector, FaultSpec,
                                       RetryPolicy, UnrecoverableEngineError)
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
                                  PromptLookupProposer, Request, RequestState,
-                                 Router, SchedulerClosedError)
+                                 Router, SamplingParams, SchedulerClosedError)
 from deepspeed_tpu.serve.metrics import PoolMetrics
 from deepspeed_tpu.serve.pool import DEAD, DRAINING, SERVING
 
@@ -55,18 +55,27 @@ def _workload(seed=17, n=6, lo=8, hi=25, gen=6):
 _REF_MEMO = {}
 
 
-def _reference(m, params, prompts, uids, gen, **eng_kw):
-    """Fault-free single-engine run — the bitwise oracle (greedy decoding
-    makes placement/migration invisible in the tokens). Memoized per
-    workload: several tests share a workload and the oracle is pure."""
+def _sampled(uids):
+    """Per-uid seeded temperature sampling — the stochastic twin of the
+    greedy workload (docs/SAMPLING.md: migration/death replay must stay
+    bitwise under sampling too, via the counter-based per-request keys)."""
+    return {u: SamplingParams(temperature=0.8, seed=u) for u in uids}
+
+
+def _reference(m, params, prompts, uids, gen, sampling=None, **eng_kw):
+    """Fault-free single-engine run — the bitwise oracle (per-request
+    counter-based keys make placement/migration invisible in the tokens,
+    sampled or greedy). Memoized per workload: several tests share a
+    workload and the oracle is pure."""
     key = (tuple(map(tuple, prompts)), tuple(uids), gen,
-           tuple(sorted(eng_kw.items())))
+           repr(sampling), tuple(sorted(eng_kw.items())))
     if key in _REF_MEMO:
         return _REF_MEMO[key]
     sched = ContinuousBatchScheduler(
         _engine(m, params, **eng_kw), retry=RetryPolicy(max_attempts=5),
         sleep=lambda s: None)
-    reqs = [sched.submit(p, max_new_tokens=gen, uid=u)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=u,
+                         sampling=(sampling or {}).get(u))
             for p, u in zip(prompts, uids)]
     sched.run_until_complete()
     assert all(r.state is RequestState.DONE for r in reqs)
@@ -354,16 +363,22 @@ class TestPlacement:
 # ---------------------------------------------------------------------------
 
 class TestMigration:
-    @pytest.mark.parametrize("steps", [1, 4])
-    def test_migration_bitwise_vs_never_migrated(self, setup, steps):
+    @pytest.mark.parametrize("steps,sampled",
+                             [(1, False), (4, False), (4, True)],
+                             ids=["prefill-greedy", "decode-greedy",
+                                  "decode-temp0.8"])
+    def test_migration_bitwise_vs_never_migrated(self, setup, steps, sampled):
         """Mid-prefill (1 step: chunked prefill still feeding) and
         mid-decode (4 steps: committed tokens exist) migration — the
-        moved request finishes bitwise identical to the reference."""
+        moved request finishes bitwise identical to the reference, under
+        greedy and under per-request seeded temperature (the adopting
+        replica re-derives the same counter-based keys)."""
         m, params = setup
         prompts, uids, gen = _workload(n=4, gen=4)
-        ref = _reference(m, params, prompts, uids, gen)
+        sp = _sampled(uids) if sampled else {}
+        ref = _reference(m, params, prompts, uids, gen, sampling=sp or None)
         pool, _, _ = _pool(m, params, 2)
-        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u, sampling=sp.get(u))
                 for p, u in zip(prompts, uids)]
         for _ in range(steps):
             pool.step()
@@ -504,19 +519,24 @@ class TestPoolOwnership:
 # ---------------------------------------------------------------------------
 
 class TestReplicaDeath:
-    def test_death_replays_across_two_survivors_bitwise(self, setup):
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "temp0.8"])
+    def test_death_replays_across_two_survivors_bitwise(self, setup, sampled):
         """The acceptance core: a replica dies mid-load in a 3-replica
         pool; its journal replays across BOTH survivors and every request
         completes bitwise identical to the fault-free single-engine
-        reference. Survivors' compiled-program bounds hold."""
+        reference — greedy and sampled (the survivors re-derive each
+        request's counter-based keys from the journaled params).
+        Survivors' compiled-program bounds hold."""
         m, params = setup
         prompts, uids, gen = _workload(n=4, gen=4)
-        ref = _reference(m, params, prompts, uids, gen)
+        sp = _sampled(uids) if sampled else {}
+        ref = _reference(m, params, prompts, uids, gen, sampling=sp or None)
         pool, engines, injectors = _pool(
             m, params, 3,
             specs_for={0: [FaultSpec(site="put", kind="device_lost",
                                      nth=2)]})
-        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u, sampling=sp.get(u))
                 for p, u in zip(prompts, uids)]
         pool.run_until_complete()
         assert injectors[0].deaths == 1

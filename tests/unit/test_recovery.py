@@ -22,7 +22,8 @@ from deepspeed_tpu.resilience import (BreakerState, CircuitBreaker,
                                       TransientEngineError,
                                       UnrecoverableEngineError)
 from deepspeed_tpu.serve import (ContinuousBatchScheduler,
-                                 PromptLookupProposer, Request, RequestState)
+                                 PromptLookupProposer, Request, RequestState,
+                                 SamplingParams)
 
 
 @pytest.fixture(scope="module")
@@ -53,9 +54,12 @@ def _assert_pool_restored(eng):
 
 
 def _run_workload(m, params, n_req, *, specs=None, seed=17, eng_kw=None,
-                  **sched_kw):
+                  sampled=False, **sched_kw):
     """Submit ``n_req`` seeded requests, run to completion, return
-    (scheduler, engine, injector, requests in submission order)."""
+    (scheduler, engine, injector, requests in submission order).
+    ``sampled=True`` gives each request its own seeded temperature-0.8
+    :class:`SamplingParams` — the stochastic twin of the greedy workload
+    (docs/SAMPLING.md: replay must stay bitwise either way)."""
     rng = np.random.default_rng(seed)
     prompts = [rng.integers(0, 128, int(rng.integers(8, 25))).tolist()
                for _ in range(n_req)]
@@ -65,7 +69,11 @@ def _run_workload(m, params, n_req, *, specs=None, seed=17, eng_kw=None,
     driven = eng if inj is None else inj.wrap(eng)
     sched_kw.setdefault("retry", RetryPolicy(max_attempts=5))
     sched = ContinuousBatchScheduler(driven, sleep=lambda s: None, **sched_kw)
-    reqs = [sched.submit(p, max_new_tokens=g) for p, g in zip(prompts, gens)]
+    reqs = [sched.submit(p, max_new_tokens=g,
+                         sampling=(SamplingParams(temperature=0.8,
+                                                  seed=100 + i)
+                                   if sampled else None))
+            for i, (p, g) in enumerate(zip(prompts, gens))]
     sched.run_until_complete()
     return sched, eng, inj, reqs
 
@@ -288,17 +296,21 @@ class TestEngineRebuild:
 
 
 class TestSchedulerRecovery:
-    def test_mid_decode_loss_bitwise(self, setup):
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "temp0.8"])
+    def test_mid_decode_loss_bitwise(self, setup, sampled):
         """The acceptance core: seeded engine deaths mid-decode; every
         request completes with tokens bitwise identical to the fault-free
         run, the journal drains, the pool comes back whole, the breaker
-        trail records the HALF_OPEN probe walk."""
+        trail records the HALF_OPEN probe walk. The sampled twin proves
+        the counter-based PRNG keys (docs/SAMPLING.md) re-derive the same
+        tokens across the rebuild replay."""
         m, params = setup
-        _, ref_eng, _, ref = _run_workload(m, params, 6)
+        _, ref_eng, _, ref = _run_workload(m, params, 6, sampled=sampled)
         assert all(r.state is RequestState.DONE for r in ref)
         _assert_pool_restored(ref_eng)
         sched, eng, inj, reqs = _run_workload(
-            m, params, 6,
+            m, params, 6, sampled=sampled,
             specs=[FaultSpec(site="decode_multi", kind="device_lost", nth=3),
                    FaultSpec(site="put", kind="device_lost", nth=11)],
             eng_kw={"decode_horizon": 4})

@@ -1,0 +1,316 @@
+"""Sampling & structured generation tests (docs/SAMPLING.md): the
+SamplingParams record (validation, normalization, serialization, fanout
+child-seed derivation), the StopScanner's rolling tail buffer (matches
+spanning token boundaries), combined_bias composition and its validation
+surface, and the scheduler-level behaviours — sampled-vs-greedy
+divergence, stop-sequence truncation with speculative-overrun rollback,
+n>1 fanout (stream 0 == the n=1 stream), device-applied logit bias,
+dynamic processors collapsing the fused horizon, and the compiled-program
+bounds under a mixed greedy/sampled load."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, QueueFullError,
+                                 RequestState, SamplingParams, StopScanner,
+                                 combined_bias)
+from deepspeed_tpu.serve.sampling import MAX_SEED, derive_child_seed
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _run(sched, reqs):
+    sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+def _solo(m, params, prompt, gen, sampling=None, **ekw):
+    sched = ContinuousBatchScheduler(_engine(m, params, **ekw))
+    req = sched.submit(prompt, max_new_tokens=gen, sampling=sampling)
+    return _run(sched, [req])[0]
+
+
+PROMPT = list(range(1, 9))
+
+
+class TestSamplingParams:
+    def test_validation_surface(self):
+        with pytest.raises(ValueError, match="temperature"):
+            SamplingParams(temperature=-0.1)
+        with pytest.raises(ValueError, match="temperature"):
+            SamplingParams(temperature=float("inf"))
+        with pytest.raises(ValueError, match="top_k"):
+            SamplingParams(top_k=-1)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=1.5)
+        with pytest.raises(ValueError, match="seed"):
+            SamplingParams(seed=-1)
+        with pytest.raises(ValueError, match="seed"):
+            SamplingParams(seed=MAX_SEED)
+        with pytest.raises(ValueError, match="n must"):
+            SamplingParams(n=0)
+        with pytest.raises(ValueError, match="best_of"):
+            SamplingParams(n=3, best_of=2)
+        with pytest.raises(ValueError, match="empty stop"):
+            SamplingParams(stop=((),))
+        with pytest.raises(ValueError, match="logit_bias"):
+            SamplingParams(logit_bias={-1: 0.5})
+
+    def test_normalization(self):
+        # a bare int stop is one single-token sequence
+        assert SamplingParams(stop=5).stop == ((5,),)
+        assert SamplingParams(stop=((7, 8), 9)).stop == ((7, 8), (9,))
+        # logit_bias: dict or pair-iterable -> sorted pair tuple
+        sp = SamplingParams(logit_bias={9: 1.0, 2: -3.0})
+        assert sp.logit_bias == ((2, -3.0), (9, 1.0))
+        assert SamplingParams(logit_bias=[(4, 0.5)]).logit_bias == ((4, 0.5),)
+
+    def test_derived_properties(self):
+        assert SamplingParams().is_greedy
+        assert not SamplingParams().needs_engine
+        assert not SamplingParams(stop=(5,)).needs_engine  # host-side only
+        assert not SamplingParams(temperature=0.7).is_greedy
+        assert SamplingParams(temperature=0.7).needs_engine
+        assert SamplingParams(logit_bias={1: 1.0}).needs_engine
+        masker = lambda ctx, v: None  # noqa: E731
+        assert SamplingParams(processors=(masker,)).needs_engine
+        assert not SamplingParams(processors=(masker,)).dynamic
+        masker.dynamic = True
+        assert SamplingParams(processors=(masker,)).dynamic
+
+    def test_child_streams(self):
+        sp = SamplingParams(temperature=0.9, seed=123, n=3, best_of=4,
+                            top_k=7, stop=(5,))
+        c0 = sp.child(0)
+        # stream 0 IS the n=1 stream: same seed, same shaping
+        assert c0.seed == 123 and c0.n == 1 and c0.best_of is None
+        assert c0.top_k == 7 and c0.stop == ((5,),)
+        seeds = {sp.child(i).seed for i in range(8)}
+        assert len(seeds) == 8
+        assert all(0 <= s < MAX_SEED for s in seeds)
+        assert derive_child_seed(123, 0) == 123
+        assert derive_child_seed(123, 2) == sp.child(2).seed
+
+    def test_dict_round_trip_excludes_processors(self):
+        sp = SamplingParams(temperature=0.8, top_k=40, top_p=0.9, seed=7,
+                            n=2, best_of=3, stop=((1, 2),),
+                            logit_bias={3: -2.0},
+                            processors=(lambda ctx, v: None,))
+        d = sp.to_dict()
+        assert "processors" not in d
+        back = SamplingParams.from_dict(d)
+        assert back == sp  # processors excluded from equality
+        assert back.processors == ()
+        # defaults serialize minimal and come back as defaults
+        assert SamplingParams.from_dict(SamplingParams().to_dict()) == \
+            SamplingParams()
+
+
+class TestStopScanner:
+    def test_match_spans_token_boundary(self):
+        sc = StopScanner([(3, 4, 5)])
+        assert sc.push(3) == 0 and sc.push(4) == 0
+        assert sc.push(5) == 3  # completes across three pushes
+
+    def test_history_seeds_the_tail(self):
+        # replay reconstruction: committed tokens already hold the first
+        # half of the stop — the next push must still complete the match
+        sc = StopScanner([(7, 8)], history=[1, 2, 7])
+        assert sc.push(8) == 2
+
+    def test_multiple_stops_and_lengths(self):
+        sc = StopScanner([(9,), (1, 2, 3)])
+        assert sc.push(1) == 0 and sc.push(2) == 0
+        assert sc.push(9) == 1  # the shorter stop fires mid-window
+        sc2 = StopScanner([(1, 2, 3)], history=[1, 2])
+        assert sc2.push(3) == 3
+
+    def test_no_stops_never_matches(self):
+        sc = StopScanner([])
+        assert sc.push(5) == 0 and sc.maxlen == 0
+
+
+class TestCombinedBias:
+    def test_none_when_unconstrained(self):
+        assert combined_bias(SamplingParams(temperature=0.8), 16) is None
+
+    def test_static_bias_row(self):
+        row = combined_bias(SamplingParams(logit_bias={3: 2.5, 5: -1.0}), 8)
+        assert row.shape == (8,) and row.dtype == np.float32
+        assert row[3] == 2.5 and row[5] == -1.0 and row[0] == 0.0
+
+    def test_bias_token_beyond_vocab_rejected(self):
+        with pytest.raises(ValueError, match="vocab size"):
+            combined_bias(SamplingParams(logit_bias={99: 1.0}), 8)
+
+    def test_processor_masks_compose_additively(self):
+        def mask_low(ctx, v):
+            row = np.zeros(v, np.float32)
+            row[0] = -1e9
+            return row
+
+        def none_proc(ctx, v):
+            return None
+
+        sp = SamplingParams(logit_bias={1: 2.0},
+                            processors=(mask_low, none_proc))
+        row = combined_bias(sp, 4)
+        assert row[0] == -1e9 and row[1] == 2.0
+
+    def test_processor_shape_mismatch_rejected(self):
+        sp = SamplingParams(processors=(lambda ctx, v: np.zeros(3),))
+        with pytest.raises(ValueError, match="shape"):
+            combined_bias(sp, 8)
+
+
+class TestSchedulerSampling:
+    def test_sampled_diverges_from_greedy_and_replays(self, setup):
+        """temperature really samples (stream != greedy) and the same
+        (seed, position) keys make an identical resubmission bitwise."""
+        m, params = setup
+        sp = SamplingParams(temperature=0.8, seed=1234)
+        base = _solo(m, params, PROMPT, 10, sampling=sp)
+        assert len(base) == 10
+        assert base != _solo(m, params, PROMPT, 10)
+        assert base == _solo(m, params, PROMPT, 10, sampling=sp)
+
+    def test_stop_sequence_truncates_with_rollback(self, setup):
+        """A 2-token stop spanning a token boundary: emission ends ON the
+        completing token (stop tokens are emitted), later fused-horizon
+        overrun rolls back, and the stop_hits metric counts it."""
+        m, params = setup
+        sp = SamplingParams(temperature=0.8, seed=1234)
+        base = _solo(m, params, PROMPT, 10, sampling=sp)
+        stopped = SamplingParams(temperature=0.8, seed=1234,
+                                 stop=(tuple(base[3:5]),))
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng)
+        req = sched.submit(PROMPT, max_new_tokens=10, sampling=stopped)
+        assert _run(sched, [req])[0] == base[:5]
+        assert sched.metrics.sampling["stop_hits"] == 1
+        assert not eng.state.seqs
+
+    def test_fanout_stream0_matches_n1(self, setup):
+        """n=3 shares the prompt via COW prefix blocks: stream 0 is the
+        n=1 stream bitwise, siblings are distinct, and the prefix cache
+        actually deduplicated the prompt prefill."""
+        m, params = setup
+        # a prompt longer than one block: the siblings' shared prefix has
+        # full blocks for the cache to deduplicate
+        prompt = list(range(1, 41))
+        base = _solo(m, params, prompt, 10,
+                     sampling=SamplingParams(temperature=0.8, seed=1234))
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng)
+        first = sched.submit(prompt, max_new_tokens=10,
+                             sampling=SamplingParams(temperature=0.8,
+                                                     seed=1234, n=3))
+        sibs = first.fanout
+        assert len(sibs) == 3 and sibs[0] is first
+        outs = _run(sched, sibs)
+        assert outs[0] == base
+        assert len({tuple(o) for o in outs}) == 3
+        assert sched.metrics.sampling["fanout_streams"] == 3
+        # COW prompt sharing: siblings admitted together dedup their
+        # identical full prompt blocks post-prefill (staggered admission
+        # would surface as lookup hits instead)
+        stats = eng.prefix_cache_stats()
+        assert stats["hits"] + stats["dedup_blocks"] > 0
+
+    def test_fanout_backpressure_is_atomic(self, setup):
+        """A fanout that cannot fully fit the queue is rejected whole —
+        no partial sibling admission."""
+        m, params = setup
+        sched = ContinuousBatchScheduler(_engine(m, params), max_queue=2)
+        with pytest.raises(QueueFullError):
+            sched.submit(PROMPT, max_new_tokens=4, arrival_time=99.0,
+                         sampling=SamplingParams(temperature=0.5, seed=1,
+                                                 n=3))
+        assert sched.metrics.admission_rejects == 1
+        assert len(sched._queue) == 0
+        sched.run_until_complete()
+
+    def test_logit_bias_forces_tokens_on_device(self, setup):
+        m, params = setup
+        out = _solo(m, params, PROMPT, 4,
+                    sampling=SamplingParams(logit_bias={42: 1e9}))
+        assert out == [42] * 4
+
+    def test_submit_rejects_bias_beyond_vocab(self, setup):
+        m, params = setup
+        sched = ContinuousBatchScheduler(_engine(m, params))
+        with pytest.raises(ValueError, match="vocab"):
+            sched.submit(PROMPT, max_new_tokens=4,
+                         sampling=SamplingParams(logit_bias={500: 1.0}))
+        sched.run_until_complete()
+
+    def test_dynamic_processor_masks_per_token(self, setup):
+        """A dynamic processor re-evaluates after every committed token
+        (the scheduler collapses the fused horizon to 1 for it) — the
+        mask cycles with context length, and the emitted stream follows
+        it exactly."""
+        m, params = setup
+
+        class Cycler:
+            dynamic = True
+
+            def __call__(self, ctx, vocab):
+                row = np.full(vocab, -1e9, np.float32)
+                row[(len(ctx) % 5) + 100] = 0.0
+                return row
+
+        sched = ContinuousBatchScheduler(_engine(m, params))
+        req = sched.submit(PROMPT, max_new_tokens=5,
+                           sampling=SamplingParams(processors=(Cycler(),)))
+        out = _run(sched, [req])[0]
+        assert out == [((len(PROMPT) + i) % 5) + 100 for i in range(5)]
+        assert sched.metrics.sampling["bias_refreshes"] > 0
+
+    def test_trace_bounds_under_mixed_load(self, setup):
+        """REGRESSION (the tentpole's no-new-modes clause): sampling
+        params ride as runtime per-row arrays, so a mixed greedy/sampled
+        workload — fused decode included — adds ZERO compiled programs
+        beyond today's bounds (ragged <= 4, fused <= 1, verify <= 1)."""
+        m, params = setup
+        rng = np.random.default_rng(4)
+        eng = _engine(m, params, decode_horizon=4)
+        sched = ContinuousBatchScheduler(eng)
+        reqs = []
+        for i in range(6):
+            sp = (SamplingParams(temperature=0.8, seed=50 + i, top_k=20,
+                                 top_p=0.9) if i % 2 else None)
+            reqs.append(sched.submit(
+                rng.integers(0, 128, int(rng.integers(8, 30))).tolist(),
+                max_new_tokens=int(rng.integers(4, 10)), sampling=sp))
+            sched.step()
+        _run(sched, reqs)
+        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+        assert sched.metrics.sampling["sampled_requests"] == 3
+        assert sched.metrics.sampling["sampled_tokens"] > 0
+        ev = {k: v for k, v, _ in sched.monitor_events(step=1)}
+        assert "serve/sampling/sampled_requests" in ev
+        eng.block_mgr.check_invariants([])

@@ -12,7 +12,8 @@ import pytest
 from deepspeed_tpu.inference.v2 import InferenceEngineV2
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, QueueFullError,
-                                 RequestState, SchedulerClosedError)
+                                 RequestState, SamplingParams,
+                                 SchedulerClosedError)
 
 
 @pytest.fixture(scope="module")
@@ -34,11 +35,13 @@ def _engine(m, params, **kw):
     return InferenceEngineV2(m, params, paged=True, **kw)
 
 
-def _run_solo(m, params, prompt, max_new_tokens):
-    """Uncontended reference: one request, ample pool, greedy tokens."""
+def _run_solo(m, params, prompt, max_new_tokens, sampling=None):
+    """Uncontended reference: one request, ample pool, greedy (or, with
+    ``sampling``, seeded stochastic) tokens."""
     eng = _engine(m, params, num_blocks=64)
     sched = ContinuousBatchScheduler(eng)
-    req = sched.submit(prompt, max_new_tokens=max_new_tokens)
+    req = sched.submit(prompt, max_new_tokens=max_new_tokens,
+                       sampling=sampling)
     sched.run_until_complete()
     assert req.state is RequestState.DONE
     return list(req.tokens)
@@ -113,30 +116,36 @@ class TestLifecycleAndStreaming:
 
 
 class TestPreemption:
-    def test_preempt_readmit_bitwise_and_cache_replay(self, setup):
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "temp0.8"])
+    def test_preempt_readmit_bitwise_and_cache_replay(self, setup, sampled):
         """The acceptance scenario: an undersized pool forces the scheduler
         to preempt a low-priority request for a high-priority arrival; the
         victim re-admits through the prefix cache (its surviving full blocks
-        map straight back) and BOTH requests finish with greedy tokens
-        bitwise-identical to uncontended runs."""
+        map straight back) and BOTH requests finish with tokens
+        bitwise-identical to uncontended runs — greedy and, in the sampled
+        twin, under per-request seeded temperature (the counter-based keys
+        of docs/SAMPLING.md make re-admission replay exact)."""
         m, params = setup
         rng = np.random.default_rng(1)
         pA = rng.integers(0, 128, 48).tolist()
         pB = rng.integers(0, 128, 48).tolist()
-        refA = _run_solo(m, params, pA, 24)
-        refB = _run_solo(m, params, pB, 8)
+        spA = SamplingParams(temperature=0.8, seed=11) if sampled else None
+        spB = SamplingParams(temperature=0.8, seed=22) if sampled else None
+        refA = _run_solo(m, params, pA, 24, sampling=spA)
+        refB = _run_solo(m, params, pB, 8, sampling=spB)
         # 6 usable blocks; A peaks at 5, B at 4 — they cannot coexist
         eng = _engine(m, params, num_blocks=7)
         sched = ContinuousBatchScheduler(eng)
-        rA = sched.submit(pA, max_new_tokens=24, priority=0)
+        rA = sched.submit(pA, max_new_tokens=24, priority=0, sampling=spA)
         for _ in range(4):
             sched.step()
-        rB = sched.submit(pB, max_new_tokens=8, priority=5)
+        rB = sched.submit(pB, max_new_tokens=8, priority=5, sampling=spB)
         sched.run_until_complete()
         assert rA.state is RequestState.DONE and rB.state is RequestState.DONE
         assert sched.metrics.preemptions > 0 and rA.preemptions > 0
         assert sched.metrics.preempted_blocks_reclaimed > 0
-        assert rA.tokens == refA and rB.tokens == refB  # bitwise, greedy
+        assert rA.tokens == refA and rB.tokens == refB  # bitwise
         stats = eng.prefix_cache_stats()
         assert stats["hits"] > 0  # re-admission replayed cached blocks
         assert stats["skipped_prefill_tokens"] > 0

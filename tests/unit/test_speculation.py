@@ -20,7 +20,7 @@ from deepspeed_tpu.resilience.errors import (ContextOverflowError,
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, DraftModelProposer,
                                  DraftProposer, FaultInjector,
                                  PromptLookupProposer, RequestState,
-                                 SpecPolicy)
+                                 SamplingParams, SpecPolicy)
 
 
 @pytest.fixture(scope="module")
@@ -48,11 +48,12 @@ def _prompts(n=3):
 
 
 def _run_sched(m, params, prompts, gen=16, eos=None, priorities=None,
-               proposer=None, **ekw):
+               proposer=None, sampling=None, **ekw):
     eng = _engine(m, params, **ekw)
     sched = ContinuousBatchScheduler(eng, proposer=proposer)
     prios = priorities or [0] * len(prompts)
-    reqs = [sched.submit(p, max_new_tokens=gen, eos_token=eos, priority=pr)
+    reqs = [sched.submit(p, max_new_tokens=gen, eos_token=eos, priority=pr,
+                         sampling=sampling)
             for p, pr in zip(prompts, prios)]
     sched.run_until_complete()
     return eng, sched, reqs
@@ -321,6 +322,28 @@ class TestSpecScheduler:
         assert ev["serve/spec/steps"] > 0
         assert "serve/spec/acceptance_rate" in ev
         assert "serve/spec/draft_horizon" in ev
+        assert not eng.state.seqs
+
+    def test_spec_under_temperature_token_for_token(self, setup):
+        """Rejection-sampling verification under temperature
+        (docs/SAMPLING.md): the speculative sampled stream matches the
+        non-speculative sampled stream token for token — the target's own
+        per-(seed, position) categorical sample decides every position;
+        drafts only move where the verify dispatch lands, never what it
+        emits. Compiled-program bounds hold."""
+        m, params = setup
+        prompts = _prompts()
+        sp = SamplingParams(temperature=0.8, seed=31)
+        _, s1, r1 = _run_sched(m, params, prompts, sampling=sp)
+        eng, ss, rs = _run_sched(m, params, prompts, decode_horizon=4,
+                                 proposer=PromptLookupProposer(), sampling=sp)
+        assert [r.tokens for r in rs] == [r.tokens for r in r1]
+        # sampling was really on: the stream differs from plain greedy
+        greedy = [r.tokens for r in _run_sched(m, params, prompts)[2]]
+        assert [r.tokens for r in rs] != greedy
+        assert ss.metrics.spec["steps"] > 0  # verification really ran
+        assert eng.verify_cache_size <= 1
+        assert eng.fused_cache_size <= 1 and eng.ragged_cache_size <= 4
         assert not eng.state.seqs
 
     def test_eos_inside_accepted_draft_prefix(self, setup):
