@@ -56,6 +56,13 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     # regression DSTPU001 should catch
     "_demote_block", "_scatter_blocks", "_drain_promotions",
     "swap_out", "swap_in", "_swap_in_readmit", "_preempt", "_swap_wins",
+    # ZeRO gather/scatter/reduce-scatter paths (docs/ZERO.md): the host-tier
+    # Adam loop carries ONE designed D2H gradient sync per leaf (suppressed at
+    # the site); the offload step dispatcher and the stage-3 residency
+    # gather/prefetch must otherwise stay sync- and allocation-free — every
+    # stray materialization here multiplies by optimizer steps/second
+    "adam_step", "_step_offload",
+    "_ensure_zero3_params", "_z3_release_and_prefetch",
 })
 
 #: where the hot-path rules (001/002) apply — ``resilience`` joined when

@@ -581,3 +581,73 @@ def check_offload_split(host_idx, dev_idx, n_leaves: int) -> None:
             f"[sanitizer] offload split does not cover the parameter tree: "
             f"missing leaves {sorted(missing)}, out-of-range "
             f"{sorted(extra)} (n_leaves={n_leaves})")
+
+
+def check_shard_conservation(leaf_sizes, bounds, shard_slices=None,
+                             dtype=None) -> None:
+    """ZeRO shard partition (zero/partition.py ``PartitionPlan``): the
+    per-rank shards must PARTITION every leaf's flat element range —
+    contiguous bounds that start at 0, end at the leaf size, and never run
+    backwards (disjoint + covering), with every rank present for every leaf.
+    Optionally, ``shard_slices[r][j]`` (the concrete per-rank flat arrays —
+    e.g. the slices a sharded checkpoint carries, or the views a gather is
+    about to concatenate) are checked against the bounds: element counts and
+    dtype must be conserved, so a shard file that was truncated, duplicated,
+    or down-cast is caught before its bytes reach optimizer state. Checked at
+    partition build, checkpoint save, and consolidation (docs/ZERO.md)."""
+    import numpy as np
+
+    n_leaves = len(leaf_sizes)
+    if len(bounds) != n_leaves:
+        raise SanitizerError(
+            f"[sanitizer] shard plan covers {len(bounds)} leaves but the "
+            f"parameter tree has {n_leaves}")
+    num_shards = None
+    for j, (size, bs) in enumerate(zip(leaf_sizes, bounds)):
+        bs = list(bs)
+        if num_shards is None:
+            num_shards = len(bs) - 1
+        elif len(bs) - 1 != num_shards:
+            raise SanitizerError(
+                f"[sanitizer] shard bounds for leaf {j} describe "
+                f"{len(bs) - 1} shards, leaf 0 describes {num_shards} — "
+                "ranks would disagree on the partition")
+        if not bs or bs[0] != 0 or bs[-1] != int(size):
+            raise SanitizerError(
+                f"[sanitizer] shard bounds for leaf {j} do not cover it: "
+                f"bounds {bs} over {int(size)} elements (a dropped head or "
+                "tail shard would silently never be optimizer-stepped)")
+        for r in range(len(bs) - 1):
+            if bs[r] > bs[r + 1]:
+                raise SanitizerError(
+                    f"[sanitizer] shard bounds for leaf {j} run backwards at "
+                    f"rank {r}: {bs} — overlapping shards would double-step "
+                    "the shared elements")
+    if shard_slices is None:
+        return
+    if num_shards is None:
+        num_shards = 0
+    if len(shard_slices) != num_shards:
+        raise SanitizerError(
+            f"[sanitizer] {len(shard_slices)} shard slice sets for a "
+            f"{num_shards}-shard plan — a rank's state is missing or "
+            "duplicated")
+    for r, slices in enumerate(shard_slices):
+        if len(slices) != n_leaves:
+            raise SanitizerError(
+                f"[sanitizer] shard {r} carries {len(slices)} leaf slices, "
+                f"expected {n_leaves}")
+        for j, sl in enumerate(slices):
+            want = bounds[j][r + 1] - bounds[j][r]
+            got = int(np.size(sl))
+            if got != want:
+                raise SanitizerError(
+                    f"[sanitizer] shard {r} leaf {j} size not conserved: "
+                    f"{got} elements vs bounds [{bounds[j][r]}, "
+                    f"{bounds[j][r + 1]}) = {want}")
+            if dtype is not None and np.dtype(getattr(sl, "dtype", dtype)) \
+                    != np.dtype(dtype):
+                raise SanitizerError(
+                    f"[sanitizer] shard {r} leaf {j} dtype changed: "
+                    f"{np.dtype(sl.dtype)} vs required {np.dtype(dtype)} — "
+                    "a lossy cast snuck into the shard path")

@@ -49,6 +49,31 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str, tag: Optional[str] = N
     if os.path.exists(opt_path):
         optim_sd = eng.load(opt_path)
 
+    if optim_sd is not None and "zero_sharded" in optim_sd:
+        # stage>=2 sharded save: consolidate the per-rank moment files into
+        # full leaves, then hang them on the module's tree structure so the
+        # per-parameter writer below names them like any other checkpoint
+        import jax
+
+        from ..runtime.checkpoint_engine.consolidate import (
+            consolidate_sharded_optim,
+        )
+
+        cons = consolidate_sharded_optim(eng, src, optim_sd)
+        module = model_sd["module"]
+        treedef = jax.tree.structure(module)
+        shapes = [np.shape(l) for l in jax.tree.leaves(module)]
+        optim_sd = {
+            "step": cons["step"],
+            "scaler": cons.get("scaler"),
+            "m": jax.tree.unflatten(treedef, [
+                np.asarray(m, np.float32).reshape(s)
+                for m, s in zip(cons["m"], shapes)]),
+            "v": jax.tree.unflatten(treedef, [
+                np.asarray(v, np.float32).reshape(s)
+                for v, s in zip(cons["v"], shapes)]),
+        }
+
     zdir = os.path.join(output_dir, UNIVERSAL_DIRNAME)
     os.makedirs(zdir, exist_ok=True)
     index = {}
@@ -103,7 +128,9 @@ def load_universal_into_engine(engine, universal_dir: str):
     shard_flat = jax.tree_util.tree_leaves(engine._param_shardings)
     opt_shard_flat = jax.tree_util.tree_leaves(engine._opt_shardings)
 
+    offloaded = getattr(engine, "_offload_mgr", None) is not None
     new_params, new_master, new_m, new_v = [], [], [], []
+    host_w, host_m, host_v = [], [], []
     have_moments = True
     for i, name in enumerate(names):
         pdir = os.path.join(zdir, name.replace("/", "."))
@@ -123,33 +150,62 @@ def load_universal_into_engine(engine, universal_dir: str):
             return w
 
         w = fit(np.load(os.path.join(pdir, "fp32.npy")))
+        host_w.append(w)
         new_params.append(jax.device_put(
             jnp.asarray(w, engine.compute_dtype), shard_flat[i]))
-        if engine._mixed:
+        if engine._mixed and not offloaded:
             new_master.append(jax.device_put(jnp.asarray(w, jnp.float32),
                                              opt_shard_flat[i]))
         m_path = os.path.join(pdir, "exp_avg.npy")
         if os.path.exists(m_path):
-            new_m.append(jax.device_put(
-                jnp.asarray(fit(np.load(m_path))), opt_shard_flat[i]))
-            new_v.append(jax.device_put(
-                jnp.asarray(fit(np.load(os.path.join(pdir, "exp_avg_sq.npy")))),
-                opt_shard_flat[i]))
+            m_np = fit(np.load(m_path))
+            v_np = fit(np.load(os.path.join(pdir, "exp_avg_sq.npy")))
+            host_m.append(m_np)
+            host_v.append(v_np)
+            if engine.opt_state is not None:
+                new_m.append(jax.device_put(jnp.asarray(m_np), opt_shard_flat[i]))
+                new_v.append(jax.device_put(jnp.asarray(v_np), opt_shard_flat[i]))
         else:
             have_moments = False
 
+    opt_step = meta.get("optimizer_step")
+    if opt_step is None:  # may legitimately be 0 — no falsy-or
+        opt_step = meta["step"]
     engine.params = jax.tree_util.tree_unflatten(treedef, new_params)
     if engine._mixed and new_master:
         engine.master_params = jax.tree_util.tree_unflatten(treedef, new_master)
     if engine.opt_state is not None and have_moments:
-        opt_step = meta.get("optimizer_step")
-        if opt_step is None:  # may legitimately be 0 — no falsy-or
-            opt_step = meta["step"]
         engine.opt_state = engine.opt_state._replace(
             step=jnp.asarray(opt_step, jnp.int32),
             m=jax.tree_util.tree_unflatten(treedef, new_m),
             v=jax.tree_util.tree_unflatten(treedef, new_v),
         )
+    if offloaded:
+        # host-resident fp32 master (flat offload AND the ZeRO-2/3 sharded
+        # tier — the tier's per-rank views alias the full buffers, so the
+        # full-leaf assignment restores every shard): master always comes
+        # from the fp32 files; moments when the universal dir carries them
+        mgr = engine._offload_mgr
+        host = mgr["host"]
+        for j, i in enumerate(mgr["host_idx"]):
+            host.master[j][...] = np.asarray(host_w[i], np.float32)
+        if have_moments and getattr(host, "m", None) is not None:
+            for j, i in enumerate(mgr["host_idx"]):
+                host.m[j][...] = np.asarray(host_m[i], np.float32).reshape(-1)
+                host.v[j][...] = np.asarray(host_v[i], np.float32).reshape(-1)
+            host.step_count = int(opt_step)
+        if mgr["dev"] is not None:
+            for j, i in enumerate(mgr["dev_idx"]):
+                mgr["dev"]["master"][j] = jax.device_put(
+                    jnp.asarray(host_w[i], jnp.float32), opt_shard_flat[i])
+                if have_moments:
+                    mgr["dev"]["m"][j] = jax.device_put(
+                        jnp.asarray(host_m[i], jnp.float32), opt_shard_flat[i])
+                    mgr["dev"]["v"][j] = jax.device_put(
+                        jnp.asarray(host_v[i], jnp.float32), opt_shard_flat[i])
+        if getattr(engine, "_z3_residency", False):
+            engine._z3_released.clear()
+            engine._z3_prefetched.clear()
     engine.global_steps = meta["step"]
     engine.global_samples = meta.get("global_samples", 0)
     sc = meta.get("scaler")

@@ -260,4 +260,8 @@ class TrainingSupervisor:
             "watchdog_breaches": self.watchdog.breaches,
             "breaker_state": self.breaker.state.name,
             "faults_fired": dict(injector.fired) if injector else {},
+            # ZeRO sharded-tier traffic (empty dict when the engine runs
+            # without the tier — or is a fake without the accessor)
+            "zero": (self.engine.zero_metrics()
+                     if hasattr(self.engine, "zero_metrics") else {}),
         }

@@ -454,12 +454,29 @@ class DeepSpeedEngine:
         stage = config.zero_config.stage
         self.zero_stage = stage
         topo = self.topology
-        self._param_specs = stage_param_specs(
-            params, stage, topo, tp_specs,
-            persistence_threshold=config.zero_config.param_persistence_threshold if stage >= 3 else 0,
+        off = config.zero_config.offload_optimizer
+        self._offload_enabled = bool(
+            off is not None and off.device in ("cpu", "nvme")
         )
-        self._grad_specs = stage_grad_specs(params, stage, topo, tp_specs)
-        self._opt_specs = stage_opt_specs(params, stage, topo, tp_specs)
+        # Cross-replica weight-update sharding (docs/ZERO.md): at stage >= 2
+        # with the FULL optimizer state host-resident (cpu offload, ratio 1),
+        # gradient/optimizer partitioning moves to the host tier's per-rank
+        # update loop (ZeroShardedTier) — params and grads keep stage-0 specs
+        # so the compiled fwd/bwd program is identical to the unsharded loop,
+        # which is what makes stage-2/3 bitwise-comparable to stage 0. Partial
+        # (ratio < 1) or NVMe offload at stage >= 2 falls back to the flat
+        # offload path with the declarative GSPMD specs.
+        self._zero_sharded_planned = bool(
+            stage >= 2 and off is not None and off.device == "cpu"
+            and off.ratio == 1.0
+        )
+        spec_stage = 0 if self._zero_sharded_planned else stage
+        self._param_specs = stage_param_specs(
+            params, spec_stage, topo, tp_specs,
+            persistence_threshold=config.zero_config.param_persistence_threshold if spec_stage >= 3 else 0,
+        )
+        self._grad_specs = stage_grad_specs(params, spec_stage, topo, tp_specs)
+        self._opt_specs = stage_opt_specs(params, spec_stage, topo, tp_specs)
         self._param_shardings = to_named(self._param_specs, topo)
         self._grad_shardings = to_named(self._grad_specs, topo)
         self._opt_shardings = to_named(self._opt_specs, topo)
@@ -467,10 +484,6 @@ class DeepSpeedEngine:
         self._replicated = NamedSharding(topo.mesh, PartitionSpec())
 
         # place lp params (compute dtype) and fp32 master
-        off = config.zero_config.offload_optimizer
-        self._offload_enabled = bool(
-            off is not None and off.device in ("cpu", "nvme")
-        )
         if sharded_init:
             from ..zero import sharded_dual_init
 
@@ -507,6 +520,11 @@ class DeepSpeedEngine:
         else:
             self.optimizer = None
         self._offload_mgr = None
+        # ZeRO-2/3 sharded host tier state (set by _setup_offload when planned)
+        self._zero_tier = None
+        self._z3_residency = False
+        self._z3_released = {}
+        self._z3_prefetched = set()
         if self.optimizer is not None and self._offload_enabled:
             self.opt_state = None
             self._setup_offload(off, params)
@@ -1113,28 +1131,52 @@ class DeepSpeedEngine:
             lr=opt.lr, betas=opt.betas, eps=opt.eps, weight_decay=opt.weight_decay,
             bias_correction=opt.bias_correction, adamw_mode=opt.adam_w_mode,
         )
-        host_state = OffloadedAdamState(
-            [np.asarray(leaves[i], np.float32) for i in host_idx],
-            device=off.device, nvme_path=off.nvme_path,
-        )
-        opt_shardings_flat = jax.tree.leaves(self._opt_shardings)
         dev_state = None
-        if dev_idx:
-            dev_master = [jax.device_put(jnp.asarray(leaves[i], jnp.float32),
-                                         opt_shardings_flat[i]) for i in dev_idx]
-            dev_state = {
-                "master": dev_master,
-                "m": [jnp.zeros_like(m) for m in dev_master],
-                "v": [jnp.zeros_like(m) for m in dev_master],
-            }
+        if self._zero_sharded_planned:
+            # stage >= 2: the host tier shards the optimizer state per DP rank
+            # (ratio == 1 guaranteed by the predicate, so host_idx is every
+            # leaf and there is no device twin-flow subset)
+            from .zero.partition import PartitionPlan
+            from .zero.sharded import ZeroShardedTier
+
+            plan = PartitionPlan(
+                [leaves[i] for i in host_idx],
+                self.topology.data_parallel_size,
+                sanitize=sanitize_enabled(),
+            )
+            host_state = ZeroShardedTier(
+                [np.asarray(leaves[i], np.float32) for i in host_idx],
+                plan, stage=self.zero_stage,
+            )
+            self._zero_tier = host_state
+            self._z3_residency = self.zero_stage >= 3
+            log_dist(
+                f"ZeRO-{self.zero_stage} sharded tier: {len(host_idx)} leaves "
+                f"-> cpu, optimizer state in {plan.num_shards} shards "
+                f"(~{plan.shard_bytes(0) // 1024} KiB/shard)", ranks=[0],
+            )
+        else:
+            host_state = OffloadedAdamState(
+                [np.asarray(leaves[i], np.float32) for i in host_idx],
+                device=off.device, nvme_path=off.nvme_path,
+            )
+            opt_shardings_flat = jax.tree.leaves(self._opt_shardings)
+            if dev_idx:
+                dev_master = [jax.device_put(jnp.asarray(leaves[i], jnp.float32),
+                                             opt_shardings_flat[i]) for i in dev_idx]
+                dev_state = {
+                    "master": dev_master,
+                    "m": [jnp.zeros_like(m) for m in dev_master],
+                    "v": [jnp.zeros_like(m) for m in dev_master],
+                }
+            log_dist(
+                f"ZeRO-Offload: {len(host_idx)} leaves -> {off.device} "
+                f"(ratio={off.ratio}), {len(dev_idx)} stay on device", ranks=[0],
+            )
         self._offload_mgr = {
             "treedef": treedef, "host_idx": host_idx, "dev_idx": dev_idx,
             "host": host_state, "dev": dev_state, "cpu_opt": cpu_opt,
         }
-        log_dist(
-            f"ZeRO-Offload: {len(host_idx)} leaves -> {off.device} "
-            f"(ratio={off.ratio}), {len(dev_idx)} stay on device", ranks=[0],
-        )
 
     def _step_offload(self, lr: float):
         """Optimizer step with offloaded states. Host leaves run the C++ CPU
@@ -1205,6 +1247,7 @@ class DeepSpeedEngine:
         params_flat = list(jax.tree.leaves(self.params))
         shard_flat = jax.tree.leaves(self._param_shardings)
         np_compute = np.dtype(self.compute_dtype)
+        tier = self._zero_tier
 
         def _writeback(j, master_np):
             # per-leaf H2D upload, dispatched while the NEXT leaf's host Adam
@@ -1214,6 +1257,10 @@ class DeepSpeedEngine:
             lp_np = master_np if np_compute == master_np.dtype else \
                 master_np.astype(np_compute)
             params_flat[i] = jax.device_put(lp_np, shard_flat[i])
+            if tier is not None:
+                # the updated-weights all-gather of the sharded tier
+                tier.counters["gathers"] += 1
+                tier.counters["offload_bytes_out"] += lp_np.nbytes
 
         mgr["host"].adam_step(
             mgr["cpu_opt"], host_grads_dev, lr, grad_scale=inv_scale,
@@ -1233,6 +1280,90 @@ class DeepSpeedEngine:
                 self.scaler_state, jnp.asarray(False)
             )
         return False, gnorm
+
+    # ------------------------------------------------------------------
+    # ZeRO-3 parameter residency (docs/ZERO.md "Stage-3 residency window")
+    # ------------------------------------------------------------------
+    def _z3_release_and_prefetch(self):
+        """After the step's writeback: demote the largest non-persistent lp
+        leaves to the tier's host cache until the live-element count fits
+        ``max_live_parameters`` (the params-sharded-at-rest half of stage 3),
+        then re-upload up to ``prefetch_bucket_size`` bytes so the next
+        forward starts with its window warm. The cached host array is the
+        SAME compute-dtype cast the writeback uploaded, so a release/upload
+        round trip is byte-exact — residency never changes the math."""
+        tier = self._zero_tier
+        zc = self.config.zero_config
+        sizes = tier.plan.leaf_sizes
+        released = self._z3_released
+        live = sum(sizes) - sum(sizes[j] for j in released)
+        if live > zc.max_live_parameters:
+            params_flat = list(jax.tree.leaves(self.params))
+            np_compute = np.dtype(jnp.dtype(self.compute_dtype).name)
+            for j in sorted(range(len(sizes)), key=lambda j: -sizes[j]):
+                if live <= zc.max_live_parameters:
+                    break
+                if j in released or sizes[j] <= zc.param_persistence_threshold:
+                    continue
+                released[j] = tier.master[j].astype(np_compute)
+                leaf = params_flat[j]
+                if hasattr(leaf, "delete"):
+                    leaf.delete()  # the device shard is actually freed
+                live -= sizes[j]
+        if not released:
+            return
+        # prefetch window, in leaf order (the order forward consumes them)
+        budget = int(zc.prefetch_bucket_size)
+        params_flat = list(jax.tree.leaves(self.params))
+        shard_flat = jax.tree.leaves(self._param_shardings)
+        changed = False
+        for j in sorted(released):
+            lp = released[j]
+            if lp.nbytes > budget:
+                break
+            budget -= lp.nbytes
+            params_flat[j] = jax.device_put(lp, shard_flat[j])
+            del released[j]
+            self._z3_prefetched.add(j)
+            tier.counters["gathers"] += 1
+            tier.counters["offload_bytes_out"] += lp.nbytes
+            changed = True
+        if changed:
+            self.params = jax.tree.unflatten(
+                self._offload_mgr["treedef"], params_flat)
+
+    def _ensure_zero3_params(self):
+        """On-demand all-gather before a forward: upload every leaf the
+        residency window released and the prefetcher did not restore. Leaves
+        the window DID restore count as prefetch hits — the knob's figure of
+        merit."""
+        tier = self._zero_tier
+        released = self._z3_released
+        pre = self._z3_prefetched
+        if pre:
+            tier.counters["prefetch_hits"] += sum(
+                1 for j in pre if j not in released)
+            pre.clear()
+        if not released:
+            return
+        params_flat = list(jax.tree.leaves(self.params))
+        shard_flat = jax.tree.leaves(self._param_shardings)
+        for j in sorted(released):
+            lp = released.pop(j)
+            params_flat[j] = jax.device_put(lp, shard_flat[j])
+            tier.counters["gathers"] += 1
+            tier.counters["offload_bytes_out"] += lp.nbytes
+        self.params = jax.tree.unflatten(
+            self._offload_mgr["treedef"], params_flat)
+
+    def zero_metrics(self):
+        """``train/zero/*`` counter snapshot (empty when no sharded tier)."""
+        tier = self._zero_tier
+        if tier is None:
+            return {}
+        out = dict(tier.counters)
+        out["shard_bytes"] = tier.shard_bytes(0)
+        return out
 
     # ------------------------------------------------------------------
     # reference API surface
@@ -1314,6 +1445,11 @@ class DeepSpeedEngine:
                 "inside `batch` (the apply_fn receives it whole)"
             )
         self.timers(FORWARD_MICRO_TIMER).start()
+        if self._z3_residency:
+            # stage-3 on-demand all-gather: any leaf the residency window
+            # released since the last step must be device-resident before the
+            # compiled program below captures self.params
+            self._ensure_zero3_params()
         batch = self._shard_batch(self._inject_train_kwargs(batch))
         if not getattr(self, "_training", True):
             loss = self._eval_fn(self.params, batch)
@@ -1423,13 +1559,18 @@ class DeepSpeedEngine:
         ``torch.cuda.synchronize()`` in the reference's distributed test
         harness (reference tests/unit/common.py:113).
         """
-        jax.block_until_ready(jax.tree.leaves((
+        leaves = jax.tree.leaves((
             self.params,
             getattr(self, "master_params", None),
             getattr(self, "opt_state", None),
             getattr(self, "scaler_state", None),
             getattr(self, "_acc_grads", None),
-        )))
+        ))
+        # stage-3 residency may have released (deleted) lp leaves between a
+        # step and the next forward — there is nothing in flight to wait on
+        jax.block_until_ready([
+            l for l in leaves
+            if not (hasattr(l, "is_deleted") and l.is_deleted())])
         return self
 
     def get_lr(self):
@@ -1453,6 +1594,9 @@ class DeepSpeedEngine:
                 self.skipped_steps += 1
             elif self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+            if self._z3_residency and not overflow:
+                self._z3_release_and_prefetch()
+            self._step_telemetry(gnorm)
             self.timers(STEP_MICRO_TIMER).stop()
             return
         if self._step_fn is None:
@@ -1683,21 +1827,28 @@ class DeepSpeedEngine:
         ``force`` fires the cadence actions regardless of the modulo — used by
         the multi-step path whose counters advance in K-jumps."""
         every = self.config.steps_per_print
+        # the offload/sharded step paths skip the norm when clipping is off —
+        # telemetry must not crash on the absent value
+        gn = float("nan") if gnorm is None else float(gnorm)
         if every and (force or self.global_steps % every == 0):
             log_dist(
                 f"step={self.global_steps} lr={self.get_lr()} "
-                f"grad_norm={float(gnorm):.4f} skipped={self.skipped_steps}",
+                f"grad_norm={gn:.4f} skipped={self.skipped_steps}",
                 ranks=[0],
             )
         if self.monitor.enabled and jax.process_index() == 0:
             # float() is a device sync — pay it only at the print cadence
             if force or self.global_steps % max(1, every or 1) == 0:
-                self.monitor.write_events([
+                events = [
                     ("Train/Samples/lr", float(self.get_lr()[0]), self.global_samples),
                     ("Train/Samples/loss_scale", float(self.scaler_state.cur_scale),
                      self.global_samples),
-                    ("Train/Samples/grad_norm", float(gnorm), self.global_samples),
-                ])
+                    ("Train/Samples/grad_norm", gn, self.global_samples),
+                ]
+                # train/zero/* counter group (docs/ZERO.md "Observability")
+                events += [(f"Train/ZeRO/{k}", float(v), self.global_samples)
+                           for k, v in self.zero_metrics().items()]
+                self.monitor.write_events(events)
 
     # ------------------------------------------------------------------
     def _shard_batch(self, batch):
@@ -1761,6 +1912,50 @@ class DeepSpeedEngine:
                 and os.path.exists(os.path.join(load_dir, n, "model_states.ckpt"))]
         return sorted(ring, key=step_of, reverse=True)
 
+    def _save_sharded_optim(self, tag_dir, optim_path, plan, m_leaves,
+                            v_leaves, step):
+        """Stage>=2 optimizer save (docs/ZERO.md "Sharded checkpoints"):
+        ``optim_states.ckpt`` becomes a small metadata record (partition plan
+        + step + scaler) and the Adam moments go to one file per rank, each
+        independently durable under the manifest-last protocol. The fp32
+        master is NOT written here — the checkpoint's module tree already
+        carries it. Slices are snapshot copies: with an async checkpoint
+        engine the write happens later, while the live buffers keep
+        mutating."""
+        from .checkpoint_engine.consolidate import shard_path
+
+        optim_sd = {
+            "zero_sharded": plan.describe(),
+            "step": int(step),
+            "scaler": _gather_to_host(self.scaler_state._asdict()),
+        }
+        m_flat = [np.asarray(m, np.float32).reshape(-1) for m in m_leaves]
+        v_flat = [np.asarray(v, np.float32).reshape(-1) for v in v_leaves]
+        shard_sds = []
+        for r in range(plan.num_shards):
+            sl = plan.slices(r)
+            shard_sds.append({
+                "rank": r, "num_shards": plan.num_shards,
+                "m": [np.array(m_flat[j][lo:hi], copy=True)
+                      for j, (lo, hi) in enumerate(sl)],
+                "v": [np.array(v_flat[j][lo:hi], copy=True)
+                      for j, (lo, hi) in enumerate(sl)],
+            })
+        from ..analysis.sanitizer import sanitize_enabled
+
+        if sanitize_enabled():
+            from ..analysis.sanitizer import check_shard_conservation
+
+            # the slices about to hit disk must still partition the state —
+            # a buggy plan or aliasing slip would save silently wrong
+            check_shard_conservation(plan.leaf_sizes, plan.bounds,
+                                     [s["m"] for s in shard_sds],
+                                     dtype=np.float32)
+        if jax.process_index() == 0:
+            self.checkpoint_engine.save(optim_sd, optim_path)
+            for r, sd in enumerate(shard_sds):
+                self.checkpoint_engine.save(sd, shard_path(tag_dir, r))
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         if tag is None:
@@ -1801,7 +1996,12 @@ class DeepSpeedEngine:
         if jax.process_index() == 0:
             self.checkpoint_engine.save(model_sd, model_path)
 
-        if self._offload_mgr is not None:
+        if self._zero_tier is not None:
+            self._save_sharded_optim(d, optim_path, self._zero_tier.plan,
+                                     [m for m in self._zero_tier.m],
+                                     [v for v in self._zero_tier.v],
+                                     self._zero_tier.step_count)
+        elif self._offload_mgr is not None:
             mgr = self._offload_mgr
             optim_sd = {
                 "offload_host": mgr["host"].state_dict(),
@@ -1818,6 +2018,20 @@ class DeepSpeedEngine:
             }
             if jax.process_index() == 0:
                 self.checkpoint_engine.save(optim_sd, optim_path)
+        elif self.opt_state is not None and self.zero_stage >= 2 \
+                and self.opt_state.m is not None:
+            # device-resident stage-2/3 moments save per-shard too: gather the
+            # global arrays once, then slice under a fresh partition plan
+            from .zero.partition import PartitionPlan
+
+            host_mv = _gather_to_host({"m": self.opt_state.m,
+                                       "v": self.opt_state.v})
+            m_leaves = jax.tree.leaves(host_mv["m"])
+            v_leaves = jax.tree.leaves(host_mv["v"])
+            plan = PartitionPlan(m_leaves, self.topology.data_parallel_size)
+            self._save_sharded_optim(
+                d, optim_path, plan, m_leaves, v_leaves,
+                int(np.asarray(jax.device_get(self.opt_state.step))))
         elif self.opt_state is not None:
             optim_sd = {
                 "step": self.opt_state.step,
@@ -1890,6 +2104,17 @@ class DeepSpeedEngine:
                 o_sd = (self.checkpoint_engine.load(optim_path)
                         if want_optim and os.path.exists(optim_path)
                         else None)
+                if o_sd is not None and "zero_sharded" in o_sd:
+                    # stage>=2 sharded save: rebuild full-leaf moments from
+                    # the per-rank files INSIDE the ring loop, so a torn or
+                    # missing shard falls back to the previous durable tag
+                    # like any other corrupt file
+                    from .checkpoint_engine.consolidate import (
+                        consolidate_sharded_optim,
+                    )
+
+                    o_sd = consolidate_sharded_optim(
+                        self.checkpoint_engine, d, o_sd)
             except CheckpointCorruptError as e:
                 e.tag = e.tag or t
                 last_err = e
@@ -1957,9 +2182,36 @@ class DeepSpeedEngine:
         self._train_iter = None
         if getattr(self, "_exec_queue", None):
             self._exec_queue.clear()
+        if self._z3_residency:
+            # params were just fully re-materialized — the residency window
+            # restarts empty
+            self._z3_released.clear()
+            self._z3_prefetched.clear()
 
         if load_lr_scheduler_states and self.lr_scheduler is not None and "lr_scheduler" in model_sd:
             self.lr_scheduler.load_state_dict(model_sd["lr_scheduler"])
+
+        if optim_sd is not None and optim_sd.get("_consolidated"):
+            # sharded save, consolidated above — normalize into the format
+            # THIS engine's restore branch expects (elastic across stage,
+            # precision, offload mode, and rank count)
+            optim_sd = self._adapt_consolidated_optim(optim_sd, module)
+        if self._offload_mgr is not None and optim_sd is not None \
+                and "offload_host" not in optim_sd:
+            # legacy device-format checkpoint restoring into an offloaded/
+            # sharded engine: synthesize the flat-offload format (master
+            # comes from the module tree either way)
+            if optim_sd.get("m") is None:
+                optim_sd = None
+            else:
+                optim_sd = self._adapt_consolidated_optim({
+                    "step": int(np.asarray(optim_sd["step"])),
+                    "scaler": optim_sd.get("scaler"),
+                    "m": [np.asarray(l, np.float32)
+                          for l in jax.tree.leaves(optim_sd["m"])],
+                    "v": [np.asarray(l, np.float32)
+                          for l in jax.tree.leaves(optim_sd["v"])],
+                }, module)
 
         if self._offload_mgr is not None and optim_sd is not None:
             mgr = self._offload_mgr
@@ -2040,6 +2292,44 @@ class DeepSpeedEngine:
 
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
+
+    def _adapt_consolidated_optim(self, optim_sd, module):
+        """Normalize consolidated full-leaf moments (from a sharded save —
+        or a legacy device-format dict pre-flattened by the caller) into the
+        restore format THIS engine uses. The fp32 master always comes from
+        the module tree: module weights ARE the master copies, so shard files
+        never duplicate them (docs/ZERO.md "Sharded checkpoints")."""
+        step, sc = int(optim_sd["step"]), optim_sd.get("scaler")
+        m_list, v_list = optim_sd["m"], optim_sd["v"]
+        if self._offload_mgr is not None:
+            flat = jax.tree.leaves(module)
+            return {
+                "offload_host": {
+                    "step": step,
+                    "master": [np.asarray(l, np.float32) for l in flat],
+                    "m": [np.asarray(m, np.float32).reshape(-1)
+                          for m in m_list],
+                    "v": [np.asarray(v, np.float32).reshape(-1)
+                          for v in v_list],
+                },
+                "offload_dev": None,
+                # full-range split: the offload branch reshards under the
+                # engine's own ratio split / partition plan as needed
+                "host_idx": list(range(len(flat))),
+                "dev_idx": [],
+                "scaler": sc,
+            }
+        if self.opt_state is not None:
+            treedef = jax.tree.structure(self.params)
+            shapes = [tuple(p.shape) for p in jax.tree.leaves(self.params)]
+            m_tree = jax.tree.unflatten(treedef, [
+                np.asarray(m, np.float32).reshape(s)
+                for m, s in zip(m_list, shapes)])
+            v_tree = jax.tree.unflatten(treedef, [
+                np.asarray(v, np.float32).reshape(s)
+                for v, s in zip(v_list, shapes)])
+            return {"step": step, "m": m_tree, "v": v_tree, "scaler": sc}
+        return None
 
     def _reshard_offload_load(self, optim_sd, saved_h, saved_d):
         """Restore offloaded optimizer state saved under a DIFFERENT ratio

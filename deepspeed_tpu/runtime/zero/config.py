@@ -149,8 +149,36 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     )
 
 
+# stage-3 tuning knobs (canonical spelling -> accepted alias spellings): they
+# only drive the stage-3 parameter-residency machinery, so supplying them at
+# stage < 3 means the user believes they are tuning something that is inert
+_STAGE3_KNOBS = {
+    "prefetch_bucket_size": ("stage3_prefetch_bucket_size",),
+    "param_persistence_threshold": ("stage3_param_persistence_threshold",),
+    "model_persistence_threshold": ("stage3_model_persistence_threshold",),
+    "max_live_parameters": ("stage3_max_live_parameters",),
+    "max_reuse_distance": ("stage3_max_reuse_distance",),
+    "gather_16bit_weights_on_model_save": (
+        "stage3_gather_16bit_weights_on_model_save",
+        "stage3_gather_fp16_weights_on_model_save",
+    ),
+}
+
+
 def zero_config_from_dict(d) -> DeepSpeedZeroConfig:
     cfg = DeepSpeedZeroConfig.from_dict(d or {})
+    # stage-3 knobs at stage < 3 were silently accepted — say so explicitly
+    # (the values ARE recorded on the config; they just drive nothing)
+    if cfg.stage < 3 and d:
+        stray = [k for canonical, aliases in _STAGE3_KNOBS.items()
+                 for k in (canonical, *aliases) if k in d]
+        if stray:
+            from ...utils.logging import logger
+
+            logger.warning(
+                f"zero_optimization: stage-3 knob(s) {stray} supplied at "
+                f"stage={cfg.stage} — they only affect the stage-3 parameter "
+                "residency window and are inert at this stage")
     # normalize legacy cpu_offload flags into offload_optimizer
     if cfg.cpu_offload and cfg.offload_optimizer is None:
         cfg.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(device="cpu")

@@ -1,0 +1,122 @@
+"""ZeRO-2/3 sharded optimizer tier: host-RAM optimizer state, per-rank update.
+
+This is the cross-replica weight-update sharding formulation (PAPERS.md:
+2004.13336) fused with ZeRO-Infinity-style host offload (2104.07857): the
+compute-dtype parameters stay replicated on device (so the compiled fwd/bwd
+program is IDENTICAL to the unsharded stage-0 loop), while the fp32 master and
+Adam moments live in host RAM partitioned by a :class:`~.partition.PartitionPlan`
+— rank ``r`` owns the flat element range ``[bounds[r], bounds[r+1])`` of every
+leaf. One training step is then:
+
+  reduce-scatter  → one batched D2H gradient pull per micro-step (each rank
+                    reads only its slice of the already-reduced gradient)
+  sharded update  → the C++ CPU Adam runs per (leaf, rank) slice; the kernel
+                    is purely elementwise, so the sharded update is BITWISE
+                    identical to stepping the whole leaf — this is the whole
+                    bitwise-vs-stage-0 argument (docs/ZERO.md)
+  all-gather      → per-leaf H2D upload of the updated compute-dtype weights,
+                    dispatched while the next leaf's host Adam still runs
+
+Storage is one full contiguous fp32 buffer per leaf with per-rank slice VIEWS:
+the per-rank loop IS the semantic sharding (each ``step_flat`` call touches
+only its rank's range), while consolidation for checkpoints/gathers is free —
+the full buffer is always assembled. Sharded checkpoints still serialize
+per-rank slices (``shard_state_dict``) so each shard file is independently
+durable under the manifest-last protocol and a corrupt shard is detected at
+consolidation, not after restore.
+
+Stage 3 adds parameter residency on top (driven by the existing ``stage3_*``
+knobs): after each step's writeback, the largest non-persistent leaves are
+released to a host-side compute-dtype cache until the live-element count fits
+``max_live_parameters``; a prefetch window re-uploads ``prefetch_bucket_size``
+bytes ahead of the next forward, and the engine's ``_ensure_zero3_params``
+uploads the remainder on demand. Residency moves exact bytes (the cached lp
+array is the same host-side cast the writeback uploads), so it never changes
+the math.
+"""
+
+from typing import Dict, List
+
+import numpy as np
+
+from .offload import OffloadedAdamState
+from .partition import PartitionPlan
+
+
+class ZeroShardedTier(OffloadedAdamState):
+    """Host-RAM tier holding the sharded fp32 master + Adam moments."""
+
+    def __init__(self, leaves: List[np.ndarray], plan: PartitionPlan,
+                 stage: int = 2):
+        super().__init__(leaves, device="cpu")
+        self.plan = plan
+        self.stage = int(stage)
+        # train/zero/* counters (docs/ZERO.md "Observability"): collective
+        # analogs on the host tier, drained via engine.zero_metrics()
+        self.counters: Dict[str, int] = {
+            "gathers": 0,             # param all-gathers (H2D uploads)
+            "reduce_scatters": 0,     # gradient D2H pulls (one per leaf/step)
+            "prefetch_hits": 0,       # stage-3 forwards served by the window
+            "offload_bytes_in": 0,    # D2H bytes (gradients)
+            "offload_bytes_out": 0,   # H2D bytes (updated params)
+        }
+
+    # ------------------------------------------------------------------
+    def adam_step(self, opt, grads: List, lr: float,
+                  grad_scale: float = 1.0, clip_coef: float = 1.0,
+                  on_leaf=None) -> List[np.ndarray]:
+        """Sharded update: per (leaf, rank) ``step_flat`` over the plan's slice
+        views. Same contract as the base class — ``grads`` may be device
+        arrays with D2H copies already in flight, and ``on_leaf(j, master_j)``
+        fires after leaf ``j``'s LAST rank so the engine's writeback uploads a
+        fully updated leaf."""
+        self.step_count += 1
+        bounds = self.plan.bounds
+        nranks = self.plan.num_shards
+        for j in range(len(self.master)):
+            # the step's ONE designed D2H sync per leaf: materialize the
+            # reduced gradient the per-rank slices below read
+            g = np.asarray(grads[j], np.float32).reshape(-1)  # dstpu-lint: ignore[DSTPU001]
+            self.counters["reduce_scatters"] += 1
+            self.counters["offload_bytes_in"] += g.nbytes
+            p = self.master[j].reshape(-1)
+            m, v = self.m[j], self.v[j]
+            bj = bounds[j]
+            for r in range(nranks):
+                lo, hi = bj[r], bj[r + 1]
+                if lo == hi:
+                    continue  # a leaf smaller than the rank count
+                opt.step_flat(p[lo:hi], g[lo:hi], m[lo:hi], v[lo:hi],
+                              self.step_count, lr=lr, grad_scale=grad_scale,
+                              clip_coef=clip_coef)
+            if on_leaf is not None:
+                on_leaf(j, self.master[j])
+        return self.master
+
+    # ------------------------------------------------------------------
+    def shard_state_dict(self, rank: int) -> Dict:
+        """Rank ``rank``'s slice of the moments — one sharded-checkpoint file.
+
+        The fp32 master is NOT duplicated here: the checkpoint's module tree
+        already carries it (module weights ARE the master copies under
+        offload), so shard files hold only what the module doesn't."""
+        out_m, out_v = [], []
+        for j, (lo, hi) in enumerate(self.plan.slices(rank)):
+            out_m.append(np.array(self.m[j][lo:hi], copy=True))
+            out_v.append(np.array(self.v[j][lo:hi], copy=True))
+        return {"rank": int(rank), "num_shards": self.plan.num_shards,
+                "m": out_m, "v": out_v}
+
+    def load_full_moments(self, m_full: List[np.ndarray],
+                          v_full: List[np.ndarray], step: int):
+        """Scatter consolidated full-leaf moments back into the tier (the
+        per-rank views alias the same buffers, so assigning the full array
+        restores every shard at once)."""
+        self.step_count = int(step)
+        for j in range(len(self.m)):
+            self.m[j][...] = np.asarray(m_full[j], np.float32).reshape(-1)
+            self.v[j][...] = np.asarray(v_full[j], np.float32).reshape(-1)
+
+    def shard_bytes(self, rank: int = 0) -> int:
+        """Optimizer-state bytes rank ``rank`` owns (master + m + v, fp32)."""
+        return 3 * self.plan.shard_bytes(rank, itemsize=4)

@@ -340,6 +340,10 @@ class ContinuousBatchScheduler:
                 self.metrics.observe_fanout(sampling.n)
                 return first
             sampling = sampling.child(0)  # normalize best_of off the record
+        if len(self._queue) >= self.max_queue:
+            self.metrics.admission_rejects += 1
+            raise QueueFullError(
+                f"serve queue full ({self.max_queue}); request rejected")
         kw = {} if uid is None else {"uid": uid}
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       priority=priority, deadline=deadline,

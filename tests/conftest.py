@@ -149,6 +149,7 @@ _SANITIZE_FILES = (
     "test_pool.py",
     "test_journal_durability.py",
     "test_kv_tier.py",
+    "test_zero_sharded.py",
 )
 
 

@@ -75,6 +75,62 @@ def test_zero_invalid_stage():
         DeepSpeedZeroConfig.from_dict({"stage": 5})
 
 
+def test_zero_config_all_stage3_alias_spellings_round_trip():
+    # every alias spelling lands on its canonical field (docs/ZERO.md)
+    z = DeepSpeedZeroConfig.from_dict({
+        "stage": 3,
+        "stage3_prefetch_bucket_size": 11,
+        "stage3_param_persistence_threshold": 22,
+        "stage3_model_persistence_threshold": 33,
+        "stage3_max_live_parameters": 44,
+        "stage3_max_reuse_distance": 55,
+        "stage3_gather_16bit_weights_on_model_save": True,
+    })
+    assert z.prefetch_bucket_size == 11
+    assert z.param_persistence_threshold == 22
+    assert z.model_persistence_threshold == 33
+    assert z.max_live_parameters == 44
+    assert z.max_reuse_distance == 55
+    assert z.gather_16bit_weights_on_model_save is True
+    # legacy fp16 alias of the gather flag resolves too
+    z2 = DeepSpeedZeroConfig.from_dict(
+        {"stage": 3, "stage3_gather_fp16_weights_on_model_save": True})
+    assert z2.gather_16bit_weights_on_model_save is True
+
+
+def test_zero_stage3_knobs_below_stage3_warn(monkeypatch):
+    # the package logger has propagate=False, so capture at the source
+    from deepspeed_tpu.runtime.zero.config import zero_config_from_dict
+    from deepspeed_tpu.utils.logging import logger
+
+    msgs = []
+    monkeypatch.setattr(logger, "warning",
+                        lambda m, *a, **k: msgs.append(str(m)))
+    z = zero_config_from_dict(
+        {"stage": 2, "stage3_max_live_parameters": 7,
+         "prefetch_bucket_size": 123})
+    assert z.stage == 2
+    # values are still recorded — only inert, and said so
+    assert z.max_live_parameters == 7
+    assert z.prefetch_bucket_size == 123
+    warning = "\n".join(msgs)
+    assert "stage-3 knob" in warning
+    assert "stage3_max_live_parameters" in warning
+    assert "prefetch_bucket_size" in warning
+
+
+def test_zero_stage3_knobs_at_stage3_do_not_warn(monkeypatch):
+    from deepspeed_tpu.runtime.zero.config import zero_config_from_dict
+    from deepspeed_tpu.utils.logging import logger
+
+    msgs = []
+    monkeypatch.setattr(logger, "warning",
+                        lambda m, *a, **k: msgs.append(str(m)))
+    zero_config_from_dict({"stage": 3, "stage3_max_live_parameters": 7})
+    zero_config_from_dict({"stage": 2, "reduce_bucket_size": 9})
+    assert not any("stage-3 knob" in m for m in msgs)
+
+
 def test_zero_offload_configs():
     cfg = DeepSpeedConfig(
         base_config(

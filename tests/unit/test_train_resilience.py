@@ -35,7 +35,8 @@ import pytest
 import deepspeed_tpu
 from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
                                               check_gather_conservation,
-                                              check_offload_split)
+                                              check_offload_split,
+                                              check_shard_conservation)
 from deepspeed_tpu.comm import topology as topo_mod
 from deepspeed_tpu.models import TransformerLM, gpt2_config
 from deepspeed_tpu.resilience import (CheckpointCorruptError, DeviceLostError,
@@ -55,6 +56,12 @@ CONFIGS = {
     "mixed": {"bf16": {"enabled": True}},
     "offload": {"zero_optimization": {
         "stage": 1, "offload_optimizer": {"device": "cpu"}}},
+    # ZeRO-2/3 sharded tier (docs/ZERO.md): per-shard optimizer checkpoints
+    # (optim_states.shard*.ckpt + manifest-last) must resume bitwise too
+    "zero2": {"zero_optimization": {
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}},
+    "zero3": {"zero_optimization": {
+        "stage": 3, "offload_optimizer": {"device": "cpu"}}},
 }
 
 #: the compiled programs shared between a reference engine and a resumed
@@ -229,6 +236,62 @@ class TestSanitizerChecks:
     def test_offload_split_catches_out_of_range(self):
         with pytest.raises(SanitizerError):
             check_offload_split([0, 5], [1], 2)
+
+    # --- ZeRO shard partition (check_shard_conservation) ---
+
+    def _plan(self):
+        # two leaves (10 and 7 elements) over 4 shards, balanced bounds
+        sizes = [10, 7]
+        bounds = [tuple((s * r) // 4 for r in range(5)) for s in sizes]
+        return sizes, bounds
+
+    def _slices(self, sizes, bounds, dtype=np.float32):
+        full = [np.arange(s, dtype=dtype) for s in sizes]
+        return [[full[j][bounds[j][r]:bounds[j][r + 1]]
+                 for j in range(len(sizes))] for r in range(4)]
+
+    def test_shard_conservation_passes_on_faithful_plan(self):
+        sizes, bounds = self._plan()
+        check_shard_conservation(sizes, bounds)
+        check_shard_conservation(sizes, bounds,
+                                 self._slices(sizes, bounds), np.float32)
+
+    def test_shard_conservation_catches_dropped_tail(self):
+        sizes, bounds = self._plan()
+        bounds[0] = (0, 2, 5, 7, 9)  # last element never stepped
+        with pytest.raises(SanitizerError, match="do not cover"):
+            check_shard_conservation(sizes, bounds)
+
+    def test_shard_conservation_catches_backwards_bounds(self):
+        sizes, bounds = self._plan()
+        bounds[1] = (0, 4, 2, 5, 7)  # rank-1/2 shards overlap
+        with pytest.raises(SanitizerError, match="backwards"):
+            check_shard_conservation(sizes, bounds)
+
+    def test_shard_conservation_catches_rank_count_drift(self):
+        sizes, bounds = self._plan()
+        bounds[1] = (0, 3, 7)  # leaf 1 thinks there are 2 shards
+        with pytest.raises(SanitizerError, match="disagree"):
+            check_shard_conservation(sizes, bounds)
+
+    def test_shard_conservation_catches_truncated_shard_file(self):
+        sizes, bounds = self._plan()
+        slices = self._slices(sizes, bounds)
+        slices[2][0] = slices[2][0][:-1]  # shard file lost an element
+        with pytest.raises(SanitizerError, match="not conserved"):
+            check_shard_conservation(sizes, bounds, slices, np.float32)
+
+    def test_shard_conservation_catches_missing_rank(self):
+        sizes, bounds = self._plan()
+        slices = self._slices(sizes, bounds)[:-1]
+        with pytest.raises(SanitizerError, match="missing or duplicated"):
+            check_shard_conservation(sizes, bounds, slices, np.float32)
+
+    def test_shard_conservation_catches_lossy_cast(self):
+        sizes, bounds = self._plan()
+        slices = self._slices(sizes, bounds, dtype=np.float16)
+        with pytest.raises(SanitizerError, match="dtype"):
+            check_shard_conservation(sizes, bounds, slices, np.float32)
 
 
 # ---------------------------------------------------------------------------
