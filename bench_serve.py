@@ -1153,6 +1153,207 @@ def run_pool_scaling(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_pool_health(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The pool health-supervision acceptance A/B (docs/RESILIENCE.md
+    "Health & overload"): the same random workload served twice by a
+    3-replica ``EnginePool`` whose replica 0 is *gray-degraded* for the
+    whole run (every ``put``/``decode_multi`` dispatch sleeps an extra
+    ``DEGRADED_MS`` before delegating — slow, not dead):
+
+    - **detector off**: the naive pool keeps routing a third of the load
+      onto the sick replica; p99 TTFT carries the full degradation.
+    - **detector on**: a :class:`HealthMonitor` (windowed latency SLO
+      with hysteresis) quarantines replica 0 after k breached windows,
+      its live requests migrate to the survivors via detach/adopt, and
+      the rest of the run never touches it. The acceptance gate:
+      detector-on p99 TTFT must beat detector-off, and both arms must
+      complete every request bitwise identical to the fault-free
+      single-engine reference (supervision may never cost a token).
+
+    A cold-restore twin rides the same row: a 2-replica pool journaling
+    to ``DurableRequestJournal`` files is abandoned mid-decode (host
+    crash), ``EnginePool.restore`` rebuilds it from the directory, and
+    the continuations are bitwise — greedy AND sampled (the .v2 records
+    carry SamplingParams; keys re-derive from (seed, position))."""
+    import gc
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.resilience import (DurableRequestJournal,
+                                          FaultInjector, FaultSpec,
+                                          HealthMonitor, RetryPolicy)
+    from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                     RequestState, SamplingParams)
+
+    cfg = gpt2_config("125m", max_seq_len=128, hidden_size=128,
+                      num_layers=2, num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    N_REQ, GEN, DEGRADED_MS = 24, 12, 60
+
+    rng = np.random.default_rng(31)
+    workload = [(9000 + i, rng.integers(
+        0, 1024, int(rng.integers(16, 48))).tolist()) for i in range(N_REQ)]
+
+    def make_engine():
+        return InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=128,
+            prefill_chunk=16, dtype=jnp.bfloat16, paged=True,
+            block_size=16, token_budget=32, num_blocks=1 + max_seqs * 12,
+            prefix_cache=prefix_cache)
+
+    def reference(wl, sampling=None):
+        sched = ContinuousBatchScheduler(
+            make_engine(), max_queue=len(wl),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        refs = [sched.submit(p, max_new_tokens=GEN, uid=u,
+                             sampling=(sampling or {}).get(u))
+                for u, p in wl]
+        sched.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in refs)
+        out = {r.uid: list(r.tokens) for r in refs}
+        sched.close()
+        gc.collect()
+        return out
+
+    ref_tokens = reference(workload)
+
+    def arm(detector: bool) -> dict:
+        engines, injectors = {}, {}
+
+        def factory(i):
+            eng = make_engine()
+            engines[i] = eng
+            if i == 0:
+                # degraded for the WHOLE run — the gray failure never
+                # heals, so detector-off pays it on every placement
+                injectors[0] = FaultInjector([
+                    FaultSpec(site="put", kind="degraded", nth=1,
+                              count=100000, latency_s=DEGRADED_MS / 1e3),
+                    FaultSpec(site="decode_step", kind="degraded", nth=1,
+                              count=100000, latency_s=DEGRADED_MS / 1e3)])
+                return injectors[0].wrap(eng)
+            return eng
+
+        pool = EnginePool.build(
+            factory, 3, max_queue=N_REQ,
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        # warm the compiled programs off the clock and off the detector
+        for rep in pool.replicas:
+            w = rep.scheduler.submit(list(range(20)), max_new_tokens=2,
+                                     uid=8900 + rep.replica_id)
+            while not w.finished:
+                rep.scheduler.step()
+            rep.scheduler.metrics.ttft_s.clear()
+        if detector:
+            pool.enable_health(HealthMonitor(
+                clock=pool._clock, slo_s=0.02, window=2, k_windows=2,
+                probe_backoff_s=0.5, probe_backoff_max_s=4.0))
+
+        t0 = time.perf_counter()
+        reqs = [pool.submit(p, max_new_tokens=GEN, uid=u)
+                for u, p in workload]
+        pool.run_until_complete()
+        wall = time.perf_counter() - t0
+
+        assert all(r.state is RequestState.DONE for r in reqs)
+        bitwise = all(list(r.tokens) == ref_tokens[r.uid] for r in reqs)
+        assert bitwise, "pool tokens diverged under gray degradation"
+        quarantines = pool.metrics.pool["health_quarantines"]
+        if detector:
+            assert quarantines >= 1, "detector never fired on the sick replica"
+        else:
+            assert quarantines == 0
+        ttft = sorted(t for rep in pool.replicas
+                      for t in rep.scheduler.metrics.ttft_s)
+        out = {
+            "detector": detector,
+            "goodput_tokens_per_s": round(
+                sum(len(r.tokens) for r in reqs) / wall, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 1),
+            "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 1),
+            "health_quarantines": quarantines,
+            "health_migrations": pool.metrics.pool["health_migrations"],
+            "degraded_dispatches": injectors[0].fired["degraded"],
+            "tokens_bitwise_identical": bitwise,
+        }
+        pool.close()
+        del pool, engines, injectors
+        gc.collect()
+        return out
+
+    def restore_twin(sampled: bool) -> dict:
+        wl = workload[:8]
+        sampling = ({u: SamplingParams(temperature=0.8, seed=u)
+                     for u, _ in wl} if sampled else None)
+        ref = ref_tokens if not sampled else reference(wl, sampling)
+        tmp = tempfile.mkdtemp(prefix="dstpu-pool-restore-")
+        try:
+            pool = EnginePool.build(
+                lambda i: make_engine(), 2,
+                journal_factory=lambda i: DurableRequestJournal(
+                    EnginePool.journal_path(tmp, i)),
+                max_queue=N_REQ, retry=RetryPolicy(max_attempts=5),
+                sleep=lambda s: None)
+            for u, p in wl:
+                pool.submit(p, max_new_tokens=GEN, uid=u,
+                            sampling=(sampling or {}).get(u))
+            for _ in range(4):
+                pool.step()     # host crash mid-decode: just abandon
+            live = sorted(u for rep in pool.replicas
+                          for u in rep.scheduler.journal.uids())
+            pool2 = EnginePool.restore(
+                tmp, lambda i: make_engine(), max_queue=N_REQ,
+                retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+            assert pool2.metrics.pool["restored_requests"] == len(live)
+            pool2.run_until_complete()
+            bitwise = all(
+                list(pool2._requests[u].tokens) == ref[u] for u in live)
+            assert bitwise, "cold-restore continuation diverged"
+            pool2.close()
+            return {"sampled": sampled, "live_at_crash": len(live),
+                    "restored_requests": len(live),
+                    "tokens_bitwise_identical": bitwise}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    off = arm(detector=False)
+    on = arm(detector=True)
+    # the acceptance gate: supervision must actually buy tail latency
+    assert on["ttft_p99_ms"] < off["ttft_p99_ms"], (on, off)
+    restore_greedy = restore_twin(sampled=False)
+    restore_sampled = restore_twin(sampled=True)
+    return {
+        "metric": _metric_name("paged", max_seqs, "pool_health",
+                               prefix_cache),
+        "value": on["goodput_tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(
+            on["goodput_tokens_per_s"] / off["goodput_tokens_per_s"], 3)
+        if off["goodput_tokens_per_s"] else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-pool-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': "
+                      "1024} ctx=128 (control-plane-bound health A/B)"),
+            "workload": (f"{N_REQ} random prompts U[16,48), gen {GEN}; "
+                         f"3 replicas x {max_seqs} seats, replica 0 "
+                         f"gray-degraded +{DEGRADED_MS}ms per dispatch "
+                         "for the whole run"),
+            "detector_on": on, "detector_off": off,
+            "p99_ttft_improvement": round(
+                off["ttft_p99_ms"] / on["ttft_p99_ms"], 2)
+            if on["ttft_p99_ms"] else None,
+            "cold_restore_greedy": restore_greedy,
+            "cold_restore_sampled": restore_sampled,
+        },
+    }
+
+
 def run_kv_tier(max_seqs: int, prefix_cache: bool = True) -> dict:
     """KV-cache tiering acceptance A/B (docs/PREFIX_CACHING.md "Two-tier
     cache"): a shared-prefix priority-mix workload over a device pool sized
@@ -1302,6 +1503,13 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       on cache hit-blocks, and one seeded replica ``device_lost``
       mid-load absorbed by journal replay across the survivor, bitwise
       vs the fault-free single-engine reference.
+    - ``pool_health``: the health-supervision acceptance A/B
+      (docs/RESILIENCE.md "Health & overload"): the same workload on a
+      3-replica pool with replica 0 gray-degraded the whole run,
+      detector off vs on (HealthMonitor quarantine + drain) — p99 TTFT
+      must improve, tokens bitwise both arms — plus a cold-restore twin
+      (``EnginePool.restore`` from durable journals after a simulated
+      host crash, bitwise greedy and sampled).
     - ``kv_tier`` (``--kv-tier``): the two-tier KV cache acceptance A/B
       (docs/PREFIX_CACHING.md "Two-tier cache"): a shared-prefix
       priority-mix workload over an overcommitted device pool, host tier
@@ -1347,6 +1555,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_sampling(max_seqs, prefix_cache)
     if workload == "pool_scaling":
         return run_pool_scaling(max_seqs, prefix_cache)
+    if workload == "pool_health":
+        return run_pool_health(max_seqs, prefix_cache)
     if workload == "kv_tier":
         return run_kv_tier(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
@@ -1490,6 +1700,7 @@ CONFIGS = (
     ("paged", 4, "spec_decode", True),
     ("paged", 4, "sampling", True),
     ("paged", 4, "pool_scaling", True),
+    ("paged", 4, "pool_health", True),
 )
 
 

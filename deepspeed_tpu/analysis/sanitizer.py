@@ -508,6 +508,63 @@ def check_pool_ownership(replica_views, owner: Dict[int, int]) -> None:
                              + "; ".join(problems))
 
 
+def check_pool_health(replica_views, owner: Dict[int, int],
+                      now: float) -> None:
+    """Health-supervision invariants (docs/RESILIENCE.md "Health &
+    overload"). ``replica_views`` is a list of ``(replica_id, state,
+    lease_deadline, health_state, limit_inflight, journal)`` tuples for
+    EVERY replica (dead included); ``owner`` is the pool's uid ->
+    replica_id map and ``now`` the pool clock. Violations this catches:
+
+    - a SERVING replica whose heartbeat lease has already expired — the
+      supervisor must have declared it lost before the step ended, so a
+      stale lease in rotation means poll() was skipped or its verdict
+      dropped;
+    - a health-quarantined replica that still owns requests (non-empty
+      journal or owner-map entries) — the quarantine drain is supposed
+      to migrate everything before probing starts;
+    - a replica's adaptive-limit in-flight count disagreeing with the
+      owner map — an admit/release was lost and the ceiling is now
+      enforced against phantom (or invisible) load.
+
+    Duck-typed (``journal.uids()``, plain strings/ints) — no
+    serve/resilience import."""
+    problems: List[str] = []
+    owned: Dict[int, int] = {}
+    for uid, rid in owner.items():
+        owned[rid] = owned.get(rid, 0) + 1
+    for rid, state, lease, health_state, inflight, journal in replica_views:
+        if (state == "serving" and health_state in ("serving", "suspect")
+                and lease is not None and now > lease):
+            problems.append(
+                f"replica {rid} is serving with an expired heartbeat "
+                f"lease (deadline {lease:.3f} < now {now:.3f}) — lost "
+                "verdict missed")
+        if health_state == "quarantined" and getattr(journal, "uids",
+                                                     None) is not None:
+            held = list(journal.uids())
+            if held:
+                problems.append(
+                    f"health-quarantined replica {rid} still owns "
+                    f"{len(held)} journaled request(s) ({held[:4]}) — "
+                    "quarantine drain incomplete")
+            stuck = owned.get(rid, 0)
+            if stuck:
+                problems.append(
+                    f"health-quarantined replica {rid} still owns "
+                    f"{stuck} request(s) in the pool owner map")
+        if inflight is not None and state != "dead":
+            expect = owned.get(rid, 0)
+            if int(inflight) != expect:
+                problems.append(
+                    f"replica {rid} limit accounting broken: "
+                    f"{int(inflight)} in flight vs {expect} owned — "
+                    "admit/release leak")
+    if problems:
+        raise SanitizerError("[sanitizer] pool health violation: "
+                             + "; ".join(problems))
+
+
 # ---------------------------------------------------------------------------
 # training: partition/gather conservation (ZeRO state)
 # ---------------------------------------------------------------------------

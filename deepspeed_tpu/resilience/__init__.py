@@ -9,14 +9,17 @@ recovery with bitwise resume. See ``docs/RESILIENCE.md``."""
 
 from .breaker import BreakerState, CircuitBreaker  # noqa: F401
 from .errors import (CheckpointCorruptError,  # noqa: F401
-                     ContextOverflowError, DeviceLostError,
-                     EngineUsageError, PoolExhaustedError,
-                     RequestFailedError, SheddingError, TransientEngineError,
-                     UnrecoverableEngineError, WatchdogTimeoutError)
+                     ContextOverflowError, DeadlineShedError,
+                     DeviceLostError, EngineUsageError, PoolExhaustedError,
+                     ReplicaLostError, RequestFailedError, SheddingError,
+                     TransientEngineError, UnrecoverableEngineError,
+                     WatchdogTimeoutError)
 from .faults import (ALL_SITES, SITES, TRAIN_SITES,  # noqa: F401
                      FaultInjector, FaultSpec, InjectedEngine,
                      InjectedTrainEngine)
+from .health import HealthMonitor, ReplicaHealth  # noqa: F401
 from .journal_store import DurableRequestJournal  # noqa: F401
+from .limits import AdaptiveLimit  # noqa: F401
 from .recovery import (JournalEntry, RecoveryPolicy,  # noqa: F401
                        RequestJournal)
 from .retry import RetryPolicy  # noqa: F401

@@ -92,6 +92,21 @@ class SheddingError(RuntimeError):
     resubmit at a priority at or above the floor."""
 
 
+class DeadlineShedError(SheddingError):
+    """Deadline-aware early rejection at admission: the scheduler's
+    predicted TTFT (pending prefill backlog x its per-token dispatch EMA)
+    already exceeds the request's deadline, so admitting it would only
+    burn prefill compute on a request guaranteed to expire in queue.
+    Subclasses :class:`SheddingError` — callers with shed handling keep
+    working; ``predicted_s``/``remaining_s`` carry the decision inputs."""
+
+    def __init__(self, message: str, predicted_s: float = 0.0,
+                 remaining_s: float = 0.0):
+        super().__init__(message)
+        self.predicted_s = predicted_s
+        self.remaining_s = remaining_s
+
+
 class WatchdogTimeoutError(RuntimeError):
     """A step (or the close() drain) exceeded its wall-clock budget past the
     point of escalation. Raised only where there is no in-band way to keep
@@ -125,6 +140,14 @@ class CheckpointCorruptError(RuntimeError):
         super().__init__(message)
         self.tag = tag
         self.path = path
+
+
+class ReplicaLostError(UnrecoverableEngineError):
+    """A pool replica's heartbeat lease expired: its control loop has not
+    reported a single step within the lease window — not slow (the gray
+    path), but *gone* (wedged dispatch, dead thread, vanished host). The
+    pool's answer is the same journal-replay absorption a loud device
+    loss gets: survivors adopt every journaled live request bitwise."""
 
 
 class DeviceLostError(UnrecoverableEngineError):

@@ -24,6 +24,16 @@ A fault **plan** is a list of :class:`FaultSpec`:
   ``nth .. nth+count-1`` to ``site`` (1-based, counted per site).
 - ``kind="latency"``: sleep ``latency_s`` before delegating on those calls —
   the watchdog sees the spike as a genuine slow step.
+- ``kind="degraded"``: a *sustained* per-replica slowdown over the dispatch
+  surface (``put``/``decode_step``/``decode_multi``/``verify_multi``): every
+  call in the window ``nth .. nth+count-1`` sleeps ``latency_s`` before
+  delegating — the
+  gray-failure shape (a replica that is slow, not dead) the pool's
+  :class:`~deepspeed_tpu.resilience.health.HealthMonitor` exists to detect.
+  ``nth`` is the start index and ``nth + count`` the stop index, so a plan
+  states exactly when the replica sickens and when it heals (quarantine
+  probes advance the same per-site counter, which is how a probed replica
+  eventually observes the recovery).
 - ``kind="persistent"``: raise ``RequestFailedError(uid)`` whenever ``uid``
   appears in a request-processing call (``put``/``decode_step``/
   ``decode_multi``/``verify_multi``) — *every* time, which is what
@@ -73,6 +83,13 @@ _DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi",
 #: plan is an API promise); training soaks pass ``device_lost_sites``
 #: explicitly
 _SERVING_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi")
+#: sites a degraded (sustained-slowdown) plan can arm on — the serving
+#: dispatch surface: the slowdown must land on the calls whose wall time
+#: the scheduler measures and feeds the pool's HealthMonitor. Includes
+#: ``decode_step`` (unlike device-lost scatter): at decode_horizon=1 the
+#: steady-state decode rides it, and a gray replica that is only slow on
+#: decode is exactly the shape the detector must see.
+_DEGRADED_SITES = ("put", "decode_step", "decode_multi", "verify_multi")
 
 
 @dataclass
@@ -81,7 +98,8 @@ class FaultSpec:
     :data:`SITES` + training :data:`TRAIN_SITES`) or ``"*"``."""
 
     site: str
-    kind: str = "transient"    # transient | persistent | latency | device_lost
+    #: transient | persistent | latency | degraded | device_lost
+    kind: str = "transient"
     nth: Optional[int] = None        # 1-based per-site call index
     count: int = 1                   # consecutive calls affected from nth
     uid: Optional[int] = None        # persistent: the culpable request
@@ -110,6 +128,18 @@ class FaultSpec:
                     "device_lost faults arm on the dispatch surface "
                     f"{_DEVICE_LOST_SITES}; once fired, EVERY site raises "
                     "until the engine is rebuilt")
+        elif self.kind == "degraded":
+            if self.nth is None:
+                raise ValueError("degraded fault needs nth (the 1-based "
+                                 "start call index; nth+count is the stop)")
+            if self.latency_s <= 0.0:
+                raise ValueError("degraded fault needs latency_s > 0 (the "
+                                 "sustained per-call slowdown)")
+            if self.site not in _DEGRADED_SITES:
+                raise ValueError(
+                    "degraded faults arm on the serving dispatch surface "
+                    f"{_DEGRADED_SITES} — the calls whose wall time feeds "
+                    "the health monitor")
         elif self.kind in ("transient", "latency"):
             if self.nth is None:
                 raise ValueError(f"{self.kind} fault needs nth (1-based "
@@ -135,7 +165,8 @@ class FaultInjector:
         self.enabled = True
         self.calls: Dict[str, int] = {s: 0 for s in ALL_SITES}
         self.fired: Dict[str, int] = {"transient": 0, "persistent": 0,
-                                      "latency": 0, "device_lost": 0}
+                                      "latency": 0, "degraded": 0,
+                                      "device_lost": 0}
         #: death message while the fake device is dead; None = alive
         self.device_lost: Optional[str] = None
         self.deaths = 0      # device_lost specs that fired
@@ -156,14 +187,24 @@ class FaultInjector:
                     n_device_lost: int = 0,
                     device_lost_sites: Sequence[str] = (
                         _SERVING_DEVICE_LOST_SITES),
+                    n_degraded: int = 0,
+                    degraded_sites: Sequence[str] = _DEGRADED_SITES,
+                    degraded_latency_s: float = 0.05,
+                    degraded_span: int = 40,
                     sleep: Callable[[float], None] = time.sleep
                     ) -> "FaultInjector":
         """Seeded randomized plan for soak testing: each site gets transient
         bursts at ~``rate`` per call over ``horizon`` calls (and latency
         spikes when ``latency_s > 0``). ``n_device_lost`` scatters that many
         whole-engine deaths over ``device_lost_sites`` — the engine-loss
-        soak mixes them into the ordinary chaos plan. Same seed, same plan —
-        the soak is rerunnable bit-for-bit."""
+        soak mixes them into the ordinary chaos plan. ``n_degraded``
+        scatters that many sustained gray-failure windows (each
+        ``degraded_span`` calls of ``degraded_latency_s`` slowdown) over
+        ``degraded_sites`` — the health-monitor soak's driver. Same seed,
+        same plan — the soak is rerunnable bit-for-bit. Degraded draws run
+        AFTER the pre-existing draws, so a plan with ``n_degraded=0`` is
+        byte-identical to one built before the kind existed (same-seed
+        reproducibility is an API promise)."""
         rng = np.random.default_rng(seed)
         specs: List[FaultSpec] = []
         for site in sites:
@@ -179,6 +220,12 @@ class FaultInjector:
             site = device_lost_sites[int(rng.integers(len(device_lost_sites)))]
             specs.append(FaultSpec(site=site, kind="device_lost",
                                    nth=int(rng.integers(1, horizon + 1))))
+        for _ in range(n_degraded):
+            site = degraded_sites[int(rng.integers(len(degraded_sites)))]
+            specs.append(FaultSpec(
+                site=site, kind="degraded",
+                nth=int(rng.integers(1, horizon + 1)),
+                count=degraded_span, latency_s=degraded_latency_s))
         return cls(specs, seed=seed, sleep=sleep)
 
     def wrap(self, engine) -> "InjectedEngine":
@@ -211,6 +258,12 @@ class FaultInjector:
                 spec.fired += 1
                 if spec.kind == "latency":
                     self.fired["latency"] += 1
+                    self.sleep(spec.latency_s)
+                elif spec.kind == "degraded":
+                    # sustained slowdown: delay, then DELEGATE — the call
+                    # succeeds slow, which is exactly what makes the gray
+                    # failure invisible to the typed-error paths
+                    self.fired["degraded"] += 1
                     self.sleep(spec.latency_s)
                 elif spec.kind == "device_lost":
                     self.fired["device_lost"] += 1

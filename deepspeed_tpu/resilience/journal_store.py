@@ -41,7 +41,21 @@ meta → CRC32-verified manifest written LAST) to an append-only stream:
 Writes are flushed per append (the commit path is the per-token hot path
 the DSTPU rules police: one buffered ``write`` + ``flush``, no fsync by
 default); ``fsync=True`` upgrades every append to a true durability
-barrier for hosts where the page cache is not trusted to survive."""
+barrier for hosts where the page cache is not trusted to survive.
+
+**Compaction** (:meth:`DurableRequestJournal.compact`): append-only logs
+grow without bound under long-lived serving — every resolved request
+leaves its record/commit/resolve lines behind as dead weight. When the
+dead-record ratio crosses ``compact_ratio`` (checked at the
+entry-removal points, ``resolve``/``detach``), the journal rewrites just
+its live entries to a fresh file under the same manifest-last protocol a
+checkpoint uses: full entries (committed tokens inline, ``.v2`` +
+sampling preserved) are framed into ``<path>.compact``, fsync'd, and
+``os.replace``-renamed over the log — atomic, so a crash at ANY point
+leaves either the old complete log or the new complete log, never a mix.
+A stale ``.compact`` temp file found at open (crash mid-compact) is
+discarded: the rename never happened, so the primary log is the truth.
+``compactions`` / ``compacted_bytes`` count the work."""
 
 import json
 import os
@@ -85,17 +99,44 @@ class DurableRequestJournal(RequestJournal):
     exactly like the base class; ``replayed_records`` counts the folded
     log records and ``corrupt_tail_truncations`` the tail repairs."""
 
-    def __init__(self, path: str, *, fsync: bool = False):
+    def __init__(self, path: str, *, fsync: bool = False,
+                 compact_ratio: Optional[float] = 0.5,
+                 compact_min_records: int = 256):
         super().__init__()
         self.path = path
         self.fsync = fsync
+        #: auto-compaction policy: when the fraction of dead records in
+        #: the file crosses ``compact_ratio`` (and the file holds at
+        #: least ``compact_min_records`` records), resolve/detach trigger
+        #: :meth:`compact`. ``None`` disables auto-compaction.
+        self.compact_ratio = compact_ratio
+        self.compact_min_records = compact_min_records
         self.replayed_records = 0
         #: typed counter (docs/RESILIENCE.md): torn-tail repairs performed
         #: at open — each is one truncation back to the last valid record
         self.corrupt_tail_truncations = 0
         self.corrupt_tail_dropped_bytes = 0
+        #: compaction counters (docs/RESILIENCE.md): rewrites completed
+        #: and total bytes reclaimed by them
+        self.compactions = 0
+        self.compacted_bytes = 0
+        #: stale ``.compact`` temp files discarded at open (crash
+        #: mid-compact: the rename never happened, the primary log wins)
+        self.stale_compact_cleanups = 0
+        #: records currently in the on-disk file (live + dead) — the
+        #: denominator of the auto-compaction ratio
+        self._file_records = 0
         self._fh = None
+        tmp = path + ".compact"
+        if os.path.exists(tmp):
+            self.stale_compact_cleanups += 1
+            logger.warning(
+                "durable journal %s: discarding stale compaction temp %s "
+                "(crash mid-compact — the primary log is authoritative)",
+                path, tmp)
+            os.remove(tmp)
         self._replay()
+        self._file_records = self.replayed_records
         self._fh = open(path, "a", encoding="utf-8")
 
     # ------------------------------------------------------------------
@@ -160,6 +201,7 @@ class DurableRequestJournal(RequestJournal):
         self._fh.flush()
         if self.fsync:
             os.fsync(self._fh.fileno())
+        self._file_records += 1
 
     @staticmethod
     def _entry_rec(kind: str, e: JournalEntry) -> dict:
@@ -196,10 +238,12 @@ class DurableRequestJournal(RequestJournal):
         super().resolve(uid)
         if present:
             self._append({"kind": "resolve", "uid": uid})
+            self._maybe_compact()
 
     def detach(self, uid: int) -> JournalEntry:
         e = super().detach(uid)
         self._append({"kind": "detach", "uid": uid})
+        self._maybe_compact()
         return e
 
     def adopt(self, entry: JournalEntry) -> JournalEntry:
@@ -208,6 +252,53 @@ class DurableRequestJournal(RequestJournal):
         # needs the detaching replica's file
         self._append(self._entry_rec("adopt", e))
         return e
+
+    # ------------------------------------------------------------------
+    # compaction
+    # ------------------------------------------------------------------
+    def _maybe_compact(self) -> None:
+        """Auto-compaction trigger, checked at the entry-removal points:
+        a live entry needs exactly one record in a compacted file, so
+        ``1 - live/total`` is the reclaimable (dead) record fraction."""
+        if self.compact_ratio is None or self._fh is None:
+            return
+        if self._file_records < self.compact_min_records:
+            return
+        dead = 1.0 - len(self._entries) / self._file_records
+        if dead >= self.compact_ratio:
+            self.compact()
+
+    def compact(self) -> int:
+        """Rewrite the log to hold only its live entries (full state —
+        committed tokens inline, sampling preserved via the ``.v2``
+        kinds) under the manifest-last protocol: frame everything into
+        ``<path>.compact``, fsync, then ``os.replace`` over the log.
+        Atomic: a crash before the rename leaves the old log complete
+        (the stale temp is discarded at next open); after it, the new.
+        Returns the bytes reclaimed."""
+        if self._fh is None:
+            return 0
+        old_size = os.path.getsize(self.path)
+        tmp = self.path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for uid in list(self._entries):
+                f.write(_frame(json.dumps(
+                    self._entry_rec("record", self._entries[uid]),
+                    separators=(",", ":"))))
+            f.flush()
+            os.fsync(f.fileno())
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        new_size = os.path.getsize(self.path)
+        self._file_records = len(self._entries)
+        self.compactions += 1
+        self.compacted_bytes += max(0, old_size - new_size)
+        logger.info(
+            "durable journal %s: compacted %d -> %d byte(s) "
+            "(%d live entr%s kept)", self.path, old_size, new_size,
+            len(self._entries), "y" if len(self._entries) == 1 else "ies")
+        return old_size - new_size
 
     def close(self) -> None:
         if self._fh is not None:

@@ -9,8 +9,10 @@ fused dispatch), and the serving metrics surface.
 See ``docs/SERVING.md`` and ``docs/RESILIENCE.md``.
 """
 
-from ..resilience import (CircuitBreaker, DurableRequestJournal,  # noqa: F401
-                          FaultInjector, FaultSpec, PoolExhaustedError,
+from ..resilience import (AdaptiveLimit, CircuitBreaker,  # noqa: F401
+                          DeadlineShedError, DurableRequestJournal,
+                          FaultInjector, FaultSpec, HealthMonitor,
+                          PoolExhaustedError, ReplicaLostError,
                           RequestFailedError, RetryPolicy, SheddingError,
                           StepWatchdog, TransientEngineError)
 from .metrics import PoolMetrics, ServeMetrics  # noqa: F401

@@ -137,6 +137,7 @@ class ServeMetrics:
             "watchdog_breaches": 0,
             "watchdog_escalations": 0,
             "shed": 0,                    # SheddingError admissions rejected
+            "deadline_shed": 0,           # DeadlineShedError early rejections
             "drain_aborts": 0,            # close() hit its drain budget
             "breaker_opens": 0,
             "breaker_half_opens": 0,
@@ -350,6 +351,15 @@ class PoolMetrics:
             "replicas_serving": 0.0,  # gauges: pool health view
             "replicas_draining": 0.0,
             "replicas_dead": 0.0,
+            # health supervision & overload control (docs/RESILIENCE.md
+            # "Health & overload")
+            "health_quarantines": 0,   # gray failures auto-drained
+            "health_migrations": 0,    # requests moved by quarantine drains
+            "health_recoveries": 0,    # quarantined replicas undrained
+            "lease_expiries": 0,       # replicas declared lost by lease
+            "limit_rejects": 0,        # submissions refused: pool at limit
+            "restores": 0,             # cold-start restores completed
+            "restored_requests": 0,    # live requests replayed at restore
         }
 
     def observe_placement(self, hit_blocks: int) -> None:
@@ -374,6 +384,23 @@ class PoolMetrics:
         self.pool["replica_deaths"] += 1
         self.pool["death_replays"] += replayed
         self.pool["death_cancelled"] += cancelled
+
+    def observe_quarantine(self, migrated: int) -> None:
+        self.pool["health_quarantines"] += 1
+        self.pool["health_migrations"] += migrated
+
+    def observe_health_recovery(self) -> None:
+        self.pool["health_recoveries"] += 1
+
+    def observe_lease_expiry(self) -> None:
+        self.pool["lease_expiries"] += 1
+
+    def observe_limit_reject(self) -> None:
+        self.pool["limit_rejects"] += 1
+
+    def observe_restore(self, restored: int) -> None:
+        self.pool["restores"] += 1
+        self.pool["restored_requests"] += restored
 
     def observe_gauges(self, loads: List[int], serving: int, draining: int,
                        dead: int) -> None:

@@ -26,6 +26,21 @@ define it:
   breakers keep recording incidents; :meth:`EnginePool.health` is the
   pool-level view. With no survivors the pool delegates to the replica's
   own in-place recovery (the single-engine path, unchanged).
+- **supervise** — :meth:`EnginePool.enable_health` arms a gray-failure
+  detector (``resilience.health``, docs/RESILIENCE.md "Health &
+  overload"): every successful dispatch feeds a per-replica latency EMA
+  and renews a heartbeat lease. A replica breaching its SLO for k
+  consecutive windows is auto-drained (live requests migrate over the
+  ``detach``/``adopt`` seam — bitwise), probed while quarantined with
+  exponential backoff, and undrained on recovery; a replica whose lease
+  expires is declared LOST and absorbed through the same journal-replay
+  path a loud device loss takes. :meth:`enable_limits` adds a
+  Vegas-style adaptive concurrency ceiling per replica, consulted by
+  ``Router.place`` and conserved against the owner map by the
+  sanitizer. :meth:`restore` cold-rebuilds a pool from per-replica
+  durable journal files after a host crash, replaying every live
+  request through normal admission — bitwise under greedy and sampled
+  decoding.
 
 Determinism (DSTPU005): every pool decision — placement, rebalance
 victim, death-replay targeting — is a pure function of replica state in
@@ -33,12 +48,18 @@ replica-id order; no wall clock, RNG, or set iteration on a decision
 path. A replayed trace routes identically.
 """
 
+import os
+import re
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..analysis import sanitizer as _sanitizer
-from ..resilience.errors import (EngineUsageError, RequestFailedError,
+from ..resilience.errors import (EngineUsageError, ReplicaLostError,
+                                 RequestFailedError,
                                  UnrecoverableEngineError)
+from ..resilience.health import HealthMonitor
+from ..resilience.journal_store import DurableRequestJournal
+from ..resilience.limits import AdaptiveLimit
 from ..resilience.recovery import RecoveryPolicy
 from ..utils.logging import logger
 from .metrics import Event, PoolMetrics
@@ -53,6 +74,10 @@ SERVING = "serving"
 DRAINING = "draining"
 DEAD = "dead"
 
+#: per-replica durable journal naming under a pool journal directory
+#: (``EnginePool.restore`` discovers membership from these)
+_JOURNAL_RE = re.compile(r"^replica(\d+)\.journal$")
+
 
 class Replica:
     """One pool member: a scheduler (which owns its engine) plus the
@@ -64,6 +89,10 @@ class Replica:
         self.replica_id = replica_id
         self.scheduler = scheduler
         self.state = SERVING
+        #: adaptive concurrency ceiling (resilience.limits) — None until
+        #: the pool arms ``enable_limits``. The router skips replicas
+        #: with no headroom; the pool keeps the uid ledger conserved.
+        self.limit: Optional[AdaptiveLimit] = None
 
     @property
     def engine(self):
@@ -116,6 +145,9 @@ class EnginePool:
         #: uid -> Request for every request the pool ever placed (the
         #: result surface — survives migration and replica death)
         self._requests: Dict[int, Request] = {}
+        #: gray-failure detector (resilience.health) — None until
+        #: :meth:`enable_health` arms it
+        self.health_monitor: Optional[HealthMonitor] = None
         self._closed = False
 
     @classmethod
@@ -139,6 +171,123 @@ class EnginePool:
                 engine_factory(i), replica_id=i, escalate_losses=True,
                 clock=clock, **kw))
         return cls(scheds, router=router, recovery=recovery, clock=clock)
+
+    # ------------------------------------------------------------------
+    # cold-start restore (docs/RESILIENCE.md "Health & overload")
+    # ------------------------------------------------------------------
+    @staticmethod
+    def journal_path(directory: str, replica_id: int) -> str:
+        """The canonical per-replica durable journal path —
+        ``<directory>/replica<i>.journal``. Use as the ``build``
+        ``journal_factory`` so :meth:`restore` can rediscover the pool."""
+        return os.path.join(directory, f"replica{replica_id}.journal")
+
+    @classmethod
+    def restore(cls, directory: str, engine_factory, *,
+                router: Optional[Router] = None,
+                recovery: Optional[RecoveryPolicy] = None,
+                clock: Callable[[], float] = time.monotonic,
+                fsync: bool = False,
+                **scheduler_kw) -> "EnginePool":
+        """Cold-start a pool after a host crash from the per-replica
+        durable journals under ``directory`` (``replica<i>.journal``,
+        written by a pool built with
+        ``journal_factory=lambda i: DurableRequestJournal(
+        EnginePool.journal_path(dir, i))``).
+
+        Membership is discovered from the files (``max id + 1``
+        replicas — a replica whose journal is missing restarts empty),
+        fresh engines come from ``engine_factory(i)``, and every
+        journaled live request re-enters through the normal
+        detach→adopt admission path on its original replica. Greedy
+        continuations are bitwise identical to the uninterrupted run;
+        sampled requests replay their committed prefix byte-for-byte
+        and re-derive every remaining PRNG key from (seed, absolute
+        position) — the same contract single-engine crash recovery
+        proves."""
+        ids = []
+        for name in sorted(os.listdir(directory)):
+            m = _JOURNAL_RE.match(name)
+            if m is not None:
+                ids.append(int(m.group(1)))
+        if not ids:
+            raise ValueError(
+                f"no replica journals (replica<i>.journal) under "
+                f"{directory!r} — nothing to restore")
+        pool = cls.build(
+            engine_factory, max(ids) + 1, router=router, recovery=recovery,
+            journal_factory=lambda i: DurableRequestJournal(
+                cls.journal_path(directory, i), fsync=fsync),
+            clock=clock, **scheduler_kw)
+        restored = 0
+        for rep in pool.replicas:
+            sched = rep.scheduler
+            for uid in list(sched.journal.uids()):
+                # detach+adopt on the SAME scheduler: the entry has no
+                # live Request attached (host state died with the
+                # crash), so adopt reconstructs it and replays
+                # prompt + committed tokens through normal admission
+                entry = sched.journal.detach(uid)
+                req = sched.adopt(entry)
+                pool._owner[uid] = rep.replica_id
+                pool._requests[uid] = req
+                restored += 1
+        pool.metrics.observe_restore(restored)
+        logger.info(
+            "pool: cold-restored %d replica(s) from %r — %d live "
+            "request(s) replaying", len(pool.replicas), directory,
+            restored)
+        return pool
+
+    # ------------------------------------------------------------------
+    # health supervision & overload control (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _tap_for(self, rep: Replica) -> Callable[[str, float, float], None]:
+        """The per-replica dispatch feed: every successful engine call
+        reports (kind, duration_s, scale) into the health detector and
+        the replica's adaptive limit. One closure serves both — each
+        consumer is consulted dynamically, so arming order is free."""
+        def tap(kind: str, duration_s: float, scale: float) -> None:
+            if self.health_monitor is not None:
+                self.health_monitor.observe(rep.replica_id, duration_s, scale,
+                                    now=self._clock())
+            if rep.limit is not None:
+                rep.limit.observe(duration_s / max(scale, 1.0))
+        return tap
+
+    def enable_health(self, monitor: Optional[HealthMonitor] = None,
+                      ) -> HealthMonitor:
+        """Arm gray-failure supervision: attach every non-dead replica
+        to ``monitor`` (a default-configured :class:`HealthMonitor` on
+        the pool's clock when omitted) and wire each scheduler's
+        ``health_tap``. Call after warmup — compile-time first-dispatch
+        latency would otherwise pollute the baseline EMA (the adaptive
+        SLO ignores cold replicas, but an explicit ``slo_s`` does not)."""
+        if monitor is None:
+            monitor = HealthMonitor(clock=self._clock)
+        self.health_monitor = monitor
+        now = self._clock()
+        for rep in self.replicas:
+            if rep.state != DEAD:
+                monitor.attach(rep.replica_id, now=now)
+            rep.scheduler.health_tap = self._tap_for(rep)
+        return monitor
+
+    def enable_limits(self, factory: Optional[Callable[[int],
+                                                       AdaptiveLimit]] = None,
+                      ) -> None:
+        """Arm per-replica adaptive concurrency limits.
+        ``factory(replica_id)`` builds each replica's
+        :class:`AdaptiveLimit` (default-configured when omitted). The
+        ledger is seeded with the requests each replica already owns, so
+        arming mid-flight conserves the accounting invariant."""
+        for rep in self.replicas:
+            rep.limit = (AdaptiveLimit() if factory is None
+                         else factory(rep.replica_id))
+            for uid, rid in self._owner.items():
+                if rid == rep.replica_id and not self._requests[uid].finished:
+                    rep.limit.admit(uid)
+            rep.scheduler.health_tap = self._tap_for(rep)
 
     # ------------------------------------------------------------------
     # membership views
@@ -170,6 +319,15 @@ class EnginePool:
         while True:
             rep, hits = self.router.place(prompt, candidates)
             if rep is None:
+                at_limit = [c.replica_id for c in candidates
+                            if c.limit is not None
+                            and not c.limit.has_headroom()]
+                if at_limit:
+                    self.metrics.observe_limit_reject()
+                    raise QueueFullError(
+                        f"every serving replica is at its adaptive "
+                        f"concurrency limit (replicas {at_limit}); retry "
+                        "after in-flight work drains")
                 raise QueueFullError(
                     "every serving replica rejected this request")
             try:
@@ -179,6 +337,8 @@ class EnginePool:
                 continue
             self._owner[req.uid] = rep.replica_id
             self._requests[req.uid] = req
+            if rep.limit is not None:
+                rep.limit.admit(req.uid)
             self.metrics.observe_placement(hits)
             return req
 
@@ -196,12 +356,23 @@ class EnginePool:
             try:
                 if rep.scheduler.step():
                     work = True
+                if self.health_monitor is not None:
+                    # a completed control-loop pass IS the liveness
+                    # signal the lease rides — even an idle one
+                    self.health_monitor.heartbeat(rep.replica_id,
+                                          now=self._clock())
             except UnrecoverableEngineError as e:
                 self._absorb_replica_loss(rep, e)
                 work = True
+        by_id = {r.replica_id: r for r in self.replicas}
         for uid in [u for u, req in list(self._requests.items())
                     if req.finished]:
-            self._owner.pop(uid, None)
+            rid = self._owner.pop(uid, None)
+            rep = by_id.get(rid) if rid is not None else None
+            if rep is not None and rep.limit is not None:
+                rep.limit.release(uid)
+        if self._supervise():
+            work = True
         self.metrics.observe_gauges(
             [Router.load(r) for r in self.replicas if r.state != DEAD],
             serving=sum(1 for r in self.replicas if r.state == SERVING),
@@ -214,16 +385,96 @@ class EnginePool:
                 [(r.replica_id, r.scheduler.journal, r.scheduler._all)
                  for r in self.replicas if r.state != DEAD],
                 self._owner)
+            if self.health_monitor is not None or any(
+                    r.limit is not None for r in self.replicas):
+                _sanitizer.check_pool_health(
+                    [(r.replica_id, r.state,
+                      (None if self.health_monitor is None else
+                       self.health_monitor.lease_deadline_of(r.replica_id)),
+                      (None if self.health_monitor is None else
+                       self.health_monitor.state_of(r.replica_id)),
+                      (None if r.limit is None else r.limit.inflight),
+                      r.scheduler.journal)
+                     for r in self.replicas],
+                    self._owner, self._clock())
         return work
 
+    def _supervise(self) -> bool:
+        """Act on the health detector's verdicts (one pass per pool
+        step): quarantine-drain gray failures, absorb lease-expired
+        replicas through journal replay, probe quarantined replicas and
+        undrain the recovered. Returns True when anything moved."""
+        if self.health_monitor is None:
+            return False
+        now = self._clock()
+        acted = False
+        for verdict, rid in self.health_monitor.poll(now=now):
+            rep = self.replica(rid)
+            if verdict == "quarantine":
+                if rep.state != SERVING or not self._serving(exclude=rep):
+                    # already out of rotation, or nowhere to migrate —
+                    # downgrade; the next breached window re-offers it
+                    self.health_monitor.note_deferred(rid)
+                    continue
+                moved = self.drain(rid)
+                self.health_monitor.note_drained(rid, now)
+                self.metrics.observe_quarantine(moved)
+                acted = True
+                logger.warning(
+                    "pool: replica %d quarantined by the health monitor "
+                    "(%d request(s) migrated); probing for recovery",
+                    rid, moved)
+            elif verdict == "lost":
+                self.metrics.observe_lease_expiry()
+                if rep.state == DEAD:
+                    continue  # already absorbed by a loud loss
+                self._absorb_replica_loss(rep, ReplicaLostError(
+                    f"replica {rid} heartbeat lease expired at "
+                    f"{now:.3f} — declaring lost"))
+                acted = True
+        for rid in self.health_monitor.quarantined_ids():
+            rep = self.replica(rid)
+            if rep.state != DRAINING or not self.health_monitor.probe_due(rid, now):
+                continue
+            t0 = time.perf_counter()
+            try:
+                rep.engine.put([], [])  # no-op dispatch, timed
+            except UnrecoverableEngineError as e:
+                self._absorb_replica_loss(rep, e)
+                acted = True
+                continue
+            except Exception:
+                self.health_monitor.probe_failed(rid, now)
+                continue
+            if self.health_monitor.observe_probe(
+                    rid, time.perf_counter() - t0, now=now):
+                self.undrain(rid)
+                self.metrics.observe_health_recovery()
+                acted = True
+        return acted
+
     def run_until_complete(self) -> None:
+        """Drive the pool until every placed request is terminal. Raises
+        :class:`UnrecoverableEngineError` instead of returning silently
+        (or spinning) when no replica can make progress — every replica
+        dead, or a request stranded with no serving owner."""
         while self.step():
             pass
+        stranded = sorted(u for u, r in self._requests.items()
+                          if not r.finished)
+        if stranded:
+            raise UnrecoverableEngineError(
+                f"pool made no progress with {len(stranded)} unfinished "
+                f"request(s) (uids {stranded[:8]}): no serving replica "
+                "can run them")
 
     def stream(self, req: Request):
         """Yield ``req``'s tokens as generated, driving the POOL loop —
         the request may migrate replicas mid-stream; the iterator
-        follows it (same ``Request`` object rides the journal entry)."""
+        follows it (same ``Request`` object rides the journal entry).
+        Raises :class:`UnrecoverableEngineError` instead of busy-spinning
+        when the pool can no longer make progress for ``req``."""
+        stalled = False
         while True:
             for tok in req.new_tokens():
                 yield tok
@@ -231,7 +482,14 @@ class EnginePool:
                 if req.error is not None:
                     raise req.error
                 return
-            self.step()
+            if stalled:
+                raise UnrecoverableEngineError(
+                    f"pool made no progress while uid {req.uid} is "
+                    f"unfinished (state {req.state.value}): the request "
+                    "is stranded with no serving replica able to run it")
+            # one more drain pass after the first idle step: the final
+            # step may have produced tokens we have not yielded yet
+            stalled = not self.step()
 
     # ------------------------------------------------------------------
     # migration / rebalance
@@ -261,6 +519,10 @@ class EnginePool:
             src.scheduler.adopt(entry)
             raise
         self._owner[uid] = to_replica_id
+        if src.limit is not None:
+            src.limit.release(uid)
+        if dst.limit is not None:
+            dst.limit.admit(uid)
         self.metrics.observe_migration(rebalance=_rebalance)
         return req
 
@@ -321,8 +583,18 @@ class EnginePool:
         for uid in list(rep.scheduler.journal.uids()):
             entry = rep.scheduler.detach(uid)
             target, _ = self.router.place(entry.replay_tokens(), survivors)
+            if target is None:
+                # every survivor is at its concurrency limit — the drain
+                # must still complete; bypass the limit filter (migrated
+                # load is conserved, not new admission)
+                target = min(survivors,
+                             key=lambda r: (Router.load(r), r.replica_id))
             target.scheduler.adopt(entry)
             self._owner[uid] = target.replica_id
+            if rep.limit is not None:
+                rep.limit.release(uid)
+            if target.limit is not None:
+                target.limit.admit(uid)
             self.metrics.observe_migration()
             moved += 1
         self.metrics.observe_drain(time.perf_counter() - t0)
@@ -403,11 +675,15 @@ class EnginePool:
             "across %d survivor(s)", rep.replica_id, exc,
             len(sched.journal), len(survivors))
         rep.state = DEAD
+        if self.health_monitor is not None:
+            self.health_monitor.note_lost(rep.replica_id)
         replayed = cancelled = 0
         for uid in list(sched.journal.uids()):
             # detach is loss-tolerant: preempt/flush on the dead engine
             # absorb the error (the blocks died with it)
             entry = sched.detach(uid)
+            if rep.limit is not None:
+                rep.limit.release(uid)
             req = entry.request
             if (req is not None and req.deadline is not None
                     and req.deadline <= now):
@@ -423,8 +699,15 @@ class EnginePool:
                 continue
             target, _ = self.router.place(entry.replay_tokens(),
                                           survivors)
+            if target is None:
+                # death replay bypasses the concurrency-limit filter:
+                # the load already existed, survivors must take it
+                target = min(survivors,
+                             key=lambda r: (Router.load(r), r.replica_id))
             target.scheduler.adopt(entry)
             self._owner[uid] = target.replica_id
+            if target.limit is not None:
+                target.limit.admit(uid)
             replayed += 1
         # the dead scheduler's residual host state is already empty
         # (detach swept _all/_queue/_live); clear the recorded loss so a
@@ -449,6 +732,12 @@ class EnginePool:
         rep.scheduler._engine_dead = None
         rep.scheduler.breaker.rearm_half_open(self._clock())
         rep.state = SERVING
+        if self.health_monitor is not None:
+            if self.health_monitor.state_of(rep.replica_id) is None:
+                self.health_monitor.attach(rep.replica_id, now=self._clock())
+            else:
+                self.health_monitor.note_revived(rep.replica_id,
+                                         now=self._clock())
 
     # ------------------------------------------------------------------
     # observability / shutdown
@@ -469,8 +758,13 @@ class EnginePool:
                 "rebuilds": r.scheduler.recovery.rebuilds,
                 "weights_version": getattr(r.engine, "weights_version",
                                            None),
+                "health": (None if self.health_monitor is None
+                           else self.health_monitor.state_of(r.replica_id)),
+                "limit": (None if r.limit is None else r.limit.view()),
             } for r in self.replicas],
             "pool_recovery_trail": list(self.recovery.trail),
+            "detector": (None if self.health_monitor is None
+                         else self.health_monitor.summary()),
             "pool": self.metrics.summary(),
         }
 

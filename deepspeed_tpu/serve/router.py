@@ -53,6 +53,12 @@ class Router:
         best_key: Optional[Tuple[int, int, int]] = None
         best_hits = 0
         for rep in replicas:
+            # adaptive concurrency limit (docs/RESILIENCE.md "Health &
+            # overload"): a replica at its Vegas ceiling is not a candidate
+            # — affinity never overrides overload protection
+            limit = getattr(rep, "limit", None)
+            if limit is not None and not limit.has_headroom():
+                continue
             hits = rep.engine.prefix_probe(prompt) if self.affinity else 0
             key = (-hits, self.load(rep), rep.replica_id)
             if best_key is None or key < best_key:

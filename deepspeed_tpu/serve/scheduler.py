@@ -100,9 +100,9 @@ import numpy as np
 
 from ..analysis import sanitizer as _sanitizer
 from ..resilience.breaker import CircuitBreaker
-from ..resilience.errors import (ContextOverflowError, PoolExhaustedError,
-                                 RequestFailedError, SheddingError,
-                                 TransientEngineError,
+from ..resilience.errors import (ContextOverflowError, DeadlineShedError,
+                                 PoolExhaustedError, RequestFailedError,
+                                 SheddingError, TransientEngineError,
                                  UnrecoverableEngineError)
 from ..resilience.recovery import RecoveryPolicy, RequestJournal
 from ..resilience.retry import RetryPolicy
@@ -165,7 +165,8 @@ class ContinuousBatchScheduler:
                  recovery: Optional[RecoveryPolicy] = None,
                  replica_id: Optional[int] = None,
                  escalate_losses: bool = False,
-                 swap_preemption: Optional[bool] = None):
+                 swap_preemption: Optional[bool] = None,
+                 deadline_guard: bool = False):
         self.engine = engine
         #: pool membership (docs/SERVING.md engine pool): ``replica_id``
         #: labels this scheduler's metrics/events so N replicas never alias
@@ -227,6 +228,16 @@ class ContinuousBatchScheduler:
             self.spec = (proposer if isinstance(proposer, SpecPolicy)
                          else SpecPolicy(proposer))
         self._token_est_s = 0.0  # EMA per-token dispatch wall (deadline guard)
+        # deadline-aware early rejection (docs/RESILIENCE.md "Health &
+        # overload"): shed at admission when predicted TTFT (pending prefill
+        # backlog x the per-token dispatch EMA) already exceeds the deadline.
+        # Opt-in: the EMA is wall-domain, so virtual-clock harnesses must not
+        # arm it implicitly.
+        self.deadline_guard = deadline_guard
+        #: pool health feed (resilience.health): when set, every successful
+        #: engine dispatch reports (kind, duration_s, scale) — the pool wires
+        #: this to HealthMonitor.observe + AdaptiveLimit.observe per replica
+        self.health_tap: Optional[Callable[[str, float, float], None]] = None
         # swap-based preemption (docs/PREFIX_CACHING.md "Two-tier cache"):
         # None = cost model (per victim, needs a host tier), True = always
         # swap when the engine can, False = always flush+replay. The
@@ -302,6 +313,24 @@ class ContinuousBatchScheduler:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new_tokens({max_new_tokens}) "
                 f"exceeds engine context {self.engine.max_seq_len}")
+        if (self.deadline_guard and deadline is not None
+                and self._token_est_s > 0.0):
+            # deadline-aware early rejection: predicted TTFT is every prefill
+            # token ahead of (and including) this prompt at the measured
+            # per-token dispatch EMA. Shedding now is strictly cheaper than
+            # burning prefill compute on a request that expires in queue.
+            pending = (len(prompt) + self._prefill_backlog()
+                       + sum(len(r.prompt) for r in self._queue))
+            predicted = pending * self._token_est_s
+            remaining = deadline - self._clock()
+            if predicted > remaining:
+                self.metrics.faults["deadline_shed"] += 1
+                raise DeadlineShedError(
+                    f"predicted TTFT {predicted:.4f}s exceeds remaining "
+                    f"deadline budget {remaining:.4f}s ({pending} pending "
+                    f"prefill token(s) at {self._token_est_s:.6f}s/token); "
+                    "shed at admission", predicted_s=predicted,
+                    remaining_s=remaining)
         if sampling is not None:
             if sampling.needs_engine and not getattr(self.engine, "paged",
                                                      False):
@@ -561,6 +590,8 @@ class ContinuousBatchScheduler:
         # a hard breach (wedged dispatch) raises UnrecoverableEngineError
         # out of observe — neither breaker hook runs; step()'s recovery
         # wrapper catches it and rebuilds the engine
+        if self.health_tap is not None:
+            self.health_tap(kind, duration_s, scale)
         breached, escalated = self.watchdog.observe(kind, duration_s, scale)
         if not breached:
             self.breaker.on_success(now)

@@ -147,6 +147,8 @@ _SANITIZE_FILES = (
     "test_train_resilience.py",
     "test_train_chaos_soak.py",
     "test_pool.py",
+    "test_pool_health.py",
+    "test_pool_restore.py",
     "test_journal_durability.py",
     "test_kv_tier.py",
     "test_zero_sharded.py",

@@ -365,3 +365,107 @@ class TestHostCrashReplay:
             assert got.tokens == ref.tokens
         assert len(j2) == 0
         j2.close()
+
+
+class TestCompaction:
+    """Journal compaction (docs/RESILIENCE.md): live-entry rewrite under
+    the manifest-last protocol — atomic rename, counters, auto-trigger,
+    crash-mid-compact stale-temp discard, and replay identity."""
+
+    def test_compact_shrinks_and_preserves_live_state(self, tmp_path):
+        path = str(tmp_path / "journal.log")
+        live = _req([1, 2, 3], uid=7001)
+        live.tokens = [9, 8]
+        with DurableRequestJournal(path, compact_ratio=None) as j:
+            j.record(live)
+            j.commit(live)
+            for i in range(50):           # dead weight: record + resolve
+                r = _req([i], uid=7100 + i)
+                j.record(r)
+                j.resolve(r.uid)
+            before = j.live()[0]
+            old = j.path
+            import os as _os
+            old_size = _os.path.getsize(old)
+            reclaimed = j.compact()
+            assert reclaimed > 0
+            assert _os.path.getsize(old) < old_size
+            assert j.compactions == 1
+            assert j.compacted_bytes == reclaimed
+            assert j._file_records == 1
+            # in-memory surface untouched
+            assert j.live() == [before]
+            # the compacted file still appends (post-compact mutations land)
+            live.tokens.append(5)
+            j.commit(live)
+        with DurableRequestJournal(path) as j2:
+            assert j2.replayed_records == 2   # compacted record + commit
+            e = j2.live()[0]
+            assert e.uid == 7001
+            assert e.prompt == [1, 2, 3]
+            assert e.tokens == [9, 8, 5]
+
+    def test_auto_compact_on_dead_ratio(self, tmp_path):
+        path = str(tmp_path / "journal.log")
+        with DurableRequestJournal(path, compact_ratio=0.5,
+                                   compact_min_records=10) as j:
+            keep = _req([1], uid=7201)
+            j.record(keep)
+            for i in range(20):
+                r = _req([i], uid=7300 + i)
+                j.record(r)
+                j.resolve(r.uid)
+            # ratio crossed well past 0.5 with >= 10 file records
+            assert j.compactions >= 1
+            assert j._file_records < 10
+            assert j.uids() == [7201]
+
+    def test_auto_compact_respects_min_records(self, tmp_path):
+        path = str(tmp_path / "journal.log")
+        with DurableRequestJournal(path, compact_ratio=0.5,
+                                   compact_min_records=1000) as j:
+            for i in range(20):
+                r = _req([i], uid=7400 + i)
+                j.record(r)
+                j.resolve(r.uid)
+            assert j.compactions == 0
+
+    def test_crash_mid_compact_discards_stale_temp(self, tmp_path):
+        path = str(tmp_path / "journal.log")
+        live = _req([1, 2], uid=7501)
+        with DurableRequestJournal(path, compact_ratio=None) as j:
+            j.record(live)
+            dead = _req([3], uid=7502)
+            j.record(dead)
+            j.resolve(dead.uid)
+        # simulate a crash between writing <path>.compact and the rename:
+        # a torn temp (even a corrupt one) sits beside an intact log
+        with open(path + ".compact", "w", encoding="utf-8") as f:
+            f.write("torn half-written com")
+        with DurableRequestJournal(path) as j2:
+            assert j2.stale_compact_cleanups == 1
+            assert not __import__("os").path.exists(path + ".compact")
+            # the primary log is authoritative: full pre-crash state
+            assert j2.uids() == [7501]
+            assert j2.replayed_records == 3
+
+    def test_compact_preserves_sampled_v2_entries(self, tmp_path):
+        path = str(tmp_path / "journal.log")
+        sp = SamplingParams(temperature=0.7, top_k=11, seed=42)
+        r = _req([4, 5, 6], uid=7601, sampling=sp)
+        with DurableRequestJournal(path, compact_ratio=None) as j:
+            j.record(r)
+            for i in range(5):
+                d = _req([i], uid=7700 + i)
+                j.record(d)
+                j.resolve(d.uid)
+            j.compact()
+        with open(path, encoding="utf-8") as f:
+            recs = [_unframe(ln) for ln in f.readlines()]
+        assert [rec["kind"] for rec in recs] == ["record.v2"]
+        with DurableRequestJournal(path) as j2:
+            e = j2.live()[0]
+            assert e.sampling is not None
+            assert e.sampling.temperature == pytest.approx(0.7)
+            assert e.sampling.top_k == 11
+            assert e.sampling.seed == 42

@@ -1,0 +1,726 @@
+"""Pool health supervision & overload control tests (docs/RESILIENCE.md
+"Health & overload"): the HealthMonitor state machine on a virtual
+timeline (windowed breach hysteresis, adaptive SLO cold-start, heartbeat
+lease, deferred quarantine, probe backoff), the Vegas AdaptiveLimit
+gradient and its router/placement integration, deadline-aware early
+rejection, the gray-failure chaos drill (a degraded replica auto-drains,
+its requests complete bitwise, and the replica rejoins after probe
+recovery), lease-expiry absorption through journal replay, the
+busy-spin bugfix (typed error instead of a silent/non-terminating loop
+when no replica can make progress), and the planted-violation coverage
+for the ``check_pool_health`` sanitizer."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_pool_health)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience import (AdaptiveLimit, DeadlineShedError,
+                                      FaultInjector, FaultSpec,
+                                      HealthMonitor, RetryPolicy,
+                                      UnrecoverableEngineError)
+from deepspeed_tpu.resilience.health import (LOST, QUARANTINED, SERVING,
+                                             SUSPECT)
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                 QueueFullError, RequestState, Router,
+                                 SamplingParams)
+from deepspeed_tpu.serve.pool import DEAD, DRAINING
+from deepspeed_tpu.serve.pool import SERVING as POOL_SERVING
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _workload(seed=17, n=6, lo=8, hi=25, gen=6):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 128, int(rng.integers(lo, hi))).tolist()
+               for _ in range(n)]
+    uids = [9000 + i for i in range(n)]
+    return prompts, uids, gen
+
+
+_REF_MEMO = {}
+
+
+def _reference(m, params, prompts, uids, gen, sampling=None):
+    key = (tuple(map(tuple, prompts)), tuple(uids), gen, repr(sampling))
+    if key in _REF_MEMO:
+        return _REF_MEMO[key]
+    sched = ContinuousBatchScheduler(
+        _engine(m, params), retry=RetryPolicy(max_attempts=5),
+        sleep=lambda s: None)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=u,
+                         sampling=(sampling or {}).get(u))
+            for p, u in zip(prompts, uids)]
+    sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    _REF_MEMO[key] = {r.uid: list(r.tokens) for r in reqs}
+    sched.close()
+    return _REF_MEMO[key]
+
+
+def _pool(m, params, n, *, specs_for=None, clock=None, **sched_kw):
+    engines, injectors = {}, {}
+
+    def factory(i):
+        eng = _engine(m, params)
+        engines[i] = eng
+        if specs_for and i in specs_for:
+            injectors[i] = FaultInjector(specs_for[i])
+            return injectors[i].wrap(eng)
+        return eng
+
+    sched_kw.setdefault("retry", RetryPolicy(max_attempts=5))
+    sched_kw.setdefault("sleep", lambda s: None)
+    kw = {} if clock is None else {"clock": clock}
+    pool = EnginePool.build(factory, n, **kw, **sched_kw)
+    return pool, engines, injectors
+
+
+def _assert_bounds(eng):
+    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor unit: pure state machine on a virtual timeline
+# ---------------------------------------------------------------------------
+
+def _mon(**kw):
+    kw.setdefault("slo_s", 0.1)
+    kw.setdefault("window", 2)
+    kw.setdefault("k_windows", 2)
+    kw.setdefault("lease_s", 10.0)
+    kw.setdefault("probe_backoff_s", 1.0)
+    kw.setdefault("probe_backoff_max_s", 4.0)
+    kw.setdefault("recovery_probes", 2)
+    return HealthMonitor(clock=lambda: 0.0, **kw)
+
+
+class TestHealthMonitor:
+    def test_breach_hysteresis_state_machine(self):
+        mon = _mon()
+        mon.attach(0, now=0.0)
+        # two fast samples: one clean window, stays SERVING
+        mon.observe(0, 0.01, now=0.0)
+        mon.observe(0, 0.01, now=0.0)
+        assert mon.state_of(0) == SERVING
+        # first breached window -> SUSPECT, not quarantined (hysteresis)
+        mon.observe(0, 0.5, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        assert mon.state_of(0) == SUSPECT
+        assert mon.poll(now=0.0) == []
+        # second consecutive breached window -> QUARANTINED + verdict
+        mon.observe(0, 0.5, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        assert mon.state_of(0) == QUARANTINED
+        assert mon.poll(now=0.0) == [("quarantine", 0)]
+        assert mon.poll(now=0.0) == []  # verdicts drain once
+
+    def test_clean_window_clears_suspect(self):
+        mon = _mon()
+        mon.attach(0, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        assert mon.state_of(0) == SUSPECT
+        mon.observe(0, 0.01, now=0.0)
+        mon.observe(0, 0.01, now=0.0)
+        assert mon.state_of(0) == SERVING
+        # the breach streak reset: two MORE breached windows needed again
+        mon.observe(0, 0.5, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        assert mon.state_of(0) == SUSPECT
+
+    def test_scale_normalizes_fused_dispatches(self):
+        mon = _mon()
+        mon.attach(0, now=0.0)
+        # 0.4s for 8 horizon units = 0.05s/unit, under the 0.1 SLO
+        mon.observe(0, 0.4, scale=8.0, now=0.0)
+        mon.observe(0, 0.4, scale=8.0, now=0.0)
+        assert mon.state_of(0) == SERVING
+
+    def test_adaptive_slo_never_fires_cold_and_tracks_floor(self):
+        mon = _mon(slo_s=None, slo_factor=4.0)
+        mon.attach(0, now=0.0)
+        mon.attach(1, now=0.0)
+        assert mon.slo() == float("inf")
+        # replica 0 establishes the healthy floor (~0.01s/unit)
+        for _ in range(4):
+            mon.observe(0, 0.01, now=0.0)
+        assert mon.slo() == pytest.approx(0.04, rel=0.3)
+        # replica 1 at 10x the floor breaches the adaptive SLO
+        for _ in range(4):
+            mon.observe(1, 0.1, now=0.0)
+        assert mon.state_of(1) == QUARANTINED
+        assert mon.state_of(0) == SERVING
+
+    def test_lease_expiry_and_heartbeat_renewal(self):
+        mon = _mon(lease_s=10.0)
+        mon.attach(0, now=0.0)
+        mon.attach(1, now=0.0)
+        mon.heartbeat(0, now=8.0)   # renews to 18
+        assert mon.poll(now=11.0) == [("lost", 1)]
+        assert mon.state_of(1) == LOST
+        assert mon.state_of(0) == SERVING
+        # an observe IS a heartbeat too
+        mon.observe(0, 0.01, now=15.0)
+        assert mon.poll(now=20.0) == []
+        assert mon.poll(now=30.0) == [("lost", 0)]
+
+    def test_note_deferred_reoffers_on_next_breach(self):
+        mon = _mon()
+        mon.attach(0, now=0.0)
+        for _ in range(4):
+            mon.observe(0, 0.5, now=0.0)
+        assert mon.poll(now=0.0) == [("quarantine", 0)]
+        mon.note_deferred(0)   # pool had no survivor to drain onto
+        assert mon.state_of(0) == SUSPECT
+        # ONE more breached window re-offers the verdict
+        mon.observe(0, 0.5, now=0.0)
+        mon.observe(0, 0.5, now=0.0)
+        assert mon.poll(now=0.0) == [("quarantine", 0)]
+
+    def test_probe_backoff_doubles_and_recovery_restores(self):
+        mon = _mon(probe_backoff_s=1.0, probe_backoff_max_s=4.0,
+                   recovery_probes=2)
+        mon.attach(0, now=0.0)
+        for _ in range(4):
+            mon.observe(0, 0.5, now=0.0)
+        mon.poll(now=0.0)
+        assert not mon.probe_due(0, now=100.0)  # not drained yet
+        mon.note_drained(0, now=0.0)
+        assert not mon.probe_due(0, now=0.5)
+        assert mon.probe_due(0, now=1.0)
+        # bad probe: backoff doubles (1 -> 2), streak resets
+        assert mon.observe_probe(0, 0.5, now=1.0) is False
+        assert not mon.probe_due(0, now=2.5)
+        assert mon.probe_due(0, now=3.0)
+        # probe raising (vs slow) gets the same treatment: 2 -> 4 (cap)
+        mon.probe_failed(0, now=3.0)
+        assert mon.probe_due(0, now=7.0)
+        mon.probe_failed(0, now=7.0)   # capped at 4, not 8
+        assert mon.probe_due(0, now=11.0)
+        # two consecutive good probes -> recovered
+        assert mon.observe_probe(0, 0.01, now=11.0) is False
+        assert mon.probe_due(0, now=15.0)
+        assert mon.observe_probe(0, 0.01, now=15.0) is True
+        assert mon.state_of(0) == SERVING
+        rec = mon._replicas[0]
+        assert rec.recoveries == 1 and rec.probe_failures == 3
+        # detector state is fresh: quarantine needs a full new streak
+        mon.observe(0, 0.5, now=15.0)
+        mon.observe(0, 0.5, now=15.0)
+        assert mon.state_of(0) == SUSPECT
+
+    def test_quarantined_replica_ignores_regular_observations(self):
+        mon = _mon()
+        mon.attach(0, now=0.0)
+        for _ in range(4):
+            mon.observe(0, 0.5, now=0.0)
+        assert mon.state_of(0) == QUARANTINED
+        mon.observe(0, 0.01, now=0.0)   # stale in-flight completion
+        assert mon.state_of(0) == QUARANTINED
+        # and its lease cannot expire it a second way
+        assert all(v != ("lost", 0) for v in mon.poll(now=1e9))
+
+
+# ---------------------------------------------------------------------------
+# AdaptiveLimit unit: the Vegas gradient + the uid ledger
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveLimit:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AdaptiveLimit(initial=0)
+        with pytest.raises(ValueError):
+            AdaptiveLimit(initial=100, max_limit=64)
+        with pytest.raises(ValueError):
+            AdaptiveLimit(decrease=1.5)
+        with pytest.raises(ValueError):
+            AdaptiveLimit(alpha=3.0, beta=1.0)
+
+    def test_grows_on_headroom(self):
+        lim = AdaptiveLimit(initial=8)
+        lim.observe(0.1)            # seeds min_rtt
+        for _ in range(16):
+            lim.observe(0.1)        # rtt == min_rtt: queue_est 0 < alpha
+        assert lim.limit > 8.0
+        assert lim.grows >= 16 and lim.shrinks == 0
+
+    def test_shrinks_on_latency_rise(self):
+        lim = AdaptiveLimit(initial=8, beta=3.0, decrease=0.9)
+        lim.observe(0.1)
+        lim.observe(10.0)           # queue_est ~= 8 * (1 - 0.01) > beta
+        assert lim.limit == pytest.approx(7.2)
+        assert lim.shrinks == 1
+        for _ in range(200):
+            lim.observe(10.0)
+        # converges into the Vegas band: queue_est within [alpha, beta]
+        est = lim.limit * (1.0 - lim.min_rtt / 10.0)
+        assert lim.alpha <= est <= lim.beta
+
+    def test_min_limit_floor(self):
+        # a beta tighter than one whole slot can never be satisfied at
+        # rtt >> min_rtt: the limit shrinks all the way to the floor
+        lim = AdaptiveLimit(initial=8, min_limit=1, alpha=0.0, beta=0.1)
+        lim.observe(0.1)
+        for _ in range(200):
+            lim.observe(10.0)
+        assert lim.limit == 1.0
+
+    def test_max_limit_clamps_growth(self):
+        lim = AdaptiveLimit(initial=8, max_limit=10)
+        lim.observe(0.1)
+        for _ in range(500):
+            lim.observe(0.1)
+        assert lim.limit == 10.0
+
+    def test_ledger_idempotent_and_headroom(self):
+        lim = AdaptiveLimit(initial=2)
+        assert lim.has_headroom()
+        lim.admit(1)
+        lim.admit(1)                # idempotent
+        assert lim.inflight == 1 and lim.holds(1)
+        lim.admit(2)
+        assert not lim.has_headroom()
+        lim.release(3)              # unknown uid: no-op
+        lim.release(2)
+        assert lim.has_headroom() and not lim.holds(2)
+
+    def test_nonpositive_samples_ignored(self):
+        lim = AdaptiveLimit()
+        lim.observe(0.0)
+        lim.observe(-1.0)
+        assert lim.samples == 0 and lim.min_rtt is None
+
+
+# ---------------------------------------------------------------------------
+# router integration: at-limit replicas are not placement candidates
+# ---------------------------------------------------------------------------
+
+class _StubSched:
+    def __init__(self, live=0, queued=0):
+        self.live_count = live
+        self.queue_depth = queued
+
+
+class _StubReplica:
+    def __init__(self, rid, live=0, hits=0, limit=None):
+        self.replica_id = rid
+        self.scheduler = _StubSched(live)
+        self._hits = hits
+        self.engine = self
+        self.limit = limit
+
+    def prefix_probe(self, prompt):
+        return self._hits
+
+
+class TestRouterLimitFilter:
+    def test_at_limit_replica_skipped_despite_affinity(self):
+        full = AdaptiveLimit(initial=1)
+        full.admit(1)
+        a = _StubReplica(0, live=0, hits=5, limit=full)   # affinity winner
+        b = _StubReplica(1, live=3, hits=0)
+        rep, hits = Router().place([1, 2, 3], [a, b])
+        assert rep is b and hits == 0
+
+    def test_all_at_limit_places_nowhere(self):
+        full = AdaptiveLimit(initial=1)
+        full.admit(1)
+        reps = [_StubReplica(i, limit=full) for i in range(2)]
+        rep, hits = Router().place([1], reps)
+        assert rep is None and hits == 0
+
+    def test_no_limit_attribute_is_unfiltered(self):
+        rep, _ = Router().place([1], [_StubReplica(0)])
+        assert rep is not None
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware early rejection (scheduler admission)
+# ---------------------------------------------------------------------------
+
+class TestDeadlineShed:
+    def test_sheds_when_predicted_ttft_exceeds_deadline(self, setup):
+        m, params = setup
+        sched = ContinuousBatchScheduler(
+            _engine(m, params), sleep=lambda s: None, deadline_guard=True)
+        sched.submit([1, 2, 3, 4], max_new_tokens=2, uid=9501)
+        sched.run_until_complete()     # establishes the per-token EMA
+        assert sched._token_est_s > 0.0
+        with pytest.raises(DeadlineShedError) as ei:
+            sched.submit(list(range(1, 21)), max_new_tokens=2, uid=9502,
+                         deadline=sched._clock() - 1.0)
+        assert ei.value.predicted_s > ei.value.remaining_s
+        assert sched.metrics.faults["deadline_shed"] == 1
+        assert 9502 not in sched._all          # never admitted
+        assert 9502 not in sched.journal       # never journaled
+        # a roomy deadline admits and completes normally
+        r = sched.submit(list(range(1, 9)), max_new_tokens=2, uid=9503,
+                         deadline=sched._clock() + 600.0)
+        sched.run_until_complete()
+        assert r.state is RequestState.DONE
+        sched.close()
+
+    def test_guard_off_by_default_and_inert_before_first_dispatch(
+            self, setup):
+        m, params = setup
+        sched = ContinuousBatchScheduler(_engine(m, params),
+                                         sleep=lambda s: None)
+        assert sched.deadline_guard is False
+        guarded = ContinuousBatchScheduler(_engine(m, params),
+                                           sleep=lambda s: None,
+                                           deadline_guard=True)
+        # no EMA yet: even an expired deadline is admitted (and then
+        # cancelled by the existing deadline machinery, not shed)
+        r = guarded.submit([1, 2, 3], max_new_tokens=2, uid=9510,
+                           deadline=guarded._clock() - 1.0)
+        guarded.run_until_complete()
+        assert r.state is RequestState.CANCELLED
+        assert guarded.metrics.faults["deadline_shed"] == 0
+        sched.close()
+        guarded.close()
+
+
+# ---------------------------------------------------------------------------
+# pool integration: adaptive limits gate placement
+# ---------------------------------------------------------------------------
+
+class TestPoolLimits:
+    def test_pool_rejects_typed_when_every_replica_at_limit(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.enable_limits(lambda rid: AdaptiveLimit(initial=1, min_limit=1,
+                                                     max_limit=1))
+        pool.submit([1, 2, 3, 4], max_new_tokens=2, uid=9601)
+        pool.submit([5, 6, 7, 8], max_new_tokens=2, uid=9602)
+        with pytest.raises(QueueFullError, match="concurrency limit"):
+            pool.submit([9, 10, 11], max_new_tokens=2, uid=9603)
+        assert pool.metrics.pool["limit_rejects"] == 1
+        pool.run_until_complete()
+        # completion released the slots: admission works again
+        r = pool.submit([9, 10, 11], max_new_tokens=2, uid=9603)
+        pool.run_until_complete()
+        assert r.state is RequestState.DONE
+        pool.close()
+
+    def test_limit_ledger_conserved_across_migration(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.enable_limits()
+        req = pool.submit([1, 2, 3, 4, 5, 6], max_new_tokens=3, uid=9610)
+        src = pool.owner_of(9610)
+        dst = 1 - src
+        pool.step()
+        pool.migrate(9610, dst)
+        assert pool.replica(src).limit.inflight == 0
+        assert pool.replica(dst).limit.holds(9610)
+        pool.run_until_complete()   # sanitizer checks conservation per step
+        assert req.state is RequestState.DONE
+        assert pool.replica(dst).limit.inflight == 0
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# gray-failure chaos: degraded replica auto-drains, recovers, bitwise
+# ---------------------------------------------------------------------------
+
+def _warmup(pool, per_replica=2, gen=4, base_uid=9100):
+    """Compile every replica's dispatch shapes BEFORE arming the
+    detector (the enable_health contract: an explicit slo_s does not
+    forgive compile-time first-dispatch latency)."""
+    n = sum(1 for r in pool.replicas if r.state == POOL_SERVING)
+    reqs = [pool.submit([3 + i] * (9 + i), max_new_tokens=gen,
+                        uid=base_uid + i)
+            for i in range(per_replica * n)]
+    pool.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+
+
+class TestGrayFailureChaos:
+    @pytest.mark.slow
+    def test_degraded_replica_quarantined_and_recovered(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=23, n=6, gen=6)
+        ref = _reference(m, params, prompts, uids, gen)
+        # replica 0 runs 50ms slow across its whole dispatch surface —
+        # prefill/mixed batches ride ``put``, pure-decode batches ride
+        # ``decode_multi`` (warmup burns a few calls, the workload the
+        # rest; probes then finish the put window and land sub-SLO)
+        specs = [FaultSpec(site="put", kind="degraded", nth=1, count=30,
+                           latency_s=0.05),
+                 FaultSpec(site="decode_step", kind="degraded", nth=1,
+                           count=30, latency_s=0.05)]
+        pool, engines, injectors = _pool(m, params, 3, specs_for={0: specs})
+        _warmup(pool)
+        pool.enable_health(HealthMonitor(
+            clock=pool._clock, slo_s=0.01, window=2, k_windows=3,
+            probe_backoff_s=0.001, probe_backoff_max_s=0.05,
+            recovery_probes=2))
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        # every request completed bitwise despite the gray failure
+        for r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[r.uid], f"uid {r.uid} diverged"
+        assert injectors[0].fired["degraded"] > 0
+        assert pool.metrics.pool["health_quarantines"] >= 1
+        # drive supervision until the probes burn through the degraded
+        # window and the replica rejoins rotation
+        rep0 = pool.replica(0)
+        for _ in range(20000):
+            if rep0.state == POOL_SERVING:
+                break
+            pool.step()
+        assert rep0.state == POOL_SERVING, rep0.state
+        assert pool.health_monitor.state_of(0) == SERVING
+        assert pool.metrics.pool["health_recoveries"] == 1
+        # the revived replica serves again
+        r = pool.submit([7, 7, 7, 7], max_new_tokens=2, uid=9700)
+        pool.run_until_complete()
+        assert r.state is RequestState.DONE
+        for eng in engines.values():
+            _assert_bounds(eng)
+        pool.close()
+
+    @pytest.mark.slow
+    def test_detector_off_baseline_never_drains(self, setup):
+        """A/B arm: same degraded replica, no supervision — the pool
+        stays naive (no quarantine, replica 0 serving throughout) and
+        still completes bitwise, just slower. The perf comparison lives
+        in the bench's pool_health row."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=23, n=6, gen=6)
+        ref = _reference(m, params, prompts, uids, gen)
+        specs = [FaultSpec(site="put", kind="degraded", nth=1, count=30,
+                           latency_s=0.05),
+                 FaultSpec(site="decode_step", kind="degraded", nth=1,
+                           count=30, latency_s=0.05)]
+        pool, _, _ = _pool(m, params, 3, specs_for={0: specs})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        for r in reqs:
+            assert r.tokens == ref[r.uid]
+        assert pool.replica(0).state == POOL_SERVING
+        assert pool.metrics.pool["health_quarantines"] == 0
+        pool.close()
+
+    @pytest.mark.slow
+    def test_quarantine_drain_bitwise_under_sampling(self, setup):
+        """The quarantine drain rides the same detach/adopt seam as
+        migration, so sampled requests must replay bitwise too (the
+        counter-based per-request keys make the move invisible)."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=29, n=4, gen=6)
+        sampling = {u: SamplingParams(temperature=0.8, seed=u)
+                    for u in uids}
+        ref = _reference(m, params, prompts, uids, gen, sampling=sampling)
+        specs = [FaultSpec(site="put", kind="degraded", nth=1, count=60,
+                           latency_s=0.05),
+                 FaultSpec(site="decode_step", kind="degraded", nth=1,
+                           count=60, latency_s=0.05)]
+        pool, _, _ = _pool(m, params, 2, specs_for={0: specs})
+        _warmup(pool)
+        pool.enable_health(HealthMonitor(
+            clock=pool._clock, slo_s=0.01, window=2, k_windows=3,
+            probe_backoff_s=0.001, probe_backoff_max_s=0.05))
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u,
+                            sampling=sampling[u])
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        for r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[r.uid], f"uid {r.uid} diverged (sampled)"
+        assert pool.metrics.pool["health_quarantines"] >= 1
+        pool.close()
+
+    def test_no_survivor_defers_quarantine(self, setup):
+        """A single-replica pool can never drain: the verdict downgrades
+        to SUSPECT (note_deferred) instead of wedging the pool."""
+        m, params = setup
+        specs = [FaultSpec(site="put", kind="degraded", nth=1, count=200,
+                           latency_s=0.05),
+                 FaultSpec(site="decode_step", kind="degraded", nth=1,
+                           count=200, latency_s=0.05)]
+        pool, _, _ = _pool(m, params, 1, specs_for={0: specs})
+        pool.enable_health(HealthMonitor(
+            clock=pool._clock, slo_s=0.01, window=2, k_windows=3))
+        r = pool.submit(list(range(1, 14)), max_new_tokens=6, uid=9801)
+        pool.run_until_complete()
+        assert r.state is RequestState.DONE
+        assert pool.replica(0).state == POOL_SERVING
+        assert pool.metrics.pool["health_quarantines"] == 0
+        assert pool.health_monitor.state_of(0) in (SERVING, SUSPECT)
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-lease expiry: a wedged replica is absorbed via journal replay
+# ---------------------------------------------------------------------------
+
+class TestLeaseExpiry:
+    def test_lost_replica_absorbed_bitwise(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=31, n=4, gen=5)
+        ref = _reference(m, params, prompts, uids, gen)
+        t = [0.0]
+        pool, _, _ = _pool(m, params, 2, clock=lambda: t[0])
+        mon = pool.enable_health(HealthMonitor(clock=lambda: t[0],
+                                               lease_s=5.0))
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.step()
+        assert any(pool.owner_of(u) == 0 for u in uids)  # 0 owns work
+        # replica 0's control loop wedges: it stops reporting while
+        # replica 1 stays live. Advance past the lease and supervise.
+        t[0] = 100.0
+        mon.heartbeat(1, now=t[0])
+        assert pool._supervise() is True
+        assert pool.replica(0).state == DEAD
+        assert mon.state_of(0) == LOST
+        assert pool.metrics.pool["lease_expiries"] == 1
+        assert pool.metrics.pool["replica_deaths"] == 1
+        # every request (including replica 0's, replayed via the
+        # journal path) completes bitwise on the survivor
+        pool.run_until_complete()
+        for r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[r.uid], f"uid {r.uid} diverged"
+        assert all(pool.owner_of(u) is None for u in uids)  # all swept
+        pool.close()
+
+    def test_revive_reattaches_detector(self, setup):
+        m, params = setup
+        t = [0.0]
+        pool, _, _ = _pool(m, params, 2, clock=lambda: t[0])
+        mon = pool.enable_health(HealthMonitor(clock=lambda: t[0],
+                                               lease_s=5.0))
+        pool.step()
+        t[0] = 100.0
+        mon.heartbeat(1, now=t[0])
+        pool._supervise()
+        assert pool.replica(0).state == DEAD
+        pool.revive(0)
+        assert pool.replica(0).state == POOL_SERVING
+        assert mon.state_of(0) == SERVING
+        assert mon.lease_deadline_of(0) == pytest.approx(105.0)
+        r = pool.submit([1, 2, 3], max_new_tokens=2, uid=9820)
+        pool.run_until_complete()
+        assert r.state is RequestState.DONE
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# busy-spin bugfix: typed error when the pool can never finish
+# ---------------------------------------------------------------------------
+
+class TestNoProgress:
+    def test_run_until_complete_raises_typed_when_all_dead(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.submit([1, 2, 3, 4], max_new_tokens=4, uid=9901)
+        for rep in pool.replicas:
+            rep.state = DEAD
+        with pytest.raises(UnrecoverableEngineError,
+                           match="no progress"):
+            pool.run_until_complete()
+
+    def test_stream_raises_typed_instead_of_spinning(self, setup):
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit([1, 2, 3, 4], max_new_tokens=4, uid=9902)
+        it = pool.stream(req)
+        for rep in pool.replicas:
+            rep.state = DEAD
+        with pytest.raises(UnrecoverableEngineError, match="stranded"):
+            for _ in it:
+                pass
+
+    def test_stream_drains_final_tokens_before_checking(self, setup):
+        """The no-progress check must not swallow tokens produced by the
+        final step: a normal run through stream() still yields every
+        token exactly once."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=37, n=1, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _pool(m, params, 2)
+        req = pool.submit(prompts[0], max_new_tokens=gen, uid=uids[0])
+        got = list(pool.stream(req))
+        assert got == ref[uids[0]]
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# check_pool_health sanitizer: planted violations
+# ---------------------------------------------------------------------------
+
+class _J:
+    def __init__(self, uids=()):
+        self._u = list(uids)
+
+    def uids(self):
+        return list(self._u)
+
+
+class TestPoolHealthSanitizer:
+    def test_clean_views_pass(self):
+        check_pool_health(
+            [(0, "serving", 50.0, "serving", 1, _J([7])),
+             (1, "draining", None, "quarantined", 0, _J())],
+            {7: 0}, now=10.0)
+
+    def test_serving_with_expired_lease_flagged(self):
+        with pytest.raises(SanitizerError, match="expired heartbeat lease"):
+            check_pool_health(
+                [(0, "serving", 5.0, "serving", None, _J())],
+                {}, now=10.0)
+
+    def test_quarantined_owner_flagged(self):
+        with pytest.raises(SanitizerError, match="quarantine drain"):
+            check_pool_health(
+                [(0, "draining", None, "quarantined", None, _J([7]))],
+                {}, now=0.0)
+        with pytest.raises(SanitizerError, match="owner map"):
+            check_pool_health(
+                [(0, "draining", None, "quarantined", None, _J())],
+                {7: 0}, now=0.0)
+
+    def test_limit_leak_flagged(self):
+        with pytest.raises(SanitizerError, match="admit/release leak"):
+            check_pool_health(
+                [(0, "serving", 50.0, "serving", 3, _J([7]))],
+                {7: 0}, now=0.0)
+
+    def test_planted_limit_leak_caught_in_pool_step(self, setup):
+        """Integration: DSTPU_SANITIZE arms check_pool_health inside
+        pool.step(); a manually corrupted ledger trips it."""
+        m, params = setup
+        pool, _, _ = _pool(m, params, 2)
+        pool.enable_limits()
+        pool.submit([1, 2, 3], max_new_tokens=2, uid=9950)
+        pool.replica(0).limit.admit(424242)   # phantom admit
+        pool.replica(1).limit.admit(424243)
+        with pytest.raises(SanitizerError, match="pool health violation"):
+            pool.run_until_complete()

@@ -1,0 +1,179 @@
+"""Cold-start pool restore tests (docs/RESILIENCE.md "Health &
+overload"): after a host crash — the process dies, every engine and all
+host state lost — ``EnginePool.restore`` rebuilds the pool from the
+per-replica durable journals (``replica<i>.journal``), replays every
+live request through the normal detach→adopt admission path, and the
+continuations are bitwise identical to the uninterrupted run, greedy
+and sampled. Membership is discovered from the files; a replica whose
+journal is missing restarts empty; an empty directory is a typed
+refusal, not a silent empty pool."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience import DurableRequestJournal, RetryPolicy
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                 RequestState, SamplingParams)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params):
+    return InferenceEngineV2(m, params, paged=True, max_seqs=4,
+                             max_seq_len=128, prefill_chunk=16, block_size=16,
+                             token_budget=16, num_blocks=33)
+
+
+def _workload(seed=43, n=5, lo=8, hi=25, gen=6):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 128, int(rng.integers(lo, hi))).tolist()
+               for _ in range(n)]
+    uids = [9300 + i for i in range(n)]
+    return prompts, uids, gen
+
+
+_REF_MEMO = {}
+
+
+def _reference(m, params, prompts, uids, gen, sampling=None):
+    key = (tuple(map(tuple, prompts)), tuple(uids), gen, repr(sampling))
+    if key in _REF_MEMO:
+        return _REF_MEMO[key]
+    sched = ContinuousBatchScheduler(
+        _engine(m, params), retry=RetryPolicy(max_attempts=5),
+        sleep=lambda s: None)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=u,
+                         sampling=(sampling or {}).get(u))
+            for p, u in zip(prompts, uids)]
+    sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    _REF_MEMO[key] = {r.uid: list(r.tokens) for r in reqs}
+    sched.close()
+    return _REF_MEMO[key]
+
+
+def _durable_pool(m, params, n, directory):
+    return EnginePool.build(
+        lambda i: _engine(m, params), n,
+        journal_factory=lambda i: DurableRequestJournal(
+            EnginePool.journal_path(directory, i)),
+        retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+
+
+def _crash(pool):
+    """Simulate the host dying: capture what the durable journals hold,
+    then simply abandon the pool — no close(), no drain. Every appended
+    record was flushed at write time, so the files are what a crashed
+    host would leave behind."""
+    live = sorted(u for rep in pool.replicas
+                  for u in rep.scheduler.journal.uids())
+    return live
+
+
+class TestColdRestore:
+    @pytest.mark.parametrize("steps", [0, 3, 99])
+    def test_greedy_restore_bitwise(self, setup, tmp_path, steps):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=43)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool = _durable_pool(m, params, 2, str(tmp_path))
+        reqs = {u: pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)}
+        for _ in range(steps):
+            if not pool.step():
+                break
+        done_before = sorted(u for u, r in reqs.items() if r.finished)
+        live = _crash(pool)
+        assert sorted(done_before + live) == sorted(uids)
+        if steps == 99:
+            assert live == []      # nothing in flight at a clean finish
+
+        pool2 = EnginePool.restore(
+            str(tmp_path), lambda i: _engine(m, params),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        assert len(pool2.replicas) == 2
+        assert sorted(pool2._requests) == live
+        assert pool2.metrics.pool["restores"] == 1
+        assert pool2.metrics.pool["restored_requests"] == len(live)
+        # a restored request is owned by its original replica
+        for uid in live:
+            assert pool2.owner_of(uid) == pool.owner_of(uid)
+        pool2.run_until_complete()
+        for uid in live:
+            req = pool2._requests[uid]
+            assert req.state is RequestState.DONE
+            assert req.tokens == ref[uid], f"uid {uid} diverged post-restore"
+        # completion resolved every journal: a second restore of the same
+        # directory finds the files but nothing to replay
+        for rep in pool2.replicas:
+            assert rep.scheduler.journal.uids() == []
+        pool2.close()
+
+    def test_sampled_restore_bitwise(self, setup, tmp_path):
+        """Sampled requests carry their SamplingParams in the durable
+        record (.v2): the restored pool replays the committed prefix
+        byte-for-byte and re-derives every remaining PRNG key from
+        (seed, absolute position) — no resupplied sampling config."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=47, n=4)
+        sampling = {u: SamplingParams(temperature=0.8, seed=u) for u in uids}
+        ref = _reference(m, params, prompts, uids, gen, sampling=sampling)
+        pool = _durable_pool(m, params, 2, str(tmp_path))
+        for p, u in zip(prompts, uids):
+            pool.submit(p, max_new_tokens=gen, uid=u, sampling=sampling[u])
+        for _ in range(3):
+            pool.step()            # crash mid-decode
+        live = _crash(pool)
+        assert live                # something was actually in flight
+
+        pool2 = EnginePool.restore(
+            str(tmp_path), lambda i: _engine(m, params),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        pool2.run_until_complete()
+        for uid in live:
+            req = pool2._requests[uid]
+            assert req.state is RequestState.DONE
+            assert req.tokens == ref[uid], \
+                f"uid {uid} diverged post-restore (sampled)"
+        pool2.close()
+
+    def test_membership_discovered_from_files(self, setup, tmp_path):
+        """n = max journal id + 1; a replica whose journal file is gone
+        restarts empty (its requests died with the file — the durable
+        contract is per-journal, not pool-global)."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=53, n=6)
+        pool = _durable_pool(m, params, 3, str(tmp_path))
+        for p, u in zip(prompts, uids):
+            pool.submit(p, max_new_tokens=gen, uid=u)
+        pool.step()
+        lost_uids = sorted(u for u in uids if pool.owner_of(u) == 1)
+        live = _crash(pool)
+        os.remove(EnginePool.journal_path(str(tmp_path), 1))
+
+        pool2 = EnginePool.restore(
+            str(tmp_path), lambda i: _engine(m, params),
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        assert len(pool2.replicas) == 3    # ids {0, 2} -> max + 1
+        expect = sorted(set(live) - set(lost_uids))
+        assert sorted(pool2._requests) == expect
+        pool2.run_until_complete()
+        assert all(pool2._requests[u].state is RequestState.DONE
+                   for u in expect)
+        pool2.close()
+
+    def test_empty_directory_refused(self, tmp_path):
+        with pytest.raises(ValueError, match="nothing to restore"):
+            EnginePool.restore(str(tmp_path), lambda i: None)

@@ -188,7 +188,7 @@ class TestFaultInjector:
         assert ei.value.uid == 9
         assert eng.flush(9) is None and eng.preempt(9) == 0
         assert inj.fired == {"transient": 2, "persistent": 1, "latency": 1,
-                             "device_lost": 0}
+                             "degraded": 0, "device_lost": 0}
         inj.enabled = False  # kill switch
         eng.decode_step({9: 1})
         assert inj.fired["persistent"] == 1
@@ -198,6 +198,43 @@ class TestFaultInjector:
         b = FaultInjector.random_plan(5, horizon=100, rate=0.1).specs
         assert a == b and len(a) > 0
         assert a != FaultInjector.random_plan(6, horizon=100, rate=0.1).specs
+
+    def test_degraded_spec_validation(self):
+        with pytest.raises(ValueError):  # nth required (sustained window)
+            FaultSpec(site="put", kind="degraded", latency_s=0.05)
+        with pytest.raises(ValueError):  # latency_s must be positive
+            FaultSpec(site="put", kind="degraded", nth=1, latency_s=0.0)
+        with pytest.raises(ValueError):  # teardown sites can't degrade
+            FaultSpec(site="flush", kind="degraded", nth=1, latency_s=0.05)
+
+    def test_degraded_fires_sustained_window_then_clears(self):
+        class Dummy:
+            def put(self, uids, toks, **kw):
+                return {"put": uids}
+
+        slept = []
+        inj = FaultInjector(
+            [dict(site="put", kind="degraded", nth=2, count=2,
+                  latency_s=0.25)], sleep=slept.append)
+        eng = inj.wrap(Dummy())
+        for _ in range(4):  # calls 1..4: clean, slow, slow, clean
+            assert eng.put([1], [[2]]) == {"put": [1]}  # never an error
+        assert slept == [0.25, 0.25]
+        assert inj.fired["degraded"] == 2
+
+    def test_random_plan_n_degraded(self):
+        a = FaultInjector.random_plan(5, horizon=100, rate=0.1,
+                                      n_degraded=3).specs
+        b = FaultInjector.random_plan(5, horizon=100, rate=0.1,
+                                      n_degraded=3).specs
+        assert a == b
+        degraded = [s for s in a if s.kind == "degraded"]
+        assert len(degraded) == 3
+        assert all(s.latency_s > 0 and s.nth is not None for s in degraded)
+        # degraded draws happen AFTER the base plan's, so n_degraded=0
+        # reproduces the pre-existing plan byte-for-byte under one seed
+        base = FaultInjector.random_plan(5, horizon=100, rate=0.1).specs
+        assert [s for s in a if s.kind != "degraded"] == base
 
 
 def _run_workload(m, params, n_req, *, injector=None, breaker=None,
