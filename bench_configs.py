@@ -598,6 +598,146 @@ def bench_train_stages():
     }
 
 
+def bench_transfer_overlap_train():
+    """Unified-TransferEngine training A/B (docs/TRANSFER.md): the dp=8
+    micro-model at ZeRO stage 2 with a cpu-offloaded sharded optimizer,
+    swept over ``transfer_overlap`` on/off x NVMe moments tier on/off
+    (``offload_optimizer.nvme_path``). Overlap ON submits every leaf's D2H
+    gradient up front as open tickets settled per leaf at the host Adam's
+    drain boundary; OFF is the synchronous twin. The four runs share ONE
+    compiled fwd/bwd program, so ``vs_baseline`` scores the tracked claim:
+    all four arms' loss curves AND final params are BITWISE identical.
+    Reports per-arm step time, the transfer ledger, and the NVMe store
+    counters; the table merges into BENCH_TRAIN.json."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import topology as topo_mod
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    mb_total, seq, warmup, steps = 8, 32, 2, 6
+
+    def mk_engine(overlap, nvme_path, pin_from=None):
+        topo_mod.reset_topology()
+        model = TransformerLM(gpt2_config(
+            "125m", hidden_size=64, num_layers=2, num_heads=4,
+            vocab_size=128, max_seq_len=seq))
+        off = {"device": "cpu"}
+        if nvme_path:
+            off["nvme_path"] = nvme_path
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_batch_size": mb_total,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3,
+                                                      "weight_decay": 0.01}},
+            "zero_optimization": {"stage": 2, "offload_optimizer": off,
+                                  "transfer_overlap": overlap},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0,
+        })
+        if pin_from is not None:  # XLA determinism is per compiled program
+            for name in ("_fwd_bwd", "_train_loss", "_acc", "_step_fn",
+                         "_fused_step_fn", "_multi_step_fn"):
+                if hasattr(pin_from, name):
+                    setattr(engine, name, getattr(pin_from, name))
+        return engine
+
+    def batch(k):
+        rng = np.random.default_rng(1000 + k)
+        return {"input_ids": jnp.asarray(
+            rng.integers(0, 128, (mb_total, seq), dtype=np.int32))}
+
+    arms = (("overlap_on", True, False), ("overlap_off", False, False),
+            ("overlap_on_nvme", True, True), ("overlap_off_nvme", False, True))
+    table, curves, finals = {}, {}, {}
+    ref_engine = None
+    for label, overlap, nvme in arms:
+        nvme_dir = tempfile.mkdtemp(prefix="dstpu_bench_optnvme_") if nvme \
+            else None
+        try:
+            eng = mk_engine(overlap, nvme_dir, pin_from=ref_engine)
+            if ref_engine is None:
+                ref_engine = eng
+            losses = []
+            for k in range(warmup):
+                loss = eng(batch(k))
+                eng.backward(loss)
+                eng.step()
+                losses.append(np.asarray(loss))
+            jax.block_until_ready(eng.params)
+            t0 = time.perf_counter()
+            for k in range(warmup, warmup + steps):
+                loss = eng(batch(k))
+                eng.backward(loss)
+                eng.step()
+                losses.append(np.asarray(loss))
+            jax.block_until_ready(eng.params)
+            step_ms = (time.perf_counter() - t0) / steps * 1000
+            curves[label] = np.asarray(losses)
+            finals[label] = [np.asarray(l) for l in
+                             jax.tree.leaves(eng.get_fp32_params())]
+            te = eng._transfer
+            table[label] = {
+                "step_ms": round(step_ms, 1),
+                "transfer_ledger": te.ledger(),
+                "h2d_bytes_per_s": (round(1.0 / te.s_per_byte("h2d"))
+                                    if te.s_per_byte("h2d") > 0 else None),
+                "d2h_bytes_per_s": (round(1.0 / te.s_per_byte("d2h"))
+                                    if te.s_per_byte("d2h") > 0 else None),
+                "nvme_counters": dict(te.nvme.counters) if te.nvme else None,
+            }
+            if nvme:
+                assert te.nvme.counters["saves"] >= 1, te.nvme.counters
+                assert te.nvme.counters["loads"] >= 1, te.nvme.counters
+        finally:
+            if nvme_dir is not None:
+                shutil.rmtree(nvme_dir, ignore_errors=True)
+
+    bitwise = all(
+        curves[l].shape == curves["overlap_on"].shape
+        and bool(np.array_equal(curves[l], curves["overlap_on"]))
+        and all(np.array_equal(a, b)
+                for a, b in zip(finals[l], finals["overlap_on"]))
+        for l, _, _ in arms)
+    sweep = {
+        "model": "gpt2-125m scaled (h64 L2 v128), seq 32, dp=8 virtual mesh",
+        "steps": steps, "warmup": warmup,
+        "config": "ZeRO stage 2, cpu-offloaded sharded Adam",
+        "bitwise_across_arms": bitwise,
+        "arms": table,
+    }
+    try:  # merge next to the stage sweep (read-modify-write)
+        with open(BENCH_TRAIN_PATH) as f:
+            existing = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        existing = {}
+    existing["transfer_overlap"] = sweep
+    with open(BENCH_TRAIN_PATH, "w") as f:
+        json.dump(existing, f, indent=1)
+    speedup = (table["overlap_off"]["step_ms"]
+               / max(table["overlap_on"]["step_ms"], 1e-9))
+    return {
+        "metric": "train_transfer_overlap_step_ms",
+        "value": table["overlap_on"]["step_ms"],
+        "unit": "ms/step (overlap on)",
+        "vs_baseline": 1.0 if bitwise else 0.0,
+        "detail": {"standin": "scaled dims (h64 L2 v128), seq 32, dp=8 "
+                              "virtual CPU mesh, ZeRO-2 sharded cpu Adam; "
+                              "full table in BENCH_TRAIN.json "
+                              "'transfer_overlap'",
+                   "normalization": "vs_baseline = 1.0 iff all four arms "
+                                    "(overlap on/off x NVMe moments on/off) "
+                                    "have BITWISE identical loss curves and "
+                                    "final params (docs/TRANSFER.md; "
+                                    "compiled programs shared across arms)",
+                   "overlap_off_over_on_step_time": round(speedup, 3),
+                   "arms": table},
+    }
+
+
 def bench_training_chaos():
     """Training-chaos row (docs/RESILIENCE.md training section): a seeded
     fault storm — transient bursts, a checkpoint-save fault, one device loss
@@ -718,7 +858,8 @@ def bench_training_chaos():
 CPU_CONFIGS = {"cpu_zero1_125m": bench_cpu_zero1_125m,
                "pipe_zero1": bench_pipe_zero1,
                "training_chaos": bench_training_chaos,
-               "train_zero_stages": bench_train_stages}
+               "train_zero_stages": bench_train_stages,
+               "train_transfer_overlap": bench_transfer_overlap_train}
 TPU_CONFIGS = {"zero2_350m": bench_zero2_350m,
                "llama7b_zero3": bench_llama7b_zero3,
                "bert_offloadpp": bench_bert_offloadpp}

@@ -1449,6 +1449,149 @@ def run_kv_tier(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_transfer_overlap(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """Unified-TransferEngine acceptance A/B (docs/TRANSFER.md): the kv_tier
+    pressure workload (shared-prefix priority mix over an overcommitted
+    device pool, host tier on, auto swap-vs-recompute preemption) under
+    four arms — transfer overlap ON vs OFF (the synchronous bitwise twin),
+    each with and without the NVMe third tier below a deliberately
+    undersized host tier (so host-LRU overflow spills to disk instead of
+    destroying). Each arm serves the workload TWICE: the second pass
+    re-submits the same prompts, so its lookups promote the tail blocks
+    pass 1 demoted/spilled — both transfer directions carry real load. All
+    four arms must serve bitwise-identical tokens; the NVMe arms must spill
+    AND load; timing reports overlap-on vs overlap-off on the same tier
+    config, plus the transfer ledger and the bandwidth EMAs that seed the
+    scheduler's cost model."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    size = os.environ.get("DSTPU_BENCH_GPT2", "350m")
+    overrides = json.loads(os.environ.get("DSTPU_BENCH_OVERRIDES", "{}"))
+    n_req = int(os.environ.get("DSTPU_BENCH_REQUESTS", "120"))
+    cfg = gpt2_config(size, max_seq_len=1024, **overrides)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    blocks_per_seq = 2  # same overcommit regime as the kv_tier row
+
+    def one_arm(overlap: bool, nvme: bool) -> dict:
+        nvme_dir = tempfile.mkdtemp(prefix="dstpu_bench_nvme_") if nvme \
+            else None
+        try:
+            eng = InferenceEngineV2(
+                model, params, max_seqs=max_seqs, max_seq_len=1024,
+                prefill_chunk=256, dtype=jnp.bfloat16, paged=True,
+                block_size=64, token_budget=256,
+                num_blocks=1 + max_seqs * blocks_per_seq,
+                prefix_cache=prefix_cache,
+                # NVMe arms undersize the host tier so its LRU overflows
+                # into the disk tier; non-NVMe arms hold the whole spill
+                host_tier_blocks=max_seqs if nvme else 4 * max_seqs,
+                transfer_overlap=overlap,
+                nvme_tier_blocks=4 * max_seqs if nvme else 0,
+                nvme_tier_dir=nvme_dir)
+            rng = np.random.default_rng(29)
+            prefix = rng.integers(0, cfg.vocab_size, 256).tolist()
+            prios = rng.integers(0, 3, n_req)
+
+            def _pass():
+                # a fresh rng with the same seed each pass: pass 2 serves
+                # pass 1's EXACT prompt set, so its lookups walk onto tail
+                # blocks the first pass demoted (and, on the NVMe arms,
+                # spilled to disk) — the promote path under measurement
+                prng = np.random.default_rng(31)
+                return run_load(eng, n_requests=n_req, arrival_rate=200.0,
+                                rng=prng, shared_prefix=prefix, prompt_lo=32,
+                                prompt_hi=128, priorities=prios,
+                                collect_tokens=True)
+
+            out1 = _pass()
+            out2 = _pass()
+            out = dict(out2)
+            gen = out1["generated_tokens"] + out2["generated_tokens"]
+            wall = out1["wall_s"] + out2["wall_s"]
+            out["generated_tokens"] = gen
+            out["wall_s"] = round(wall, 2)
+            out["tokens_per_s"] = round(gen / wall, 1) if wall else None
+            out["pass_tokens_per_s"] = [out1["tokens_per_s"],
+                                        out2["tokens_per_s"]]
+            out["request_tokens"] = (out1["request_tokens"]
+                                     + out2["request_tokens"])
+            out["request_states"] = (out1["request_states"]
+                                     + out2["request_states"])
+            out["prefix_cache_stats"] = eng.prefix_cache_stats()
+            out["transfer_ledger"] = eng.transfer.ledger()
+            out["transfer_gauges"] = {
+                label.split("/", 2)[-1]: round(value, 3)
+                for label, value, _ in eng.monitor_events(0)
+                if label.startswith("serve/transfer/")}
+            return out
+        finally:
+            if nvme_dir is not None:
+                shutil.rmtree(nvme_dir, ignore_errors=True)
+
+    arms = {(ov, nv): one_arm(ov, nv)
+            for ov in (True, False) for nv in (False, True)}
+    ref_toks = None
+    for key, out in arms.items():
+        toks = out.pop("request_tokens")
+        states = out.pop("request_states")
+        if ref_toks is None:
+            ref_toks, ref_states = toks, states
+        else:
+            assert toks == ref_toks and states == ref_states, (
+                f"arm overlap={key[0]} nvme={key[1]} changed served tokens")
+    on, off = arms[(True, False)], arms[(False, False)]
+    on_nv, off_nv = arms[(True, True)], arms[(False, True)]
+    for key, out in arms.items():
+        # every arm must have carried real tier traffic both ways, or the
+        # A/B proves nothing about the transfer paths
+        st = out["prefix_cache_stats"]
+        assert st["demoted_blocks"] >= 1 and st["promoted_blocks"] >= 1, (
+            key, st)
+    for out in (on_nv, off_nv):
+        st = out["prefix_cache_stats"]
+        # the disk tier carried load in BOTH directions
+        assert st["nvme_spilled_blocks"] >= 1, st
+        assert st["nvme_loaded_blocks"] >= 1, st
+    speedup = (round(on["tokens_per_s"] / off["tokens_per_s"], 3)
+               if off["tokens_per_s"] else None)
+    speedup_nvme = (round(on_nv["tokens_per_s"] / off_nv["tokens_per_s"], 3)
+                    if off_nv["tokens_per_s"] else None)
+    return {
+        "metric": _metric_name("paged", max_seqs, "transfer_overlap",
+                               prefix_cache),
+        "value": on["tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": speedup,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": f"gpt2-{size} bf16" + (f" {overrides}" if overrides
+                                            else ""),
+            "workload": ("kv_tier pressure shape served TWICE per arm (the "
+                         "second pass re-hits pass 1's demoted/spilled "
+                         "blocks), four arms: transfer overlap on/off x "
+                         "NVMe tier on/off, all bitwise-asserted; NVMe "
+                         f"arms host tier {max_seqs} blocks (undersized) + "
+                         f"{4 * max_seqs} NVMe blocks"),
+            "overlap_on": on, "overlap_off": off,
+            "overlap_on_nvme": on_nv, "overlap_off_nvme": off_nv,
+            "tokens_bitwise_identical": True,
+            "overlap_speedup": speedup,
+            "overlap_speedup_nvme": speedup_nvme,
+            "nvme_spilled_blocks":
+                on_nv["prefix_cache_stats"]["nvme_spilled_blocks"],
+            "nvme_loaded_blocks":
+                on_nv["prefix_cache_stats"]["nvme_loaded_blocks"],
+        },
+    }
+
+
 def _metric_name(mode: str, max_seqs: int, workload: str,
                  prefix_cache: bool) -> str:
     name = f"serve_{mode}_{max_seqs}seq"
@@ -1516,6 +1659,11 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       on (demotion + swap-based preemption) vs off at the same pool size,
       tokens bitwise-asserted, reporting the swap/recompute split, swap
       re-admission percentiles and promotion traffic.
+    - ``transfer_overlap`` (``--kv-tier``): the unified-TransferEngine A/B
+      (docs/TRANSFER.md): the kv_tier pressure shape at transfer overlap
+      on/off x NVMe third tier on/off — four bitwise-identical arms, the
+      NVMe arms spilling a deliberately undersized host tier to disk —
+      reporting overlap speedups, the byte ledger, and the bandwidth EMAs.
     - ``chaos`` (``--faults``): the mixed workload under a seeded fault plan
       (transient bursts, latency spikes, one persistent per-request fault)
       vs its own fault-free reference, decoding speculatively so the site
@@ -1559,6 +1707,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_pool_health(max_seqs, prefix_cache)
     if workload == "kv_tier":
         return run_kv_tier(max_seqs, prefix_cache)
+    if workload == "transfer_overlap":
+        return run_transfer_overlap(max_seqs, prefix_cache)
     cfg = gpt2_config(size, max_seq_len=1024, **overrides)
     model = TransformerLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -1715,7 +1865,8 @@ def main(faults: bool = False, kv_tier: bool = False):
                           ("paged", 32, "engine_loss", True)) if faults
                          else ())
     if kv_tier:
-        configs = configs + (("paged", 32, "kv_tier", True),)
+        configs = configs + (("paged", 32, "kv_tier", True),
+                             ("paged", 32, "transfer_overlap", True))
     results = []
     rows = {}
     for mode, max_seqs, workload, cache in configs:

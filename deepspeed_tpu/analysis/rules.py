@@ -63,6 +63,19 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     # stray materialization here multiplies by optimizer steps/second
     "adam_step", "_step_offload",
     "_ensure_zero3_params", "_z3_release_and_prefetch",
+    # unified TransferEngine (docs/TRANSFER.md): EVERY offload/tier byte
+    # rides these — submit must stay dispatch-only (the async copy), the
+    # designed materialization lives ONLY in _settle / the non-overlap twin
+    # (suppressed at those sites); staging acquire/release must reuse the
+    # pool, never allocate per transfer
+    "submit_d2h", "submit_h2d", "drain_before", "drain_oldest",
+    "drain_all", "acquire_staging", "release_staging",
+    "release_staging_by_key", "put_tree", "get_tree",
+    "cancel_ticket", "cancel_all", "_settle",
+    # TransferEngine client ports: NVMe spill/load of KV blocks and the
+    # offload tier's per-leaf gradient materialization
+    "_spill_block", "_load_block", "_drop_block", "_materialize",
+    "_moments",
 })
 
 #: where the hot-path rules (001/002) apply — ``resilience`` joined when

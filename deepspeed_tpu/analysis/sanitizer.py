@@ -360,11 +360,13 @@ def check_tier_conservation(engine) -> None:
     - **free**: on the device free list,
     - **device-LRU**: device-resident indexed prefix content, unreferenced,
     - **host-tier**: demoted to host RAM (negative-id namespace),
+    - **NVMe-tier**: spilled to disk (same negative-id namespace — a spill
+      moves residency, never the id),
     - **referenced**: mapped by at least one live sequence.
 
     On top of the partition: every content-index entry must resolve — a
     device-id entry through the referenced/LRU sets, a demoted (negative)
-    entry through the host tier (a dangling demoted entry would let
+    entry through the host OR NVMe tier (a dangling demoted entry would let
     ``lookup`` promote freed garbage into a live sequence); queued
     promotions must target referenced blocks (the lookup that queued them
     pinned the destination); and every swap entry must describe a
@@ -380,14 +382,16 @@ def check_tier_conservation(engine) -> None:
     problems: List[str] = []
     free, lru, ref = set(mgr._free), set(mgr._lru), set(mgr._ref)
     host = set(mgr._host)
+    nvme = set(getattr(mgr, "_nvme", ()))
     for overlap, name in ((free & ref, "free AND referenced"),
                           (free & lru, "free AND device-LRU"),
-                          (ref & lru, "referenced AND device-LRU")):
+                          (ref & lru, "referenced AND device-LRU"),
+                          (host & nvme, "host-tier AND NVMe-tier")):
         if overlap:
             problems.append(f"block(s) {sorted(overlap)} are {name}")
-    bad_ns = [b for b in host if b >= _ROOT]
+    bad_ns = [b for b in host | nvme if b >= _ROOT]
     if bad_ns:
-        problems.append(f"host-tier id(s) {sorted(bad_ns)} outside the "
+        problems.append(f"tiered id(s) {sorted(bad_ns)} outside the "
                         f"negative namespace (must be < {_ROOT})")
     devices = free | ref | lru
     expected = set(range(1, mgr.num_blocks))  # block 0 is the trash block
@@ -400,11 +404,15 @@ def check_tier_conservation(engine) -> None:
     if len(host) > cap:
         problems.append(f"host tier over capacity: {len(host)} resident "
                         f"> {cap}")
+    nvme_cap = max(getattr(mgr, "nvme_blocks", 0), 0)
+    if len(nvme) > nvme_cap:
+        problems.append(f"NVMe tier over capacity: {len(nvme)} resident "
+                        f"> {nvme_cap}")
     for key, b in mgr._index.items():
         if b < _ROOT:
-            if b not in host:
+            if b not in host and b not in nvme:
                 problems.append(f"index entry {key} points at demoted "
-                                f"block {b} with no host-tier residence")
+                                f"block {b} with no tier residence")
         elif b not in ref and b not in lru:
             problems.append(f"index entry {key} points at device block "
                             f"{b} that is neither referenced nor cached")
@@ -426,6 +434,61 @@ def check_tier_conservation(engine) -> None:
                             f"tokens (needs {need})")
     if problems:
         raise SanitizerError("[sanitizer] tier conservation violated: "
+                             + "; ".join(problems))
+
+
+def check_transfer_ledger(transfer) -> None:
+    """TransferEngine byte-ledger conservation (docs/TRANSFER.md), checked
+    at every drain boundary under ``DSTPU_SANITIZE``:
+
+    - per direction, bytes **submitted == completed + cancelled + in
+      flight** — a transfer that vanished from the ledger means a client
+      dropped a payload without drain/cancel (leaked in-flight bytes) or a
+      settle was double-counted;
+    - the in-flight byte count must equal the sum over open tickets (and a
+      ticket in the open table must actually be open) — the two views of
+      "still in flight" may never diverge;
+    - the engine's recorded violations must be empty — these are the
+      buffer-reissue-while-open and dependent-read-without-``drain_before``
+      hazards the engine itself detects at the moment they happen and
+      parks here for the next boundary check to report.
+
+    Duck-typed on the engine's public ledger surface; no-op shape for
+    engines without one."""
+    ledger = getattr(transfer, "ledger", None)
+    if ledger is None:
+        return
+    problems: List[str] = []
+    led = ledger()
+    open_bytes = {"d2h": 0, "h2d": 0}
+    for t in getattr(transfer, "_open", {}).values():
+        open_bytes[t.direction] = open_bytes.get(t.direction, 0) + t.nbytes
+        if not t.open:
+            problems.append(f"ticket {t.tid} ({t.direction}) is closed but "
+                            "still tracked as open")
+    for d in ("d2h", "h2d"):
+        sub = led["submitted"][d]
+        acct = (led["completed"][d] + led.get("cancelled", {}).get(d, 0)
+                + led["inflight"][d])
+        if sub != acct:
+            problems.append(
+                f"{d} bytes not conserved: submitted {sub} != completed "
+                f"{led['completed'][d]} + cancelled "
+                f"{led.get('cancelled', {}).get(d, 0)} + inflight "
+                f"{led['inflight'][d]}")
+        if led["inflight"][d] < 0:
+            problems.append(f"{d} in-flight byte count went negative "
+                            f"({led['inflight'][d]})")
+        if led["inflight"][d] != open_bytes.get(d, 0):
+            problems.append(
+                f"{d} in-flight ledger {led['inflight'][d]} B disagrees "
+                f"with the open-ticket table ({open_bytes.get(d, 0)} B)")
+    recorded = list(getattr(transfer, "violations", ()))
+    if recorded:
+        transfer.violations = []
+        problems.extend(recorded)
+    if problems:
+        raise SanitizerError("[sanitizer] transfer ledger violated: "
                              + "; ".join(problems))
 
 

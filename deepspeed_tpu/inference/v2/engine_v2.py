@@ -55,7 +55,9 @@ class InferenceEngineV2:
                  dtype=jnp.float32, paged: bool = False, block_size: int = 64,
                  num_blocks: Optional[int] = None, token_budget: int = 0,
                  prefix_cache: bool = True, decode_horizon: int = 1,
-                 host_tier_blocks: int = 0):
+                 host_tier_blocks: int = 0, transfer_overlap: bool = True,
+                 nvme_tier_blocks: int = 0,
+                 nvme_tier_dir: Optional[str] = None):
         self.model = model
         self.cfg = model.config
         # default serving width: paged mode shares one block pool so 32 slots
@@ -115,9 +117,22 @@ class InferenceEngineV2:
         # pre-tier behavior, byte-identical). Needs the prefix cache: the
         # content index is what makes demoted blocks findable again.
         self.host_tier_blocks = host_tier_blocks if self.prefix_cache else 0
+        # NVMe third tier below host RAM (docs/TRANSFER.md): host-LRU
+        # eviction demotes prefix KV blocks to disk instead of dropping
+        # them. Needs the host tier (it spills FROM it) and a directory.
+        self.nvme_tier_blocks = nvme_tier_blocks \
+            if (self.host_tier_blocks and nvme_tier_dir) else 0
+        #: the engine's one owner of host↔device byte movement
+        #: (docs/TRANSFER.md): async D2H with delayed sync, batched H2D,
+        #: bandwidth EMAs, byte ledger, optional NVMe store. overlap=False
+        #: is the synchronous A/B twin of every tier/swap path.
+        from ...runtime.transfer_engine import TransferEngine
+
+        self.transfer = TransferEngine(
+            overlap=transfer_overlap,
+            nvme_dir=nvme_tier_dir if self.nvme_tier_blocks else None)
         self._tier_gather_fn = None
         self._tier_scatter_fn = None
-        self._tier_buf: Optional[np.ndarray] = None
         #: swapped-out preemption victims: uid -> (block payloads, history,
         #: seen_tokens). Host-side cache only — engine loss, weight swaps,
         #: and flushes drop entries; the scheduler then replays from its
@@ -162,6 +177,7 @@ class InferenceEngineV2:
                     prefix_cache=self.prefix_cache,
                     host_tier_blocks=self.host_tier_blocks)
             self.block_mgr.demote_fn = self._demote_block
+            self._bind_nvme_tier()
             self.kv = model.init_kv_pool(num_blocks, block_size, dtype=dtype)
             #: device bytes of one block's K+V across all layers — the unit
             #: of every tier/swap byte counter and of the scheduler's
@@ -223,7 +239,8 @@ class InferenceEngineV2:
             self.block_mgr.flush_cache()
             # swapped-out victims' KV is old-weights too: drop the payloads
             # so re-admission replays their prompts under the new weights
-            self._swaps.clear()
+            # (cancelling their open tickets settles the byte ledger)
+            self._drop_swaps()
 
     def prefix_probe(self, tokens) -> int:
         """Read-only placement probe: leading full blocks of ``tokens``
@@ -393,50 +410,91 @@ class InferenceEngineV2:
             self._tier_scatter_fn = jax.jit(scatter, donate_argnums=(0,))
         return self._tier_scatter_fn
 
-    def _tier_host_buf(self) -> np.ndarray:
-        """Reused fixed-capacity host staging buffer for promotion/swap-in
-        batches — (max_blocks_per_seq, 2, L, kvh, BS, hd), allocated once.
-        Fixed capacity keeps the scatter program's batch shape constant (no
-        retrace) and bounds staging memory; larger batches go in chunks."""
-        if self._tier_buf is None:
-            k = self.kv[0]
-            shape = ((self.block_mgr.max_blocks_per_seq, 2)
-                     + tuple(k.shape[:2]) + tuple(k.shape[3:]))
-            self._tier_buf = np.empty(shape, np.dtype(k.dtype))
-        return self._tier_buf
+    def _tier_buf_shape(self):
+        """Shape of the fixed-capacity staging batch for promotion/swap-in —
+        (max_blocks_per_seq, 2, L, kvh, BS, hd). Fixed capacity keeps the
+        scatter program's batch shape constant (no retrace) and bounds
+        staging memory; larger batches go in chunks. The buffer itself lives
+        in the TransferEngine's bounded pool (docs/TRANSFER.md)."""
+        k = self.kv[0]
+        return ((self.block_mgr.max_blocks_per_seq, 2)
+                + tuple(k.shape[:2]) + tuple(k.shape[3:]))
+
+    def _bind_nvme_tier(self) -> None:
+        """Wire the allocator's NVMe spill hooks to the TransferEngine's
+        store (no-op with the tier off)."""
+        if not self.nvme_tier_blocks:
+            return
+        self.block_mgr.nvme_blocks = self.nvme_tier_blocks
+        self.block_mgr.spill_fn = self._spill_block
+        self.block_mgr.load_fn = self._load_block
+        self.block_mgr.drop_fn = self._drop_block
+
+    def _spill_block(self, hid: int, payload) -> bool:
+        """Host-LRU eviction hook: demote one host-tier payload to the NVMe
+        store instead of destroying it. Materializing the (long-completed)
+        async gather here is the tier's designed sync — it was going to
+        happen at eviction anyway; the bytes now land on disk under the
+        manifest-last + CRC protocol instead of dying."""
+        arr = self.transfer.drain_before([payload])[0]
+        if arr is None:
+            return False
+        self.transfer.nvme.save(f"kvblock_{-hid}", arr)
+        return True
+
+    def _load_block(self, hid: int):
+        """Promotion hook for NVMe-resident blocks; None on a corrupt file —
+        the allocator drops the entry and the chain truncates there, so the
+        tokens recompute through normal prefill / journal replay (the
+        existing fallback paths; content is never trusted past its CRC)."""
+        from ...runtime.transfer_engine import TransferCorruptError
+
+        try:
+            return self.transfer.nvme.load(f"kvblock_{-hid}")
+        except TransferCorruptError:
+            return None
+
+    def _drop_block(self, hid: int) -> None:
+        self.transfer.nvme.delete(f"kvblock_{-hid}")
 
     def _demote_block(self, block: int):
         """The allocator's ``demote_fn``: async-gather one pool block to the
-        host. Dispatch-only — the gather program is enqueued and the
-        device→host copy started without waiting (``copy_to_host_async``),
-        so demotion never blocks the decode dispatch behind it. The payload
-        materializes lazily at promotion/eviction time."""
+        host through the TransferEngine. Dispatch-only — the gather program
+        is enqueued and the device→host copy started without waiting
+        (``submit_d2h`` → ``copy_to_host_async``), so demotion never blocks
+        the decode dispatch behind it. The payload (an open TransferTicket)
+        materializes lazily at promotion/spill time via ``drain_before``."""
         blk = self._get_tier_gather()(self.kv, jnp.int32(block))
-        blk.copy_to_host_async()
-        return blk
+        return self.transfer.submit_d2h(blk)
 
     def _scatter_blocks(self, payloads, dsts) -> None:
-        """Land host payloads in pool blocks ``dsts``: stage up to
-        ``max_blocks_per_seq`` payloads in the reused host buffer, ship the
-        batch with ONE device_put per dispatch chunk (never one per block),
-        then scatter each row with the single compiled traced-index
-        program."""
+        """Land host payloads in pool blocks ``dsts``: drain the payload
+        tickets at this dispatch boundary (THE tier's designed sync — the
+        copies were started at demotion/swap-out time and have long
+        completed), stage up to ``max_blocks_per_seq`` of them in a pooled
+        staging buffer, ship the batch with ONE device_put per dispatch
+        chunk (never one per block), then scatter each row with the single
+        compiled traced-index program."""
         if not payloads:
             return
-        buf = self._tier_host_buf()
-        cap = buf.shape[0]
-        scatter = self._get_tier_scatter()
-        for base in range(0, len(dsts), cap):
-            chunk = range(base, min(base + cap, len(dsts)))
-            for i, j in enumerate(chunk):
-                # materializing the async gather is THE designed host sync
-                # of the tier: by now the copy has long completed in the
-                # background (it was started at demotion/swap-out time)
-                buf[i] = np.asarray(payloads[j])  # dstpu-lint: ignore[DSTPU001]
-            batch = jax.device_put(buf)
-            for i, j in enumerate(chunk):
-                self.kv = scatter(self.kv, batch, jnp.int32(i),
-                                  jnp.int32(dsts[j]))
+        te = self.transfer
+        buf = te.acquire_staging(self._tier_buf_shape(), self.kv[0].dtype)
+        try:
+            cap = buf.shape[0]
+            scatter = self._get_tier_scatter()
+            for base in range(0, len(dsts), cap):
+                chunk = range(base, min(base + cap, len(dsts)))
+                # payloads are TransferTickets (demote/swap-out) or host
+                # arrays (NVMe loads) — drain_before settles both kinds
+                vals = te.drain_before([payloads[j] for j in chunk])
+                for i, v in enumerate(vals):
+                    buf[i] = v
+                batch = te.submit_h2d(buf).value
+                for i, j in enumerate(chunk):
+                    self.kv = scatter(self.kv, batch, jnp.int32(i),
+                                      jnp.int32(dsts[j]))
+        finally:
+            te.release_staging(buf)
 
     def _drain_promotions(self) -> None:
         """Land every queued host→device promotion before the next compiled
@@ -450,10 +508,29 @@ class InferenceEngineV2:
         if orders:
             self._scatter_blocks([p for p, _ in orders],
                                  [d for _, d in orders])
+            if sanitize_enabled():
+                from ...analysis.sanitizer import check_transfer_ledger
+
+                check_transfer_ledger(self.transfer)
 
     # ------------------------------------------------------------------
     # swap-based preemption (docs/SERVING.md)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _cancel_payloads(payloads) -> None:
+        """Drop swap payloads without landing them — open TransferTickets
+        settle into the ledger's cancelled bucket (host arrays pass)."""
+        for p in payloads:
+            cancel = getattr(p, "cancel", None)
+            if cancel is not None:
+                cancel()
+
+    def _drop_swaps(self) -> None:
+        """Drop every swap-store entry, cancelling its in-flight tickets."""
+        for payloads, _, _ in self._swaps.values():
+            self._cancel_payloads(payloads)
+        self._swaps.clear()
+
     def swap_resident(self, uid: int) -> bool:
         """True when ``uid``'s KV is parked in the host swap store."""
         return uid in self._swaps
@@ -474,11 +551,10 @@ class InferenceEngineV2:
                 or not d.blocks):
             return False
         gather = self._get_tier_gather()
-        payloads = []
-        for b in d.blocks:
-            blk = gather(self.kv, jnp.int32(b))
-            blk.copy_to_host_async()  # dispatch-only, like demotion
-            payloads.append(blk)
+        # dispatch-only, like demotion: each block rides an open ticket;
+        # the sync is delayed to swap-in's drain_before
+        payloads = [self.transfer.submit_d2h(gather(self.kv, jnp.int32(b)))
+                    for b in d.blocks]
         entry = (payloads, list(d.history), d.seen_tokens)
         self.flush(uid)
         self._swaps[uid] = entry
@@ -501,6 +577,7 @@ class InferenceEngineV2:
             return False
         payloads, history, seen = entry
         if not self.state.can_allocate():
+            self._cancel_payloads(payloads)
             return False
         desc = self.state.get_or_create_sequence(uid)
         try:
@@ -508,11 +585,16 @@ class InferenceEngineV2:
         except (PoolExhaustedError, ContextOverflowError):
             self.block_mgr.free(desc)
             self.state.flush_sequence(uid)
+            self._cancel_payloads(payloads)
             return False
         assert len(desc.blocks) == len(payloads), \
             f"uid {uid}: swap-in geometry drift"
         self._drain_promotions()  # keep pool writes in queue order
         self._scatter_blocks(payloads, desc.blocks)
+        if sanitize_enabled():
+            from ...analysis.sanitizer import check_transfer_ledger
+
+            check_transfer_ledger(self.transfer)
         desc.history = list(history)
         desc.seen_tokens = seen
         desc.n_indexed = 0
@@ -1288,8 +1370,11 @@ class InferenceEngineV2:
         self._bias_rows.pop(uid, None)
         self._drop_bias(uid)
         if uid not in self.state.seqs:
-            if self._swaps.pop(uid, None) is not None:
-                # cancel/expiry of a swapped-out victim: drop its payload
+            entry = self._swaps.pop(uid, None)
+            if entry is not None:
+                # cancel/expiry of a swapped-out victim: drop its payloads,
+                # cancelling any still-open transfer tickets
+                self._cancel_payloads(entry[0])
                 return
             self.flush_noops += 1
             log_dist(f"flush({uid}): unknown uid (no-op #{self.flush_noops})",
@@ -1328,8 +1413,13 @@ class InferenceEngineV2:
         journal through normal admission. The host KV tier and the swap
         store die with the incarnation too (both are caches of pool content
         that no longer exists — a swap-in after rebuild would resurrect KV
-        from the dead device): journal replay never consults either."""
+        from the dead device): journal replay never consults either. Open
+        transfer tickets reference arrays on the dead device — they are
+        cancelled wholesale (settling them is impossible), and orphaned
+        NVMe-tier files (their bookkeeping dies with the block manager) are
+        deleted so the store never serves a previous incarnation's KV."""
         self.state = DSStateManager(self.max_seqs, self.max_seq_len)
+        self.transfer.cancel_all()
         self._swaps.clear()
         # sampling state is per-residency (slot bindings died with the state
         # manager): replay re-registers through set_sampling + put, and the
@@ -1360,7 +1450,11 @@ class InferenceEngineV2:
                 old.num_blocks, old.block_size, old.max_blocks_per_seq,
                 prefix_cache=self.prefix_cache,
                 host_tier_blocks=self.host_tier_blocks)
+        if self.nvme_tier_blocks:
+            for hid in list(getattr(old, "_nvme", ())):
+                self._drop_block(hid)
         self.block_mgr.demote_fn = self._demote_block
+        self._bind_nvme_tier()
         self.kv = self.model.init_kv_pool(old.num_blocks, old.block_size,
                                           dtype=self.dtype)
         log_dist(
@@ -1401,6 +1495,12 @@ class InferenceEngineV2:
         s["host_blocks"] = self.block_mgr.host_blocks
         s["host_capacity_blocks"] = self.host_tier_blocks
         s["host_bytes"] = self.block_mgr.host_blocks * self.block_bytes
+        # NVMe third tier (docs/TRANSFER.md): residency + capacity gauges
+        # alongside the allocator's nvme_* flow counters already in ``s``
+        nvme_res = getattr(self.block_mgr, "nvme_resident_blocks", 0)
+        s["nvme_blocks"] = nvme_res
+        s["nvme_capacity_blocks"] = self.nvme_tier_blocks
+        s["nvme_bytes"] = nvme_res * self.block_bytes
         s.update(self.swap_stats)
         s["swap_out_bytes"] = self.swap_stats["swap_out_blocks"] * self.block_bytes
         s["swap_in_bytes"] = self.swap_stats["swap_in_blocks"] * self.block_bytes
@@ -1409,9 +1509,13 @@ class InferenceEngineV2:
     def monitor_events(self, step: int = 0) -> List[Tuple[str, float, int]]:
         """Prefix-cache counters as ``(label, value, step)`` events for
         ``deepspeed_tpu.monitor.MonitorMaster.write_events`` — serving
-        dashboards plot cache effectiveness alongside training metrics."""
-        return [(f"inference/prefix_cache/{k}", float(v), step)
-                for k, v in sorted(self.prefix_cache_stats().items())]
+        dashboards plot cache effectiveness alongside training metrics.
+        TransferEngine bandwidth EMAs and ledger bytes ride along under
+        ``serve/transfer/*`` (docs/TRANSFER.md)."""
+        events = [(f"inference/prefix_cache/{k}", float(v), step)
+                  for k, v in sorted(self.prefix_cache_stats().items())]
+        events.extend(self.transfer.monitor_events("serve/transfer", step))
+        return events
 
     def can_schedule(self, n_new: int = 1) -> bool:
         if not self.state.can_allocate(n_new):

@@ -36,6 +36,20 @@ so a recycled device id can never collide with a host-resident index entry;
 own keys, which embed the parent id — whenever a block crosses the tier
 boundary. The host tier is a cache, never a source of truth: flushes drop it
 wholesale and recovery never consults it.
+
+NVMe third tier (docs/TRANSFER.md): with ``nvme_blocks > 0`` host-LRU
+eviction *spills* the oldest host block to disk (``spill_fn``) instead of
+destroying it. A spill keeps the block's id — host and NVMe ids share the
+``< _ROOT`` namespace, so only residency moves (``_host`` → ``_nvme``) and no
+rekey is needed; the index chain stays intact and ``probe`` sees all three
+tiers. A content hit on an NVMe block loads it back (``load_fn``) straight
+onto a device block; a load that fails verification (``load_fn`` returns
+None — the TransferEngine's CRC/ring protocol exhausted every slot) drops
+the block's whole NVMe subtree and truncates the hit chain there, so the
+tokens recompute via normal prefill — corruption degrades to a cache miss,
+never to wrong KV. Because children demote before parents and the spill
+takes the oldest host entry first, an NVMe block's children are always
+NVMe-resident and subtree drops never dangle an edge.
 """
 
 from collections import OrderedDict
@@ -85,7 +99,8 @@ class BlockedKVCache:
     step fills blocks, and ``free`` at flush."""
 
     def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int,
-                 prefix_cache: bool = False, host_tier_blocks: int = 0):
+                 prefix_cache: bool = False, host_tier_blocks: int = 0,
+                 nvme_blocks: int = 0):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
@@ -93,6 +108,9 @@ class BlockedKVCache:
         #: host-RAM spill tier capacity in blocks; 0 disables the tier and
         #: keeps reclaim byte-identical to the single-tier allocator
         self.host_tier_blocks = host_tier_blocks if prefix_cache else 0
+        #: NVMe third-tier capacity in blocks; requires the host tier (spills
+        #: only ever come OUT of ``_host``) and engine-supplied spill/load fns
+        self.nvme_blocks = nvme_blocks if self.host_tier_blocks else 0
         self._free: List[int] = list(range(1, num_blocks))[::-1]  # 0 reserved
         self._ref: Dict[int, int] = {}  # block -> refcount (present iff > 0)
         # content index: (parent block id | _ROOT, token tuple) -> block id.
@@ -106,6 +124,10 @@ class BlockedKVCache:
         #: host tier: host id (< _ROOT) -> opaque payload handle from
         #: ``demote_fn``; insertion order = host-eviction order
         self._host: "OrderedDict[int, object]" = OrderedDict()
+        #: NVMe tier residency: block id (same ``< _ROOT`` namespace as the
+        #: host tier — a spill moves residency, never the id), insertion
+        #: order = NVMe-eviction order; payloads live on disk, not here
+        self._nvme: "OrderedDict[int, None]" = OrderedDict()
         self._next_host_id = _ROOT - 1
         #: (payload, device_block) pairs the engine must scatter onto the
         #: device before its next dispatch (see ``take_promotions``)
@@ -113,11 +135,20 @@ class BlockedKVCache:
         #: engine-supplied ``block_id -> payload`` async gather; when None the
         #: tier tracks bookkeeping only (host-side unit tests)
         self.demote_fn = None
+        #: engine-supplied NVMe hooks: ``spill_fn(hid, payload) -> bool``
+        #: persists a host payload to disk, ``load_fn(hid) -> payload|None``
+        #: reads it back (None = failed verification), ``drop_fn(hid)``
+        #: deletes the on-disk copy; all None = bookkeeping-only tier
+        self.spill_fn = None
+        self.load_fn = None
+        self.drop_fn = None
         self.stats = {"lookups": 0, "hits": 0, "hit_blocks": 0,
                       "skipped_prefill_tokens": 0, "evicted_blocks": 0,
                       "cow_copies": 0, "dedup_blocks": 0,
                       "demoted_blocks": 0, "promoted_blocks": 0,
-                      "host_evicted_blocks": 0}
+                      "host_evicted_blocks": 0, "nvme_spilled_blocks": 0,
+                      "nvme_loaded_blocks": 0, "nvme_evicted_blocks": 0,
+                      "nvme_corrupt_blocks": 0}
 
     @property
     def free_blocks(self) -> int:
@@ -133,6 +164,11 @@ class BlockedKVCache:
     def host_blocks(self) -> int:
         """Blocks currently resident in the host-RAM spill tier."""
         return len(self._host)
+
+    @property
+    def nvme_resident_blocks(self) -> int:
+        """Blocks currently resident in the NVMe third tier."""
+        return len(self._nvme)
 
     def blocks_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.block_size)
@@ -198,14 +234,45 @@ class BlockedKVCache:
                 self._index[nkey] = c
                 self._meta[c] = (nkey, new)
 
-    def _evict_host_one(self) -> bool:
-        """Destroy one leaf block of the host tier (oldest first). Host-tier
-        eviction is the only place tiered content actually dies, so it stays
-        strictly leaf-first: a host block's children are themselves
-        host-resident (a device child pins its parent on device), and
-        children demote before parents, so leaves sit at the old end."""
+    @staticmethod
+    def _drop_payload(payload) -> None:
+        """A destroyed tier entry's payload may be an in-flight
+        TransferTicket — cancel it so the engine's byte ledger settles the
+        bytes as cancelled instead of leaking them as forever-in-flight."""
+        cancel = getattr(payload, "cancel", None)
+        if cancel is not None:
+            cancel()
+
+    def _evict_host_one(self, spill: bool = None) -> bool:
+        """Make room in the host tier by one block: *spill* the oldest host
+        block to the NVMe tier when one is configured (residency moves, the
+        id — and therefore every index/children edge — stays), destroy a
+        leaf block otherwise. ``spill=False`` forces the destructive path
+        (flushes: dropped content must not resurface by NVMe load).
+
+        The spill takes strictly the OLDEST entry: children demote before
+        parents, so FIFO order guarantees an NVMe block's children are
+        already NVMe-resident — the invariant subtree drops rely on. The
+        destructive path stays leaf-first (no children in any tier), since
+        it is the only place tiered content actually dies."""
+        if spill is None:
+            spill = self.nvme_blocks > 0 and self.spill_fn is not None
+        if spill and self._host:
+            while len(self._nvme) >= self.nvme_blocks:
+                if not self._evict_nvme_one():
+                    spill = False  # NVMe wedged: fall back to destruction
+                    break
+            if spill:
+                b = next(iter(self._host))  # oldest
+                if self.spill_fn(b, self._host[b]):
+                    del self._host[b]
+                    self._nvme[b] = None
+                    self.stats["nvme_spilled_blocks"] += 1
+                    return True
+                # spill failed (disk error): fall through and destroy a leaf
         for b in self._host:  # oldest → newest
             if not self._children.get(b):
+                self._drop_payload(self._host[b])
                 self._unindex(b)
                 del self._host[b]
                 self.stats["host_evicted_blocks"] += 1
@@ -213,6 +280,35 @@ class BlockedKVCache:
         # every resident block has children (a promotion holds one leaf out
         # of the scan): tell the caller to fall back to a hard evict
         return False
+
+    def _evict_nvme_one(self) -> bool:
+        """Destroy one leaf block of the NVMe tier (oldest first) — the
+        bottom of the hierarchy, where eviction finally deletes content."""
+        for b in self._nvme:  # oldest → newest
+            if not self._children.get(b):
+                self._unindex(b)
+                del self._nvme[b]
+                if self.drop_fn is not None:
+                    self.drop_fn(b)
+                self.stats["nvme_evicted_blocks"] += 1
+                return True
+        return False
+
+    def _drop_nvme_subtree(self, root: int) -> None:
+        """Drop ``root`` and every descendant from the index and the NVMe
+        tier (descendants of an NVMe block are all NVMe-resident). Used when
+        a load fails verification: the chain is truncated at the corrupt
+        block and everything below it is unreachable content."""
+        stack, order = [root], []
+        while stack:
+            b = stack.pop()
+            order.append(b)
+            stack.extend(self._children.get(b, ()))
+        for b in reversed(order):  # children unindex before their parent
+            self._unindex(b)
+            self._nvme.pop(b, None)
+            if self.drop_fn is not None:
+                self.drop_fn(b)
 
     def _demote(self, b: int) -> bool:
         """Spill device block ``b``'s content to the host tier: gather its KV
@@ -237,7 +333,33 @@ class BlockedKVCache:
         onto it, and queue the data movement for the engine to drain before
         its next dispatch. Returns the device id, or None when the device
         pool cannot host it (the hit chain is truncated there — the tokens
-        recompute, correctness is unaffected)."""
+        recompute, correctness is unaffected).
+
+        NVMe-resident blocks load straight to the device: the disk copy is
+        read back (``load_fn``), verified by the TransferEngine's CRC/ring
+        protocol, and deleted once promoted. A failed verification drops the
+        block's whole NVMe subtree and truncates the hit — corruption
+        degrades to recompute, never to wrong KV."""
+        if hid in self._nvme:
+            del self._nvme[hid]  # hold it out of any eviction scan below
+            try:
+                dst = self._allocate(uid)
+            except PoolExhaustedError:
+                self._nvme[hid] = None  # re-shelve and give up
+                return None
+            payload = self.load_fn(hid) if self.load_fn is not None else None
+            if payload is None and self.load_fn is not None:
+                self._decref(dst)  # unindexed → straight back to free list
+                self._drop_nvme_subtree(hid)
+                self.stats["nvme_corrupt_blocks"] += 1
+                return None
+            if self.drop_fn is not None:
+                self.drop_fn(hid)  # promoted: the disk copy is now stale
+            self._rekey(hid, dst)
+            self._pending_promotions.append((payload, dst))
+            self.stats["nvme_loaded_blocks"] += 1
+            self.stats["promoted_blocks"] += 1
+            return dst
         payload = self._host.pop(hid)
         try:
             dst = self._allocate(uid)
@@ -299,10 +421,16 @@ class BlockedKVCache:
     def flush_cache(self):
         """Force-evict every cached (unreferenced) block back to the free
         pool — drops all prefix reuse state held beyond live sequences,
-        *including the entire host tier*: a flush marks the content stale
-        (e.g. a weight swap), so nothing may survive to promote back in."""
+        *including the entire host and NVMe tiers*: a flush marks the
+        content stale (e.g. a weight swap), so nothing may survive to
+        promote or load back in. NVMe drains first (its blocks may pin host
+        parents), then the host tier destructively (never spilling — spilled
+        content would resurface)."""
+        while self._nvme:
+            if not self._evict_nvme_one():  # pragma: no cover - defensive
+                raise AssertionError("NVMe tier wedged during flush")
         while self._host:
-            if not self._evict_host_one():  # pragma: no cover - defensive
+            if not self._evict_host_one(spill=False):  # pragma: no cover
                 raise AssertionError("host tier wedged during flush")
         while self._lru:
             self._evict_one(demote=False)
@@ -429,9 +557,9 @@ class BlockedKVCache:
         score every replica per placement without perturbing any cache.
         Deterministic: the exact chained index, not a hash sketch.
 
-        The probe sees BOTH tiers: demoted blocks keep their index entries
-        (at negative host ids, with child keys rechained by ``_rekey``), so
-        the walk crosses device->host boundaries transparently and the
+        The probe sees EVERY tier: demoted and spilled blocks keep their
+        index entries (at negative ids, with child keys rechained by
+        ``_rekey``), so the walk crosses tier boundaries transparently and the
         affinity score counts content one promotion away — exactly what a
         placement should weigh, since a hit on a demoted block is a block
         copy, not a recompute."""
@@ -483,11 +611,17 @@ class BlockedKVCache:
             own = desc.blocks[j]
             existing = self._index.get(key)
             if existing is not None and existing < _ROOT:
-                # identical content sits demoted in the host tier; our copy
-                # is freshly written on device and bitwise the same, so adopt
-                # it as the canonical block: drop the host payload and rekey
-                # the demoted id (and any host children) onto our block.
-                self._host.pop(existing, None)
+                # identical content sits demoted in the host or NVMe tier;
+                # our copy is freshly written on device and bitwise the same,
+                # so adopt it as the canonical block: drop the tiered payload
+                # and rekey the demoted id (and any tiered children) onto
+                # our block.
+                if existing in self._nvme:
+                    del self._nvme[existing]
+                    if self.drop_fn is not None:
+                        self.drop_fn(existing)
+                else:
+                    self._drop_payload(self._host.pop(existing, None))
                 self._rekey(existing, own)
                 self.stats["dedup_blocks"] += 1
             elif existing is not None and existing != own:
@@ -509,28 +643,36 @@ class BlockedKVCache:
         """Raise AssertionError if internal bookkeeping is inconsistent."""
         assert all(r > 0 for r in self._ref.values()), "non-positive refcount"
         free, lru, ref = set(self._free), set(self._lru), set(self._ref)
-        host = set(self._host)
+        host, nvme = set(self._host), set(self._nvme)
         assert not (free & lru) and not (free & ref) and not (lru & ref), \
             "block in more than one pool"
         assert len(free) == len(self._free), "duplicate block in free list"
         assert 0 not in free | lru | ref, "trash block 0 escaped reservation"
         assert len(free | lru | ref) <= self.num_blocks - 1, "phantom block"
         assert all(b < _ROOT for b in host), "device id in the host tier"
+        assert all(b < _ROOT for b in nvme), "device id in the NVMe tier"
+        assert not (host & nvme), "block resident in both spill tiers"
         assert len(host) <= max(self.host_tier_blocks, 0), "host tier overfull"
+        assert len(nvme) <= max(self.nvme_blocks, 0), "NVMe tier overfull"
         for b in host:
             assert b in self._meta, "host-tier block missing from the index"
             kids = self._children.get(b, ())
             assert all(c < _ROOT for c in kids), \
                 "host-tier block anchors a device-resident child"
+        for b in nvme:
+            assert b in self._meta, "NVMe-tier block missing from the index"
+            kids = self._children.get(b, ())
+            assert all(c in nvme for c in kids), \
+                "NVMe-tier block anchors a child above it in the hierarchy"
         for key, b in self._index.items():
             assert self._meta.get(b, (None,))[0] == key, "index/meta mismatch"
             parent = key[0]
             assert parent == _ROOT or parent in self._meta, \
                 "indexed block chained on an unindexed parent"
-            assert b >= 0 or b in host, \
-                "index entry at a demoted block with no host-tier residence"
+            assert b >= 0 or b in host or b in nvme, \
+                "index entry at a demoted block with no tier residence"
         for b in self._meta:
-            assert b in ref or b in lru or b in host, \
+            assert b in ref or b in lru or b in host or b in nvme, \
                 "indexed block is in the free list"
         for parent, kids in self._children.items():
             for c in kids:

@@ -520,11 +520,18 @@ class DeepSpeedEngine:
         else:
             self.optimizer = None
         self._offload_mgr = None
+        # unified TransferEngine owning all offload host<->device byte
+        # movement (docs/TRANSFER.md; set by _setup_offload)
+        self._transfer = None
         # ZeRO-2/3 sharded host tier state (set by _setup_offload when planned)
         self._zero_tier = None
         self._z3_residency = False
         self._z3_released = {}
         self._z3_prefetched = set()
+        # per-leaf access schedule (writeback order of the first completed
+        # step = the order forward consumes leaves) driving stage-3
+        # release/prefetch ordering once recorded
+        self._z3_schedule = []
         if self.optimizer is not None and self._offload_enabled:
             self.opt_state = None
             self._setup_offload(off, params)
@@ -1131,6 +1138,19 @@ class DeepSpeedEngine:
             lr=opt.lr, betas=opt.betas, eps=opt.eps, weight_decay=opt.weight_decay,
             bias_correction=opt.bias_correction, adamw_mode=opt.adam_w_mode,
         )
+        # one TransferEngine per engine: every offload D2H/H2D byte rides its
+        # ledger; overlap=False is the synchronous bitwise twin (A/B arm).
+        # nvme_path on the SHARDED (cpu) tier selects the NVMe third tier for
+        # the Adam moments — the legacy device="nvme" AIO path is untouched.
+        from .transfer_engine import TransferEngine
+
+        zc = self.config.zero_config
+        nvme_dir = off.nvme_path if (self._zero_sharded_planned
+                                     and off.nvme_path) else None
+        self._transfer = TransferEngine(
+            overlap=bool(getattr(zc, "transfer_overlap", True)),
+            nvme_dir=nvme_dir,
+        )
         dev_state = None
         if self._zero_sharded_planned:
             # stage >= 2: the host tier shards the optimizer state per DP rank
@@ -1147,13 +1167,16 @@ class DeepSpeedEngine:
             host_state = ZeroShardedTier(
                 [np.asarray(leaves[i], np.float32) for i in host_idx],
                 plan, stage=self.zero_stage,
+                nvme_store=self._transfer.nvme if nvme_dir else None,
             )
             self._zero_tier = host_state
             self._z3_residency = self.zero_stage >= 3
             log_dist(
                 f"ZeRO-{self.zero_stage} sharded tier: {len(host_idx)} leaves "
                 f"-> cpu, optimizer state in {plan.num_shards} shards "
-                f"(~{plan.shard_bytes(0) // 1024} KiB/shard)", ranks=[0],
+                f"(~{plan.shard_bytes(0) // 1024} KiB/shard)"
+                + (f", moments on NVMe ({nvme_dir})" if nvme_dir else ""),
+                ranks=[0],
             )
         else:
             host_state = OffloadedAdamState(
@@ -1173,6 +1196,8 @@ class DeepSpeedEngine:
                 f"ZeRO-Offload: {len(host_idx)} leaves -> {off.device} "
                 f"(ratio={off.ratio}), {len(dev_idx)} stay on device", ranks=[0],
             )
+        # both tiers settle their gradient tickets through THIS ledger
+        host_state.transfer = self._transfer
         self._offload_mgr = {
             "treedef": treedef, "host_idx": host_idx, "dev_idx": dev_idx,
             "host": host_state, "dev": dev_state, "cpu_opt": cpu_opt,
@@ -1234,20 +1259,28 @@ class DeepSpeedEngine:
                 jnp.asarray(mgr["host"].step_count, jnp.int32),
             )
 
-        # twin-flow overlap (reference Offload++ blog): start EVERY host
-        # leaf's D2H gradient transfer now (native dtype — half the wire bytes
-        # under bf16), so the per-leaf Adam loop below finds its grad already
-        # host-resident while later leaves are still in flight
+        # twin-flow overlap (reference Offload++ blog): submit EVERY host
+        # leaf's D2H gradient transfer now through the TransferEngine (native
+        # dtype — half the wire bytes under bf16); the per-leaf Adam loop
+        # settles each ticket at its drain_before boundary while later leaves
+        # are still in flight. overlap=False makes each submit a synchronous
+        # bitwise twin.
         host_idx = mgr["host_idx"]
-        host_grads_dev = [grads_flat[i] for i in host_idx]
-        for g in host_grads_dev:
-            if hasattr(g, "copy_to_host_async"):
-                g.copy_to_host_async()
+        te = self._transfer
+        host_grads_dev = [
+            te.submit_d2h(grads_flat[i])
+            if hasattr(grads_flat[i], "copy_to_host_async") else grads_flat[i]
+            for i in host_idx
+        ]
 
         params_flat = list(jax.tree.leaves(self.params))
         shard_flat = jax.tree.leaves(self._param_shardings)
         np_compute = np.dtype(self.compute_dtype)
         tier = self._zero_tier
+        sched = self._z3_schedule
+        record = tier is not None and len(sched) < len(host_idx)
+        if record:
+            del sched[:]  # re-record from scratch if a prior step aborted
 
         def _writeback(j, master_np):
             # per-leaf H2D upload, dispatched while the NEXT leaf's host Adam
@@ -1256,11 +1289,16 @@ class DeepSpeedEngine:
             i = host_idx[j]
             lp_np = master_np if np_compute == master_np.dtype else \
                 master_np.astype(np_compute)
-            params_flat[i] = jax.device_put(lp_np, shard_flat[i])
+            params_flat[i] = te.submit_h2d(lp_np, shard_flat[i]).value
             if tier is not None:
                 # the updated-weights all-gather of the sharded tier
                 tier.counters["gathers"] += 1
                 tier.counters["offload_bytes_out"] += lp_np.nbytes
+            if record:
+                # first completed step records the leaf schedule (writeback
+                # order == tree-leaf order == the order forward consumes) for
+                # stage-3 release/prefetch ordering
+                sched.append(j)
 
         mgr["host"].adam_step(
             mgr["cpu_opt"], host_grads_dev, lr, grad_scale=inv_scale,
@@ -1279,28 +1317,46 @@ class DeepSpeedEngine:
             self.scaler_state = self.loss_scaler.update(
                 self.scaler_state, jnp.asarray(False)
             )
+        from ..analysis.sanitizer import sanitize_enabled
+
+        if sanitize_enabled():
+            # step boundary: every gradient ticket drained, every H2D settled
+            # -> submitted == completed + cancelled, nothing in flight
+            from ..analysis.sanitizer import check_transfer_ledger
+
+            check_transfer_ledger(te)
         return False, gnorm
 
     # ------------------------------------------------------------------
     # ZeRO-3 parameter residency (docs/ZERO.md "Stage-3 residency window")
     # ------------------------------------------------------------------
     def _z3_release_and_prefetch(self):
-        """After the step's writeback: demote the largest non-persistent lp
-        leaves to the tier's host cache until the live-element count fits
+        """After the step's writeback: demote non-persistent lp leaves to the
+        tier's host cache until the live-element count fits
         ``max_live_parameters`` (the params-sharded-at-rest half of stage 3),
         then re-upload up to ``prefetch_bucket_size`` bytes so the next
         forward starts with its window warm. The cached host array is the
         SAME compute-dtype cast the writeback uploaded, so a release/upload
-        round trip is byte-exact — residency never changes the math."""
+        round trip is byte-exact — residency never changes the math.
+
+        Ordering comes from the recorded access schedule (``_z3_schedule``,
+        first completed step's writeback order == the order forward consumes
+        leaves): release farthest-next-use first (reverse schedule), prefetch
+        earliest-needed first. Until a schedule exists (e.g. step 1 hit a
+        loss-scale overflow) the old largest-first heuristic stands in."""
         tier = self._zero_tier
         zc = self.config.zero_config
         sizes = tier.plan.leaf_sizes
         released = self._z3_released
+        sched = self._z3_schedule if len(self._z3_schedule) == len(sizes) \
+            else None
         live = sum(sizes) - sum(sizes[j] for j in released)
         if live > zc.max_live_parameters:
             params_flat = list(jax.tree.leaves(self.params))
             np_compute = np.dtype(jnp.dtype(self.compute_dtype).name)
-            for j in sorted(range(len(sizes)), key=lambda j: -sizes[j]):
+            release_order = list(reversed(sched)) if sched is not None else \
+                sorted(range(len(sizes)), key=lambda j: -sizes[j])
+            for j in release_order:
                 if live <= zc.max_live_parameters:
                     break
                 if j in released or sizes[j] <= zc.param_persistence_threshold:
@@ -1312,17 +1368,20 @@ class DeepSpeedEngine:
                 live -= sizes[j]
         if not released:
             return
-        # prefetch window, in leaf order (the order forward consumes them)
+        # prefetch window, in schedule order (earliest-needed first)
         budget = int(zc.prefetch_bucket_size)
         params_flat = list(jax.tree.leaves(self.params))
         shard_flat = jax.tree.leaves(self._param_shardings)
+        te = self._transfer
         changed = False
-        for j in sorted(released):
+        prefetch_order = [j for j in sched if j in released] \
+            if sched is not None else sorted(released)
+        for j in prefetch_order:
             lp = released[j]
             if lp.nbytes > budget:
                 break
             budget -= lp.nbytes
-            params_flat[j] = jax.device_put(lp, shard_flat[j])
+            params_flat[j] = te.submit_h2d(lp, shard_flat[j]).value
             del released[j]
             self._z3_prefetched.add(j)
             tier.counters["gathers"] += 1
@@ -1348,9 +1407,10 @@ class DeepSpeedEngine:
             return
         params_flat = list(jax.tree.leaves(self.params))
         shard_flat = jax.tree.leaves(self._param_shardings)
+        te = self._transfer
         for j in sorted(released):
             lp = released.pop(j)
-            params_flat[j] = jax.device_put(lp, shard_flat[j])
+            params_flat[j] = te.submit_h2d(lp, shard_flat[j]).value
             tier.counters["gathers"] += 1
             tier.counters["offload_bytes_out"] += lp.nbytes
         self.params = jax.tree.unflatten(
@@ -1363,6 +1423,16 @@ class DeepSpeedEngine:
             return {}
         out = dict(tier.counters)
         out["shard_bytes"] = tier.shard_bytes(0)
+        return out
+
+    def transfer_metrics(self):
+        """TransferEngine ledger snapshot (empty when no offload tier)."""
+        te = self._transfer
+        if te is None:
+            return {}
+        led = te.ledger()
+        out = {f"{d}_{k}": v for k, dd in led.items()
+               if isinstance(dd, dict) for d, v in dd.items()}
         return out
 
     # ------------------------------------------------------------------
@@ -1848,6 +1918,10 @@ class DeepSpeedEngine:
                 # train/zero/* counter group (docs/ZERO.md "Observability")
                 events += [(f"Train/ZeRO/{k}", float(v), self.global_samples)
                            for k, v in self.zero_metrics().items()]
+                # transfer-engine bandwidth EMAs + ledger (docs/TRANSFER.md)
+                if self._transfer is not None:
+                    events += self._transfer.monitor_events(
+                        "Train/Transfer", self.global_samples)
                 self.monitor.write_events(events)
 
     # ------------------------------------------------------------------

@@ -116,6 +116,11 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     legacy_stage1: bool = False
     round_robin_gradients: bool = False
 
+    #: unified TransferEngine overlap (docs/TRANSFER.md): True = offload
+    #: gradient D2H rides async tickets settled at the dispatch boundary;
+    #: False = the synchronous bitwise twin (A/B arm for benches/tests)
+    transfer_overlap: bool = True
+
     # ZeRO++ knobs
     zero_hpz_partition_size: int = 1
     zero_quantized_weights: bool = False

@@ -38,6 +38,10 @@ class OffloadedAdamState:
         # the C++ updater writes through raw pointers and must own its memory
         self.master = [np.array(l, np.float32, copy=True) for l in leaves]
         self.step_count = 0
+        #: TransferEngine all gradient D2H rides (docs/TRANSFER.md) — the
+        #: engine wires its own; standalone callers fall back to the
+        #: process-wide default so every byte still hits ONE ledger
+        self.transfer = None
         if device == "nvme":
             from ...ops.aio.py_aio import AsyncIOHandle
 
@@ -57,6 +61,22 @@ class OffloadedAdamState:
             self.v = [np.zeros(l.size, np.float32) for l in self.master]
 
     # ------------------------------------------------------------------
+    def _materialize(self, g) -> np.ndarray:
+        """One leaf's gradient as a flat fp32 host array. Tickets settle
+        through their owning TransferEngine (``drain_before`` — the step's
+        designed sync per leaf); raw device arrays are routed through the
+        tier's engine so every D2H byte is ledger-accounted; host arrays
+        pass straight to the cast."""
+        from ..transfer_engine import TransferTicket, default_engine
+
+        if isinstance(g, TransferTicket):
+            g = g.wait()
+        elif hasattr(g, "copy_to_host_async"):
+            te = self.transfer if self.transfer is not None \
+                else default_engine()
+            g = te.submit_d2h(g).wait()
+        return np.ascontiguousarray(g, dtype=np.float32).reshape(-1)
+
     def _fetch_mv(self, i) -> Tuple[np.ndarray, int]:
         buf = np.empty((2, self.master[i].size), np.float32)
         rid = self._aio.pread(self._paths[i], buf)
@@ -67,12 +87,14 @@ class OffloadedAdamState:
                   on_leaf=None) -> List[np.ndarray]:
         """Update all offloaded leaves in place; returns the master list.
 
-        ``grads`` entries may be device (jax) arrays — each is materialized on
-        host per leaf, so a caller that issued ``copy_to_host_async`` on all
-        of them overlaps the remaining D2H transfers with this loop's compute
-        (twin-flow overlap, reference Offload++ blog). ``on_leaf(i, master_i)``
-        fires right after leaf ``i``'s update — the engine uses it to start
-        that leaf's H2D parameter upload while the next leaf computes.
+        ``grads`` entries may be open :class:`TransferTicket`\\ s (the engine
+        submits every leaf's D2H up front through the TransferEngine) or
+        device (jax) arrays — each materializes on host per leaf via
+        ``_materialize``, so the remaining transfers overlap this loop's
+        compute (twin-flow overlap, reference Offload++ blog).
+        ``on_leaf(i, master_i)`` fires right after leaf ``i``'s update — the
+        engine uses it to start that leaf's H2D parameter upload while the
+        next leaf computes.
 
         NVMe: moments additionally stream through a 2-deep prefetch pipeline —
         leaf i+1's read is in flight while leaf i computes (reference
@@ -82,9 +104,9 @@ class OffloadedAdamState:
         n = len(self.master)
         if self._aio is None:
             for i in range(n):
-                # the step's ONE designed D2H sync per leaf (transfer started
-                # by the caller's copy_to_host_async batch)
-                g = np.asarray(grads[i], np.float32).reshape(-1)  # dstpu-lint: ignore[DSTPU001]
+                # the step's ONE designed D2H settle per leaf, through the
+                # TransferEngine ledger (copy started at submit_d2h time)
+                g = self._materialize(grads[i])
                 p = self.master[i]
                 opt.step_flat(p.reshape(-1), g, self.m[i],
                               self.v[i], self.step_count, lr=lr,
@@ -101,8 +123,8 @@ class OffloadedAdamState:
             if i + 1 < n:
                 pending[i + 1] = self._fetch_mv(i + 1)
             assert self._aio.wait(rid) == 0, f"NVMe read failed for leaf {i}"
-            # same designed per-leaf D2H sync as the host-RAM path above
-            g = np.asarray(grads[i], np.float32).reshape(-1)  # dstpu-lint: ignore[DSTPU001]
+            # same designed per-leaf D2H settle as the host-RAM path above
+            g = self._materialize(grads[i])
             p = self.master[i]
             opt.step_flat(p.reshape(-1), g, buf[0], buf[1],
                           self.step_count, lr=lr, grad_scale=grad_scale,

@@ -44,10 +44,20 @@ from .partition import PartitionPlan
 
 
 class ZeroShardedTier(OffloadedAdamState):
-    """Host-RAM tier holding the sharded fp32 master + Adam moments."""
+    """Host-RAM tier holding the sharded fp32 master + Adam moments.
+
+    With ``nvme_store`` set (a :class:`~..transfer_engine.NVMeStore`), the
+    Adam moments live one tier LOWER — on NVMe under the manifest-last +
+    CRC durability protocol, one keyed ``(2, leaf_size)`` [m; v] record per
+    leaf with a 2-slot ring (docs/TRANSFER.md). ``adam_step`` then streams
+    each leaf's moments disk→RAM→disk around its update; a corrupt newest
+    record falls back one ring slot (the previous step's durable moments —
+    degraded recovery, counted in ``nvme.counters['ring_fallbacks']``)
+    instead of poisoning the update, the same discipline as the checkpoint
+    ring. Host RAM holds only the fp32 master."""
 
     def __init__(self, leaves: List[np.ndarray], plan: PartitionPlan,
-                 stage: int = 2):
+                 stage: int = 2, nvme_store=None):
         super().__init__(leaves, device="cpu")
         self.plan = plan
         self.stage = int(stage)
@@ -60,6 +70,27 @@ class ZeroShardedTier(OffloadedAdamState):
             "offload_bytes_in": 0,    # D2H bytes (gradients)
             "offload_bytes_out": 0,   # H2D bytes (updated params)
         }
+        self.nvme_store = nvme_store
+        if nvme_store is not None:
+            # moments move below host RAM: seed the store with the zero
+            # moments, then free the RAM copies — steady state holds one
+            # leaf's (2, size) buffer at a time
+            for j in range(len(self.master)):
+                nvme_store.save(self._nvme_key(j),
+                                np.stack([self.m[j], self.v[j]]))
+            self.m = self.v = None
+
+    @staticmethod
+    def _nvme_key(j: int) -> str:
+        return f"optshard_{j}"
+
+    def _moments(self, j: int):
+        """Leaf ``j``'s (m, v) views plus the backing [m; v] buffer to save
+        back (None when the moments are RAM-resident)."""
+        if self.nvme_store is None:
+            return self.m[j], self.v[j], None
+        buf = self.nvme_store.load(self._nvme_key(j))
+        return buf[0], buf[1], buf
 
     # ------------------------------------------------------------------
     def adam_step(self, opt, grads: List, lr: float,
@@ -74,13 +105,14 @@ class ZeroShardedTier(OffloadedAdamState):
         bounds = self.plan.bounds
         nranks = self.plan.num_shards
         for j in range(len(self.master)):
-            # the step's ONE designed D2H sync per leaf: materialize the
-            # reduced gradient the per-rank slices below read
-            g = np.asarray(grads[j], np.float32).reshape(-1)  # dstpu-lint: ignore[DSTPU001]
+            # the step's ONE designed D2H settle per leaf: materialize the
+            # reduced gradient the per-rank slices below read (ticket or
+            # device array, through the TransferEngine ledger)
+            g = self._materialize(grads[j])
             self.counters["reduce_scatters"] += 1
             self.counters["offload_bytes_in"] += g.nbytes
             p = self.master[j].reshape(-1)
-            m, v = self.m[j], self.v[j]
+            m, v, buf = self._moments(j)
             bj = bounds[j]
             for r in range(nranks):
                 lo, hi = bj[r], bj[r + 1]
@@ -89,9 +121,39 @@ class ZeroShardedTier(OffloadedAdamState):
                 opt.step_flat(p[lo:hi], g[lo:hi], m[lo:hi], v[lo:hi],
                               self.step_count, lr=lr, grad_scale=grad_scale,
                               clip_coef=clip_coef)
+            if buf is not None:
+                # NVMe moments: updated [m; v] back to disk before the next
+                # leaf's load reuses the RAM (manifest-last + CRC, ring slot)
+                self.nvme_store.save(self._nvme_key(j), buf)
             if on_leaf is not None:
                 on_leaf(j, self.master[j])
         return self.master
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict:
+        """Snapshot (copies) of master + moments, NVMe-aware: disk-resident
+        moments stream up one leaf at a time rather than assuming RAM."""
+        if self.nvme_store is None:
+            return super().state_dict()
+        master = [np.array(p, copy=True) for p in self.master]
+        m_out, v_out = [], []
+        for j in range(len(self.master)):
+            m, v, _ = self._moments(j)
+            m_out.append(np.array(m, copy=True))
+            v_out.append(np.array(v, copy=True))
+        return {"master": master, "m": m_out, "v": v_out,
+                "step": self.step_count}
+
+    def load_state_dict(self, sd: Dict):
+        if self.nvme_store is None:
+            return super().load_state_dict(sd)
+        self.step_count = int(sd["step"])
+        for j, p in enumerate(sd["master"]):
+            self.master[j][...] = p
+        for j in range(len(self.master)):
+            self.nvme_store.save(self._nvme_key(j), np.stack([
+                np.ascontiguousarray(sd["m"][j], dtype=np.float32),
+                np.ascontiguousarray(sd["v"][j], dtype=np.float32)]))
 
     # ------------------------------------------------------------------
     def shard_state_dict(self, rank: int) -> Dict:
@@ -102,8 +164,9 @@ class ZeroShardedTier(OffloadedAdamState):
         offload), so shard files hold only what the module doesn't."""
         out_m, out_v = [], []
         for j, (lo, hi) in enumerate(self.plan.slices(rank)):
-            out_m.append(np.array(self.m[j][lo:hi], copy=True))
-            out_v.append(np.array(self.v[j][lo:hi], copy=True))
+            m, v, _ = self._moments(j)
+            out_m.append(np.array(m[lo:hi], copy=True))
+            out_v.append(np.array(v[lo:hi], copy=True))
         return {"rank": int(rank), "num_shards": self.plan.num_shards,
                 "m": out_m, "v": out_v}
 
@@ -111,11 +174,16 @@ class ZeroShardedTier(OffloadedAdamState):
                           v_full: List[np.ndarray], step: int):
         """Scatter consolidated full-leaf moments back into the tier (the
         per-rank views alias the same buffers, so assigning the full array
-        restores every shard at once)."""
+        restores every shard at once; NVMe-mode leaves write back to disk)."""
         self.step_count = int(step)
-        for j in range(len(self.m)):
-            self.m[j][...] = np.asarray(m_full[j], np.float32).reshape(-1)
-            self.v[j][...] = np.asarray(v_full[j], np.float32).reshape(-1)
+        for j in range(len(self.master)):
+            mf = np.asarray(m_full[j], np.float32).reshape(-1)
+            vf = np.asarray(v_full[j], np.float32).reshape(-1)
+            if self.nvme_store is None:
+                self.m[j][...] = mf
+                self.v[j][...] = vf
+            else:
+                self.nvme_store.save(self._nvme_key(j), np.stack([mf, vf]))
 
     def shard_bytes(self, rank: int = 0) -> int:
         """Optimizer-state bytes rank ``rank`` owns (master + m + v, fp32)."""

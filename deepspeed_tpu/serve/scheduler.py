@@ -782,7 +782,15 @@ class ContinuousBatchScheduler:
         if self._token_est_s == 0.0:
             return False
         if self._swap_s_per_byte == 0.0:
-            return True  # bandwidth probe: the swap_in measures the EMA
+            # before the first measured swap_in, seed from the engine's
+            # TransferEngine H2D bandwidth EMA (docs/TRANSFER.md): ANY
+            # promote/swap traffic already priced the tunnel, so the cost
+            # model starts informed instead of blind-probing
+            te = getattr(self.engine, "transfer", None)
+            seed = te.s_per_byte("h2d") if te is not None else 0.0
+            if seed <= 0.0:
+                return True  # bandwidth probe: the swap_in measures the EMA
+            self._swap_s_per_byte = seed
         swap_s = (2.0 * held * getattr(self.engine, "block_bytes", 0)
                   * self._swap_s_per_byte)
         recompute_s = len(req.replay_tokens()) * self._token_est_s

@@ -18,27 +18,28 @@ every bench/profiling tool in this repo must use them:
 Reference analogue: the AIO swapper's bounded double-buffering
 (``deepspeed/runtime/swap_tensor/pipelined_optimizer_swapper.py``) applies the
 same cap-in-flight principle to NVMe traffic.
+
+Since the unified-TransferEngine refactor (docs/TRANSFER.md), the chunked
+helpers here are thin delegates onto the process-wide
+:class:`~deepspeed_tpu.runtime.transfer_engine.TransferEngine` staging pool —
+there is exactly ONE bounded-in-flight implementation in the repo, and every
+tooling transfer rides the same byte ledger (and bandwidth EMAs) as the KV
+tier, swap preemption, and ZeRO offload traffic. The signal-guard semantics
+below are unchanged.
 """
 
 import signal
 import sys
-from typing import Any, Optional
+from typing import Any
 
 import jax
-import numpy as np
 
 #: hard cap on outstanding host↔device bytes for tooling transfers
-MAX_INFLIGHT_BYTES = 32 * 1024 * 1024
+#: (re-exported from the TransferEngine — the one place the cap lives)
+from ..runtime.transfer_engine import MAX_INFLIGHT_BYTES, default_engine
 
 #: how long the signal guard waits for in-flight device work before exiting
 DRAIN_TIMEOUT_S = 120.0
-
-
-def _leaf_nbytes(leaf) -> int:
-    try:
-        return int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
-    except Exception:
-        return 0
 
 
 def chunked_device_put(tree: Any, sharding=None, *,
@@ -53,68 +54,9 @@ def chunked_device_put(tree: Any, sharding=None, *,
     are blocked on first, and leaves larger than the limit are split along
     axis 0 so no single flight exceeds the cap.  Leaves that are already
     ``jax.Array``s are resharded directly (device-side, not a tunnel
-    transfer) without chunking.
-    """
-    leaves, treedef = jax.tree.flatten(tree)
-    shard_leaves = None
-    if sharding is not None and not isinstance(sharding, jax.sharding.Sharding):
-        shard_leaves = jax.tree.flatten(
-            sharding,
-            is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))[0]
-        if len(shard_leaves) != len(leaves):
-            raise ValueError(
-                f"sharding pytree has {len(shard_leaves)} leaves for a "
-                f"{len(leaves)}-leaf tree")
-    out = []
-    pending: list = []
-    inflight = 0
-
-    def _drain():
-        nonlocal inflight
-        for p in pending:
-            jax.block_until_ready(p)
-        pending.clear()
-        inflight = 0
-
-    for i, leaf in enumerate(leaves):
-        sh = shard_leaves[i] if shard_leaves is not None else sharding
-        if isinstance(leaf, jax.Array):
-            out.append(jax.device_put(leaf, sh))
-            continue
-        nb = _leaf_nbytes(leaf)
-        arr = np.asarray(leaf)
-        # chunk-split only when the leaf lands on ONE device (the tunnel
-        # case): assembling a full unsharded copy on the default device
-        # would defeat a multi-device sharding and OOM the chip that
-        # sharding exists to protect — there, device_put(arr, sh) already
-        # transfers per-device shard slices, each a fraction of the leaf
-        single_dev = sh is None or len(sh.device_set) == 1
-        if single_dev and nb > limit_bytes and arr.ndim >= 1 and arr.shape[0] > 1:
-            # split along axis 0 into <=limit chunks, then reassemble on device
-            rows = max(1, int(arr.shape[0] * limit_bytes / nb))
-            parts = []
-            for s in range(0, arr.shape[0], rows):
-                _drain()
-                # chunks ride unsharded (a chunk's row count need not divide
-                # the mesh axis); the assembled leaf reshards device-side
-                p = jax.device_put(arr[s:s + rows])
-                pending.append(p)
-                inflight += _leaf_nbytes(p)
-                parts.append(p)
-            _drain()
-            import jax.numpy as jnp
-
-            chunked = jnp.concatenate(parts, axis=0)
-            out.append(jax.device_put(chunked, sh) if sh is not None else chunked)
-            continue
-        if inflight + nb > limit_bytes:
-            _drain()
-        p = jax.device_put(arr, sh)
-        pending.append(p)
-        inflight += nb
-        out.append(p)
-    _drain()
-    return jax.tree.unflatten(treedef, out)
+    transfer) without chunking.  Delegates to the TransferEngine staging
+    pool (``TransferEngine.put_tree``)."""
+    return default_engine().put_tree(tree, sharding, limit_bytes=limit_bytes)
 
 
 def chunked_device_get(tree: Any, *,
@@ -123,24 +65,9 @@ def chunked_device_get(tree: Any, *,
 
     Leaves larger than ``limit_bytes`` are fetched in axis-0 slices so no
     single transfer exceeds the cap (a 1 GB embedding table otherwise rides
-    the tunnel as one flight — the exact r4 wedge hazard)."""
-    leaves, treedef = jax.tree.flatten(tree)
-    out = []
-    for leaf in leaves:
-        # block per leaf first: device_get of an unready array queues the
-        # full transfer; readiness keeps the tunnel queue to one chunk
-        jax.block_until_ready(leaf)
-        nb = _leaf_nbytes(leaf)
-        shape = getattr(leaf, "shape", ())
-        if nb > limit_bytes and len(shape) >= 1 and shape[0] > 1:
-            rows = max(1, int(shape[0] * limit_bytes / nb))
-            parts = []
-            for s in range(0, shape[0], rows):
-                parts.append(np.asarray(jax.device_get(leaf[s:s + rows])))
-            out.append(np.concatenate(parts, axis=0))
-        else:
-            out.append(np.asarray(jax.device_get(leaf)))
-    return jax.tree.unflatten(treedef, out)
+    the tunnel as one flight — the exact r4 wedge hazard).  Delegates to the
+    TransferEngine (``TransferEngine.get_tree``)."""
+    return default_engine().get_tree(tree, limit_bytes=limit_bytes)
 
 
 _guard_installed = False

@@ -152,6 +152,7 @@ _SANITIZE_FILES = (
     "test_journal_durability.py",
     "test_kv_tier.py",
     "test_zero_sharded.py",
+    "test_transfer_engine.py",
 )
 
 

@@ -214,7 +214,7 @@ class TestTierConservationSanitizer:
         mgr, _ = self._tiered_mgr()
         hid = next(iter(mgr._host))
         del mgr._host[hid]  # index still names it: lookup would promote junk
-        with pytest.raises(SanitizerError, match="no host-tier residence"):
+        with pytest.raises(SanitizerError, match="no tier residence"):
             check_tier_conservation(_stub_engine(mgr))
 
     def test_device_pool_leak_is_caught(self):

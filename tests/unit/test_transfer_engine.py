@@ -1,0 +1,567 @@
+"""Unified TransferEngine tests (docs/TRANSFER.md): the ticket/ledger
+contract (delayed D2H sync, H2D settles at submit, cap-in-flight FIFO
+drain, cancel accounting), overlap-off as the bitwise synchronous twin,
+the staging pool's no-reissue discipline, ``check_transfer_ledger``
+planted violations (conservation break, open-table divergence, undrained
+``.value`` read, staging reissue), the NVMe store's manifest-last + CRC
+ring (corrupt newest → one-slot fallback, torn write → silent skip, all
+slots corrupt → hard error), the KV allocator's third-tier bookkeeping
+(host-LRU spill, NVMe promote, corrupt-load chain truncation, flush),
+the serving engine's NVMe spill/promote path bitwise vs an untiered twin
+— surviving a planted corrupt block file via recompute — and the ZeRO
+moments-on-NVMe tier bitwise vs its RAM twin with ring-slot fallback.
+
+Runs under ``DSTPU_SANITIZE`` (conftest ``_SANITIZE_FILES``): violation
+recording in the engine is live, so the planted-violation tests exercise
+the exact wiring production checked mode uses."""
+
+import glob
+import os
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_tier_conservation,
+                                              check_transfer_ledger)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.ragged_manager import (_ROOT, BlockedKVCache,
+                                                       SequenceDescriptor)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.runtime.transfer_engine import (STAGING_POOL_DEPTH,
+                                                   NVMeStore,
+                                                   TransferCorruptError,
+                                                   TransferEngine)
+from deepspeed_tpu.runtime.zero.partition import PartitionPlan
+from deepspeed_tpu.runtime.zero.sharded import ZeroShardedTier
+
+
+def _dev(n=256, seed=0):
+    """A device-resident float32 array (has ``copy_to_host_async``)."""
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(n).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# ticket / ledger contract
+# ---------------------------------------------------------------------------
+
+class TestTicketLedger:
+    def test_d2h_open_ticket_settles_at_drain(self):
+        """submit_d2h returns an OPEN ticket (bytes in flight, ledger
+        charged); drain_before materializes it, passes non-tickets through
+        unchanged, and conservation holds at the boundary."""
+        eng = TransferEngine(overlap=True)
+        arr = _dev(seed=1)
+        t = eng.submit_d2h(arr)
+        assert t.open and t.direction == "d2h" and t.nbytes == arr.size * 4
+        assert eng.ledger()["inflight"]["d2h"] == t.nbytes
+        out = eng.drain_before([t, "host-passthrough"])
+        np.testing.assert_array_equal(out[0], np.asarray(arr))
+        assert out[1] == "host-passthrough"
+        assert not t.open
+        led = eng.ledger()
+        assert led["submitted"]["d2h"] == led["completed"]["d2h"] == t.nbytes
+        assert led["inflight"]["d2h"] == 0
+        check_transfer_ledger(eng)
+
+    def test_overlap_off_is_bitwise_synchronous_twin(self):
+        """The A/B arm: overlap=False settles at submit; the payload is
+        bitwise identical to the overlapped engine's drained payload."""
+        arr = _dev(seed=2)
+        on, off = TransferEngine(overlap=True), TransferEngine(overlap=False)
+        t_on, t_off = on.submit_d2h(arr), off.submit_d2h(arr)
+        assert t_on.open and not t_off.open
+        np.testing.assert_array_equal(t_on.wait(), t_off.value)
+        for e in (on, off):
+            led = e.ledger()
+            assert led["submitted"]["d2h"] == led["completed"]["d2h"]
+            check_transfer_ledger(e)
+
+    def test_h2d_settles_at_submit_and_roundtrips(self):
+        """H2D needs no delayed sync (device_put snapshots host memory):
+        the ticket is closed on return and the source is safe to reuse."""
+        eng = TransferEngine()
+        host = np.arange(96, dtype=np.float32)
+        t = eng.submit_h2d(host)
+        assert not t.open
+        host += 1.0  # source reuse after submit must not corrupt the payload
+        np.testing.assert_array_equal(np.asarray(t.value),
+                                      np.arange(96, dtype=np.float32))
+        led = eng.ledger()
+        assert led["submitted"]["h2d"] == led["completed"]["h2d"] == 96 * 4
+        assert eng.s_per_byte("h2d") > 0
+        check_transfer_ledger(eng)
+
+    def test_cap_in_flight_drains_oldest_first(self):
+        """Outstanding D2H bytes never exceed the cap: the oldest tickets
+        are force-settled FIFO to admit new submissions, and every payload
+        is still correct."""
+        eng = TransferEngine(overlap=True, limit_bytes=4096)
+        arrs = [jnp.full((256,), float(i), jnp.float32) for i in range(8)]
+        ts = [eng.submit_d2h(a) for a in arrs]  # 1 KiB each, cap = 4
+        assert eng.ledger()["inflight"]["d2h"] <= 4096
+        assert not ts[0].open  # the oldest was settled to make room
+        for i, v in enumerate(eng.drain_before(ts)):
+            np.testing.assert_array_equal(v, np.asarray(arrs[i]))
+        assert eng.ledger()["inflight"]["d2h"] == 0
+        check_transfer_ledger(eng)
+
+    def test_cancel_accounting(self):
+        """cancel moves an open ticket's bytes to the cancelled bucket
+        (conservation includes it); double-cancel is a no-op; cancel_all
+        quiesces the open table."""
+        eng = TransferEngine(overlap=True)
+        t1, t2 = eng.submit_d2h(_dev(seed=3)), eng.submit_d2h(_dev(seed=4))
+        t1.cancel()
+        assert not t1.open and t1.value is None  # closed: no payload left
+        led = eng.ledger()
+        assert led["cancelled"]["d2h"] == t1.nbytes
+        assert led["inflight"]["d2h"] == t2.nbytes
+        check_transfer_ledger(eng)
+        t1.cancel()
+        assert eng.ledger()["cancelled"]["d2h"] == t1.nbytes
+        eng.cancel_all()
+        assert not eng._open and eng.ledger()["inflight"]["d2h"] == 0
+        check_transfer_ledger(eng)
+
+    def test_bandwidth_ema_and_monitor_gauges(self, tmp_path):
+        """Measured traffic seeds both direction EMAs (the scheduler's cost
+        model reads these) and the gauge surface carries the documented
+        labels — nvme_* only when the tier is configured."""
+        eng = TransferEngine(overlap=True)
+        eng.drain_before([eng.submit_d2h(_dev(seed=5))])
+        eng.submit_h2d(np.ones(64, np.float32))
+        assert eng.s_per_byte("d2h") > 0 and eng.s_per_byte("h2d") > 0
+        labels = {l for l, _, _ in eng.monitor_events("serve/transfer", 5)}
+        assert "serve/transfer/d2h_bytes_per_s" in labels
+        assert "serve/transfer/h2d_completed_bytes" in labels
+        assert not any("nvme" in l for l in labels)
+        nv = TransferEngine(nvme_dir=str(tmp_path))
+        labels = {l for l, _, _ in nv.monitor_events("p")}
+        assert "p/nvme_saves" in labels and "p/nvme_ring_fallbacks" in labels
+
+    def test_staging_pool_reuses_released_buffers(self):
+        eng = TransferEngine()
+        b1 = eng.acquire_staging((4, 4), np.float32)
+        eng.release_staging(b1)
+        b2 = eng.acquire_staging((4, 4), np.float32)
+        assert b2 is b1  # pooled, not reallocated
+        b3 = eng.acquire_staging((4, 4), np.float32)  # the double buffer
+        assert b3 is not b2 and eng.staging_buffers() == 2
+
+    def test_put_get_tree_chunked_bitwise(self):
+        """The chunked pytree path (utils/transfer.py contract) round-trips
+        bitwise through both engines, with the 2 KiB leaf split under a
+        512 B in-flight cap, and both ledgers settle."""
+        rng = np.random.default_rng(6)
+        tree = {"w": rng.standard_normal((64, 8)).astype(np.float32),
+                "b": np.arange(7, dtype=np.int32)}
+        for overlap in (True, False):
+            eng = TransferEngine(overlap=overlap, limit_bytes=512)
+            back = eng.get_tree(eng.put_tree(tree))
+            jax.tree.map(np.testing.assert_array_equal, back, tree)
+            led = eng.ledger()
+            assert led["inflight"] == {"d2h": 0, "h2d": 0}
+            assert led["submitted"]["h2d"] > 0 and led["submitted"]["d2h"] > 0
+            check_transfer_ledger(eng)
+
+
+# ---------------------------------------------------------------------------
+# check_transfer_ledger: planted violations (sanitize armed by conftest)
+# ---------------------------------------------------------------------------
+
+class TestPlantedLedgerViolations:
+    def test_ledger_checker_is_duck_typed(self):
+        check_transfer_ledger(None)                  # no engine at all
+        check_transfer_ledger(SimpleNamespace())     # no ledger surface
+
+    def test_conservation_break_is_caught(self):
+        eng = TransferEngine()
+        eng.drain_before([eng.submit_d2h(_dev(seed=7))])
+        check_transfer_ledger(eng)  # clean first
+        eng.completed_bytes["d2h"] += 128  # a double-counted settle
+        with pytest.raises(SanitizerError, match="not conserved"):
+            check_transfer_ledger(eng)
+
+    def test_inflight_table_divergence_is_caught(self):
+        """The ledger's in-flight byte count and the open-ticket table are
+        two views of the same state; a planted skew trips the checker."""
+        eng = TransferEngine(overlap=True)
+        t = eng.submit_d2h(_dev(seed=8))
+        eng.inflight_bytes["d2h"] += 64
+        with pytest.raises(SanitizerError, match="disagrees"):
+            check_transfer_ledger(eng)
+        eng.inflight_bytes["d2h"] -= 64
+        eng.drain_before([t])
+        check_transfer_ledger(eng)
+
+    def test_closed_ticket_tracked_open_is_caught(self):
+        eng = TransferEngine(overlap=True)
+        t = eng.submit_d2h(_dev(seed=9))
+        t.open = False  # closed behind the engine's back, still tracked
+        with pytest.raises(SanitizerError, match="closed but still tracked"):
+            check_transfer_ledger(eng)
+
+    def test_undrained_value_read_is_recorded(self):
+        """Reading ``.value`` on an open ticket is the dependent-read
+        hazard: the payload still materializes (loud in the checker, not
+        silent corruption) and the next boundary check reports it once."""
+        eng = TransferEngine(overlap=True)
+        arr = _dev(seed=10)
+        t = eng.submit_d2h(arr)
+        np.testing.assert_array_equal(t.value, np.asarray(arr))
+        assert not t.open  # the read settled the ticket
+        with pytest.raises(SanitizerError, match="without drain_before"):
+            check_transfer_ledger(eng)
+        check_transfer_ledger(eng)  # violations drain exactly once
+
+    def test_staging_reissue_while_open_is_recorded(self):
+        eng = TransferEngine()
+        for _ in range(STAGING_POOL_DEPTH):
+            eng.acquire_staging((8,), np.float32)
+        eng.acquire_staging((8,), np.float32)  # every buffer checked out
+        with pytest.raises(SanitizerError, match="re-requested"):
+            check_transfer_ledger(eng)
+
+
+# ---------------------------------------------------------------------------
+# NVMe store: manifest-last + CRC ring
+# ---------------------------------------------------------------------------
+
+class TestNVMeStore:
+    def test_roundtrip_and_generation_ring(self, tmp_path):
+        store = NVMeStore(str(tmp_path), ring_slots=2)
+        a0 = np.arange(24, dtype=np.float32).reshape(4, 6)
+        store.save("k", a0)
+        np.testing.assert_array_equal(store.load("k"), a0)
+        a1, a2 = a0 + 1.0, a0 + 2.0
+        store.save("k", a1)
+        store.save("k", a2)  # gen2 cycles back onto slot 0
+        np.testing.assert_array_equal(store.load("k"), a2)
+        assert store.counters["saves"] == 3
+        assert store.counters["ring_fallbacks"] == 0
+        assert store.counters["bytes_written"] == 3 * a0.nbytes
+
+    def test_corrupt_newest_falls_back_one_slot(self, tmp_path):
+        """A corrupt newest record (CRC mismatch) reads as the previous
+        complete generation — degraded, never wrong."""
+        store = NVMeStore(str(tmp_path), ring_slots=2)
+        a0, a1 = np.arange(16, dtype=np.float32), np.full(16, 9.0, np.float32)
+        store.save("k", a0)  # gen0 -> slot 0
+        store.save("k", a1)  # gen1 -> slot 1 (newest)
+        bad = os.path.join(str(tmp_path), "k.1.bin")
+        with open(bad, "wb") as f:
+            f.write(b"\xff" * os.path.getsize(bad))
+        np.testing.assert_array_equal(store.load("k"), a0)
+        assert store.counters["ring_fallbacks"] == 1
+        assert store.counters["corrupt_reads"] == 1
+
+    def test_missing_manifest_is_a_torn_write(self, tmp_path):
+        """No manifest = the write never committed: the slot is skipped
+        without even counting as corruption (manifest-last by design)."""
+        store = NVMeStore(str(tmp_path), ring_slots=2)
+        a0, a1 = np.arange(8, dtype=np.float32), np.ones(8, np.float32)
+        store.save("k", a0)
+        store.save("k", a1)
+        os.remove(os.path.join(str(tmp_path), "k.1.json"))
+        np.testing.assert_array_equal(store.load("k"), a0)
+        assert store.counters["ring_fallbacks"] == 0
+        assert store.counters["corrupt_reads"] == 0
+
+    def test_all_slots_corrupt_raises(self, tmp_path):
+        store = NVMeStore(str(tmp_path), ring_slots=2)
+        store.save("k", np.arange(8, dtype=np.float32))
+        store.save("k", np.ones(8, np.float32))
+        for slot in (0, 1):
+            p = os.path.join(str(tmp_path), f"k.{slot}.bin")
+            with open(p, "wb") as f:
+                f.write(b"\xff" * os.path.getsize(p))
+        with pytest.raises(TransferCorruptError, match="no complete slot"):
+            store.load("k")
+        assert store.counters["corrupt_reads"] == 2
+
+    def test_delete_and_has(self, tmp_path):
+        store = NVMeStore(str(tmp_path), ring_slots=2)
+        assert not store.has("k")
+        store.save("k", np.zeros(4, np.float32))
+        assert store.has("k")
+        store.delete("k")
+        assert not store.has("k")
+        with pytest.raises(TransferCorruptError):
+            store.load("k")
+
+
+# ---------------------------------------------------------------------------
+# KV allocator: NVMe third-tier bookkeeping (host-side, stub disk)
+# ---------------------------------------------------------------------------
+
+class TestKVNVMeTierAllocator:
+    def _mgr(self, num_blocks=9, host=1, nvme=8):
+        mgr = BlockedKVCache(num_blocks, block_size=4, max_blocks_per_seq=8,
+                             prefix_cache=True, host_tier_blocks=host,
+                             nvme_blocks=nvme)
+        disk = {}
+        mgr.demote_fn = lambda b: f"payload{b}"
+        mgr.spill_fn = lambda hid, payload: (disk.__setitem__(hid, payload)
+                                             or True)
+        mgr.load_fn = disk.get
+        mgr.drop_fn = lambda hid: disk.pop(hid, None)
+        return mgr, disk
+
+    def _prefill(self, mgr, desc, tokens):
+        skipped = mgr.lookup(desc, tokens)
+        desc.history.extend(tokens[:skipped])
+        mgr.ensure(desc, len(tokens))
+        desc.history.extend(tokens[skipped:])
+        desc.seen_tokens = len(tokens)
+        mgr.register(desc)
+
+    def _spilled(self, mgr):
+        """Chain of 2 demoted through a 1-block host tier: the oldest
+        (leaf) spills to NVMe, the root stays host-resident."""
+        a = SequenceDescriptor(uid=1, slot=0)
+        self._prefill(mgr, a, [1, 1, 1, 1, 2, 2, 2, 2])
+        mgr.free(a)
+        b = SequenceDescriptor(uid=2, slot=1)
+        mgr.ensure(b, 8 * 4)  # both chain blocks leave the device
+        return b
+
+    def test_host_overflow_spills_oldest_to_nvme(self):
+        mgr, disk = self._mgr()
+        b = self._spilled(mgr)
+        assert mgr.stats["demoted_blocks"] == 2
+        assert mgr.stats["nvme_spilled_blocks"] == 1
+        assert mgr.stats["host_evicted_blocks"] == 0  # nothing destroyed
+        assert mgr.host_blocks == 1 and mgr.nvme_resident_blocks == 1
+        assert len(disk) == 1
+        assert all(h < _ROOT for h in mgr._nvme)  # same demoted namespace
+        mgr.check_invariants([b])
+        check_tier_conservation(SimpleNamespace(
+            block_mgr=mgr, state=SimpleNamespace(seqs={}), _swaps={}))
+
+    def test_promote_from_nvme_loads_and_drops_disk_copy(self):
+        mgr, disk = self._mgr()
+        b = self._spilled(mgr)
+        mgr.free(b)
+        assert mgr.probe([1, 1, 1, 1, 2, 2, 2, 2]) == 2  # probe sees tier 3
+        probe = SequenceDescriptor(uid=3, slot=2)
+        assert mgr.lookup(probe, [1, 1, 1, 1, 2, 2, 2, 2, 9]) == 8
+        assert mgr.stats["promoted_blocks"] == 2
+        assert mgr.stats["nvme_loaded_blocks"] == 1
+        assert mgr.nvme_resident_blocks == 0 and not disk  # disk copy stale
+        orders = mgr.take_promotions()
+        assert len(orders) == 2
+        assert all(p is not None for p, _ in orders)  # payloads rode along
+        mgr.check_invariants([probe])
+
+    def test_corrupt_nvme_load_truncates_chain(self):
+        """A failed verification (load_fn -> None) drops the block's NVMe
+        subtree and truncates the hit at the corrupt block — the tokens
+        recompute, nothing promotes junk."""
+        mgr, disk = self._mgr()
+        b = self._spilled(mgr)
+        mgr.free(b)
+        disk.clear()  # the disk copy is gone/corrupt
+        probe = SequenceDescriptor(uid=3, slot=2)
+        assert mgr.lookup(probe, [1, 1, 1, 1, 2, 2, 2, 2, 9]) == 4
+        assert mgr.stats["nvme_corrupt_blocks"] == 1
+        assert mgr.stats["promoted_blocks"] == 1  # the host-tier root only
+        assert mgr.nvme_resident_blocks == 0
+        assert mgr.probe([1, 1, 1, 1, 2, 2, 2, 2]) == 1  # chain ends at root
+        mgr.check_invariants([probe])
+
+    def test_nvme_capacity_bounds_by_destroying_oldest_leaf(self):
+        """A full NVMe tier destroys its oldest childless block — the
+        bottom of the hierarchy is where content finally dies."""
+        mgr, disk = self._mgr(nvme=1)
+        a = SequenceDescriptor(uid=1, slot=0)
+        self._prefill(mgr, a, [1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3])
+        mgr.free(a)
+        b = SequenceDescriptor(uid=2, slot=1)
+        mgr.ensure(b, 8 * 4)  # 3 demotions through a 1+1 block tier stack
+        assert mgr.stats["demoted_blocks"] == 3
+        assert mgr.stats["nvme_spilled_blocks"] == 2
+        assert mgr.stats["nvme_evicted_blocks"] == 1
+        assert mgr.nvme_resident_blocks == 1 and len(disk) == 1
+        mgr.check_invariants([b])
+
+    def test_flush_destroys_all_three_tiers(self):
+        mgr, disk = self._mgr()
+        b = self._spilled(mgr)
+        mgr.free(b)
+        mgr.flush_cache()
+        assert mgr.host_blocks == 0 and mgr.nvme_resident_blocks == 0
+        assert not disk  # drop_fn ran: nothing can resurface by load
+        assert mgr.probe([1, 1, 1, 1, 2, 2, 2, 2]) == 0
+        mgr.check_invariants([])
+
+
+# ---------------------------------------------------------------------------
+# serving engine: NVMe tier end to end, bitwise + corrupt-file survival
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _tier_workload():
+    rng = np.random.default_rng(21)
+    a = rng.integers(0, 128, 32).tolist()      # 2 full blocks
+    big = rng.integers(0, 128, 128).tolist()   # the whole 8-block pool
+    tail = rng.integers(0, 128, 8).tolist()
+    return a, big, tail
+
+
+class TestServingNVMeTier:
+    def _spill_prefix(self, m, params, tmp_path, overlap):
+        a, big, tail = _tier_workload()
+        eng = _engine(m, params, num_blocks=9, host_tier_blocks=1,
+                      transfer_overlap=overlap, nvme_tier_blocks=16,
+                      nvme_tier_dir=str(tmp_path))
+        eng.put([1], [a], greedy=True)
+        eng.flush(1)
+        eng.put([2], [big], greedy=True)  # demotes a's chain through host
+        eng.flush(2)
+        s = eng.prefix_cache_stats()
+        assert s["nvme_spilled_blocks"] >= 1 and s["nvme_blocks"] >= 1
+        assert glob.glob(os.path.join(str(tmp_path), "kvblock_*.bin"))
+        return eng, a, tail
+
+    @pytest.mark.parametrize("overlap", [True, False],
+                             ids=["overlap-on", "overlap-off"])
+    def test_nvme_spill_promote_bitwise(self, setup, tmp_path, overlap):
+        """A prefix spilled device -> host -> NVMe by pool pressure and
+        promoted back by a content-index hit serves BITWISE-identical
+        logits to a cold untiered engine, in both overlap arms — the
+        payload really round-trips through the disk ring."""
+        m, params = setup
+        eng, a, tail = self._spill_prefix(m, params, tmp_path, overlap)
+        cold = _engine(m, params, num_blocks=9, host_tier_blocks=0)
+        w, c = eng.put([3], [a + tail]), cold.put([3], [a + tail])
+        s = eng.prefix_cache_stats()
+        assert s["nvme_loaded_blocks"] >= 1
+        assert s["skipped_prefill_tokens"] >= 32  # the hit was real
+        np.testing.assert_array_equal(np.asarray(w[3]), np.asarray(c[3]))
+        eng.block_mgr.check_invariants(eng.state.seqs.values())
+        check_tier_conservation(eng)
+        check_transfer_ledger(eng.transfer)
+
+    def test_corrupt_nvme_block_degrades_to_recompute(self, setup, tmp_path):
+        """The acceptance case: every on-disk KV block corrupted in place.
+        The CRC rejects the payload, the allocator truncates the hit chain
+        and the tokens recompute — output still bitwise, never wrong KV."""
+        m, params = setup
+        eng, a, tail = self._spill_prefix(m, params, tmp_path, True)
+        for p in glob.glob(os.path.join(str(tmp_path), "kvblock_*.bin")):
+            with open(p, "wb") as f:
+                f.write(b"\xff" * os.path.getsize(p))
+        cold = _engine(m, params, num_blocks=9, host_tier_blocks=0)
+        w, c = eng.put([3], [a + tail]), cold.put([3], [a + tail])
+        s = eng.prefix_cache_stats()
+        assert s["nvme_corrupt_blocks"] >= 1
+        assert eng.transfer.nvme.counters["corrupt_reads"] >= 1
+        np.testing.assert_array_equal(np.asarray(w[3]), np.asarray(c[3]))
+        eng.block_mgr.check_invariants(eng.state.seqs.values())
+        check_tier_conservation(eng)
+        check_transfer_ledger(eng.transfer)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO moments-on-NVMe tier
+# ---------------------------------------------------------------------------
+
+class TestZeroNVMeMoments:
+    LR = 1e-3
+
+    def _tier(self, tmp=None):
+        rng = np.random.default_rng(3)
+        leaves = [rng.standard_normal(37).astype(np.float32),
+                  rng.standard_normal((5, 4)).astype(np.float32)]
+        store = NVMeStore(str(tmp), 2) if tmp is not None else None
+        return ZeroShardedTier(leaves, PartitionPlan(leaves, 4), stage=2,
+                               nvme_store=store), leaves
+
+    def _grads(self, leaves, k):
+        rng = np.random.default_rng(100 + k)
+        return [rng.standard_normal(l.size).astype(np.float32)
+                for l in leaves]
+
+    def test_moments_on_nvme_bitwise_vs_ram_twin(self, tmp_path):
+        """Streaming the Adam moments disk -> RAM -> disk around each
+        leaf's update changes residency only: masters stay bitwise equal
+        to the RAM-resident twin's, and host RAM really holds nothing."""
+        opt = DeepSpeedCPUAdam(lr=self.LR, weight_decay=0.01)
+        ram, leaves = self._tier()
+        nvme, _ = self._tier(tmp=tmp_path)
+        assert nvme.m is None and nvme.v is None
+        for k in range(3):
+            g = self._grads(leaves, k)
+            ram.adam_step(opt, [x.copy() for x in g], lr=self.LR)
+            nvme.adam_step(opt, [x.copy() for x in g], lr=self.LR)
+        for p_ram, p_nvme in zip(ram.master, nvme.master):
+            np.testing.assert_array_equal(p_ram, p_nvme)
+        c = nvme.nvme_store.counters
+        assert c["saves"] >= 2 + 3 * 2  # init seed + one per leaf per step
+        assert c["loads"] >= 3 * 2 and c["ring_fallbacks"] == 0
+
+    def test_state_dict_roundtrips_through_disk(self, tmp_path):
+        opt = DeepSpeedCPUAdam(lr=self.LR)
+        src, leaves = self._tier(tmp=tmp_path / "src")
+        src.adam_step(opt, self._grads(leaves, 0), lr=self.LR)
+        sd = src.state_dict()
+        dst, _ = self._tier(tmp=tmp_path / "dst")
+        dst.load_state_dict(sd)
+        src.adam_step(opt, self._grads(leaves, 1), lr=self.LR)
+        dst.adam_step(opt, self._grads(leaves, 1), lr=self.LR)
+        for a, b in zip(src.master, dst.master):
+            np.testing.assert_array_equal(a, b)
+
+    def test_corrupt_newest_moments_use_previous_ring_slot(self, tmp_path):
+        """Designed degraded recovery: a corrupt newest [m; v] record falls
+        back to the PREVIOUS step's durable moments instead of poisoning
+        the update — counted, finite, and the step still applies."""
+        opt = DeepSpeedCPUAdam(lr=self.LR)
+        nvme, leaves = self._tier(tmp=tmp_path)
+        nvme.adam_step(opt, self._grads(leaves, 0), lr=self.LR)
+        nvme.adam_step(opt, self._grads(leaves, 1), lr=self.LR)
+        # seed->slot0(gen0), step1->slot1(gen1), step2->slot0(gen2): the
+        # newest record for leaf 0 sits on slot 0 — corrupt it in place
+        bad = os.path.join(str(tmp_path), "optshard_0.0.bin")
+        with open(bad, "wb") as f:
+            f.write(b"\xff" * os.path.getsize(bad))
+        before = [p.copy() for p in nvme.master]
+        nvme.adam_step(opt, self._grads(leaves, 2), lr=self.LR)
+        assert nvme.nvme_store.counters["ring_fallbacks"] == 1
+        assert all(np.isfinite(p).all() for p in nvme.master)
+        assert not np.array_equal(before[0], nvme.master[0])
+
+    def test_no_ring_slot_verifies_fails_loudly(self, tmp_path):
+        opt = DeepSpeedCPUAdam(lr=self.LR)
+        nvme, leaves = self._tier(tmp=tmp_path)
+        nvme.adam_step(opt, self._grads(leaves, 0), lr=self.LR)
+        for slot in (0, 1):
+            p = os.path.join(str(tmp_path), f"optshard_0.{slot}.bin")
+            if os.path.exists(p):
+                with open(p, "wb") as f:
+                    f.write(b"\xff" * os.path.getsize(p))
+        with pytest.raises(TransferCorruptError):
+            nvme.adam_step(opt, self._grads(leaves, 1), lr=self.LR)
