@@ -1354,6 +1354,170 @@ def run_pool_health(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_disagg(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The disaggregated-serving acceptance A/B (docs/SERVING.md
+    "Disaggregated serving"): a bimodal workload — steady decode-heavy
+    streams already in flight when a burst of long-prompt requests
+    arrives — served at equal chip count by a 1P+2D :class:`DisaggPool`
+    (one prefill worker, two decode workers, KV-transfer handoff) vs a
+    3-replica mixed :class:`EnginePool`.
+
+    The mechanism under test: in the mixed arm the burst queues behind
+    seats held by steady decodes for their whole ``gen`` (a seat frees
+    every ~gen steps), and every replica interleaves prefill chunks with
+    decode dispatches. In the disagg arm the prefill worker's seats
+    recycle at prefill speed — each long prompt prefills undisturbed,
+    emits its first token, and leaves by KV handoff — so burst TTFT p99
+    is bounded by prefill time, not by the steady streams' decode time.
+    Acceptance gates: both arms complete every request bitwise identical
+    to the fault-free single-engine reference, the disagg arm moves every
+    long-prompt request by at least one KV handoff (no replay
+    degradation), and its TTFT p99 beats the mixed arm's."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.resilience import RetryPolicy
+    from deepspeed_tpu.serve import (ContinuousBatchScheduler, DisaggPool,
+                                     EnginePool, RequestState)
+
+    cfg = gpt2_config("125m", max_seq_len=128, hidden_size=128,
+                      num_layers=2, num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    N_STEADY, STEADY_GEN = 8, 24     # decode-heavy: short prompt, long gen
+    N_BURST, BURST_GEN = 8, 8        # prefill-heavy: long prompt, short gen
+
+    rng = np.random.default_rng(37)
+    steady = [(9000 + i, rng.integers(
+        0, 1024, int(rng.integers(16, 25))).tolist())
+        for i in range(N_STEADY)]
+    burst = [(9100 + i, rng.integers(
+        0, 1024, int(rng.integers(80, 97))).tolist())
+        for i in range(N_BURST)]
+
+    def make_engine():
+        return InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=128,
+            prefill_chunk=16, dtype=jnp.bfloat16, paged=True,
+            block_size=16, token_budget=32, num_blocks=1 + max_seqs * 12,
+            prefix_cache=prefix_cache)
+
+    def _gen_of(uid):
+        return STEADY_GEN if uid < 9100 else BURST_GEN
+
+    # fault-free single-engine reference — the bitwise oracle for BOTH
+    # arms (counter-based keys make placement and handoff invisible)
+    ref_sched = ContinuousBatchScheduler(
+        make_engine(), max_queue=N_STEADY + N_BURST,
+        retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+    refs = [ref_sched.submit(p, max_new_tokens=_gen_of(u), uid=u)
+            for u, p in steady + burst]
+    ref_sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in refs)
+    ref_tokens = {r.uid: list(r.tokens) for r in refs}
+    ref_sched.close()
+    gc.collect()
+
+    def arm(disagg: bool) -> dict:
+        engines = {}
+
+        def factory(i):
+            engines[i] = make_engine()
+            return engines[i]
+
+        kw = dict(max_queue=N_STEADY + N_BURST,
+                  retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        if disagg:
+            pool = DisaggPool.build(factory, 3,
+                                    roles=["prefill", "decode", "decode"],
+                                    **kw)
+        else:
+            pool = EnginePool.build(factory, 3, **kw)
+        # warm the compiled programs off the clock, then drop the warmup
+        # KV and latency samples so the measured arm starts clean
+        for rep in pool.replicas:
+            w = rep.scheduler.submit(list(range(20)), max_new_tokens=2,
+                                     uid=8900 + rep.replica_id)
+            while not w.finished:
+                rep.scheduler.step()
+            rep.engine.block_mgr.flush_cache()
+            for k in rep.engine.block_mgr.stats:
+                rep.engine.block_mgr.stats[k] = 0
+            rep.scheduler.metrics.ttft_s.clear()
+
+        t0 = time.perf_counter()
+        reqs = [pool.submit(p, max_new_tokens=STEADY_GEN, uid=u)
+                for u, p in steady]
+        # let the steady streams reach steady-state decode (every seat
+        # they will hold is held) BEFORE the long-prompt burst arrives
+        while any(not r.tokens for r in reqs):
+            pool.step()
+        reqs += [pool.submit(p, max_new_tokens=BURST_GEN, uid=u)
+                 for u, p in burst]
+        pool.run_until_complete()
+        wall = time.perf_counter() - t0
+
+        assert all(r.state is RequestState.DONE for r in reqs)
+        bitwise = all(list(r.tokens) == ref_tokens[r.uid] for r in reqs)
+        assert bitwise, "tokens diverged from single-engine reference"
+        ttft = sorted(t for rep in pool.replicas
+                      for t in rep.scheduler.metrics.ttft_s)
+        pm = pool.metrics.pool
+        out = {
+            "arm": "disagg_1p2d" if disagg else "mixed_3x",
+            "goodput_tokens_per_s": round(
+                sum(len(r.tokens) for r in reqs) / wall, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 1),
+            "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 1),
+            "handoffs": int(pm["handoffs"]),
+            "handoffs_kv": int(pm["handoffs_kv"]),
+            "handoff_bytes": int(pm["handoff_bytes"]),
+            "handoff_deferrals": int(pm["handoff_deferrals"]),
+            "handoff_p95_ms": round(pm["handoff_p95_s"] * 1e3, 2),
+            "all_requests_completed": True,
+            "tokens_bitwise_identical": bitwise,
+        }
+        pool.close()
+        del pool, engines
+        gc.collect()
+        return out
+
+    dis = arm(disagg=True)
+    mix = arm(disagg=False)
+    # acceptance gates: every long-prompt request left the prefill worker
+    # by KV transfer, and role specialization bought tail TTFT
+    assert dis["handoffs_kv"] >= N_BURST, dis
+    assert mix["handoffs"] == 0, mix
+    assert dis["ttft_p99_ms"] < mix["ttft_p99_ms"], (dis, mix)
+    return {
+        "metric": _metric_name("paged", max_seqs, "disagg", prefix_cache),
+        "value": dis["goodput_tokens_per_s"], "unit": "tokens/s",
+        "vs_baseline": round(
+            dis["goodput_tokens_per_s"] / mix["goodput_tokens_per_s"], 3)
+        if mix["goodput_tokens_per_s"] else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-pool-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': "
+                      "1024} ctx=128 (control-plane-bound disagg A/B)"),
+            "workload": (f"{N_STEADY} steady streams (prompt U[16,24], "
+                         f"gen {STEADY_GEN}) in flight, then a burst of "
+                         f"{N_BURST} long prompts (U[80,96], gen "
+                         f"{BURST_GEN}); 3 replicas x {max_seqs} seats: "
+                         "1 prefill + 2 decode vs 3 mixed"),
+            "disagg_1p2d": dis, "mixed_3x": mix,
+            "ttft_p99_improvement": round(
+                mix["ttft_p99_ms"] / dis["ttft_p99_ms"], 2)
+            if dis["ttft_p99_ms"] else None,
+            "tokens_bitwise_identical": True,
+        },
+    }
+
+
 def run_kv_tier(max_seqs: int, prefix_cache: bool = True) -> dict:
     """KV-cache tiering acceptance A/B (docs/PREFIX_CACHING.md "Two-tier
     cache"): a shared-prefix priority-mix workload over a device pool sized
@@ -1653,6 +1817,12 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       must improve, tokens bitwise both arms — plus a cold-restore twin
       (``EnginePool.restore`` from durable journals after a simulated
       host crash, bitwise greedy and sampled).
+    - ``disagg``: the disaggregated-serving acceptance A/B
+      (docs/SERVING.md "Disaggregated serving"): steady decode streams
+      in flight, then a bursty long-prompt wave, served 1P+2D
+      (``DisaggPool``, KV-transfer handoff) vs 3 mixed replicas at equal
+      chip count — TTFT p99 must improve, every long prompt must hand
+      off by KV transfer, tokens bitwise both arms.
     - ``kv_tier`` (``--kv-tier``): the two-tier KV cache acceptance A/B
       (docs/PREFIX_CACHING.md "Two-tier cache"): a shared-prefix
       priority-mix workload over an overcommitted device pool, host tier
@@ -1705,6 +1875,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_pool_scaling(max_seqs, prefix_cache)
     if workload == "pool_health":
         return run_pool_health(max_seqs, prefix_cache)
+    if workload == "disagg":
+        return run_disagg(max_seqs, prefix_cache)
     if workload == "kv_tier":
         return run_kv_tier(max_seqs, prefix_cache)
     if workload == "transfer_overlap":
@@ -1851,6 +2023,7 @@ CONFIGS = (
     ("paged", 4, "sampling", True),
     ("paged", 4, "pool_scaling", True),
     ("paged", 4, "pool_health", True),
+    ("paged", 4, "disagg", True),
 )
 
 

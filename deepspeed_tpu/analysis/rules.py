@@ -56,6 +56,14 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     # regression DSTPU001 should catch
     "_demote_block", "_scatter_blocks", "_drain_promotions",
     "swap_out", "swap_in", "_swap_in_readmit", "_preempt", "_swap_wins",
+    # disaggregated prefill/decode handoff (docs/SERVING.md
+    # "Disaggregated serving"): the export carries the handoff's ONE
+    # designed materialization (drain_before, the blocks leave the
+    # process); import/adopt dispatch and the per-step handoff scan must
+    # otherwise stay sync- and allocation-free — handoff traffic
+    # multiplies by long-prompt requests/second
+    "export_swap", "import_swap", "export_ready", "detach_with_kv",
+    "_dispatch_handoffs", "_handoff",
     # ZeRO gather/scatter/reduce-scatter paths (docs/ZERO.md): the host-tier
     # Adam loop carries ONE designed D2H gradient sync per leaf (suppressed at
     # the site); the offload step dispatcher and the stage-3 residency

@@ -628,6 +628,64 @@ def check_pool_health(replica_views, owner: Dict[int, int],
                              + "; ".join(problems))
 
 
+def check_disagg_ownership(replica_views, handoffs,
+                           deferred) -> None:
+    """Disaggregated-serving invariants (docs/SERVING.md "Disaggregated
+    serving"), armed per ``DisaggPool.step`` on top of
+    :func:`check_pool_ownership`. ``replica_views`` is a list of
+    ``(replica_id, role, journal, all_requests)`` tuples (non-dead
+    replicas only); ``handoffs`` maps uid -> the in-flight handoff's
+    exported payload dict (``None`` for a replay-degraded handoff);
+    ``deferred`` is the set of uids whose handoff the pool deliberately
+    postponed this step (no decode headroom / KV not yet at rest).
+    Violations this catches:
+
+    - a uid both journaled on a replica AND carried by an in-flight
+      handoff — two owners; whichever finishes second double-decodes;
+    - a handoff payload whose declared byte count disagrees with the
+      bytes its blocks actually hold — KV was dropped or duplicated in
+      transit (the in-memory companion of the CRC: the checksum proves
+      the bytes are intact, this proves they are conserved — the
+      TransferEngine ledger accounted exactly this many out of the
+      source);
+    - a decode-phase request resident on a prefill-only replica that the
+      pool did NOT defer — the handoff dispatcher missed it, and a
+      prefill worker is now paying the steady decode cost the role split
+      exists to remove.
+
+    Duck-typed (``journal.uids()``, ``Request.state``, payload dicts) —
+    no serve/resilience import."""
+    problems: List[str] = []
+    for rid, role, journal, all_requests in replica_views:
+        for uid in journal.uids():
+            if uid in handoffs:
+                problems.append(
+                    f"uid {uid} journaled on replica {rid} AND in an "
+                    "in-flight handoff — two owners")
+        if role == "prefill":
+            for uid, req in all_requests.items():
+                state = getattr(getattr(req, "state", None), "value", None)
+                if state == "decode" and uid not in deferred:
+                    problems.append(
+                        f"decode-phase uid {uid} resident on prefill-only "
+                        f"replica {rid} without a recorded deferral — "
+                        "handoff missed")
+    for uid, payload in handoffs.items():
+        if payload is None:
+            continue  # replay-degraded handoff carries no KV
+        declared = int(payload.get("nbytes", -1))
+        actual = sum(int(getattr(b, "nbytes", 0))
+                     for b in payload.get("blocks", ()))
+        if declared != actual:
+            problems.append(
+                f"uid {uid} handoff payload declares {declared} B but "
+                f"its blocks hold {actual} B — KV not conserved in "
+                "transit")
+    if problems:
+        raise SanitizerError("[sanitizer] disagg ownership violation: "
+                             + "; ".join(problems))
+
+
 # ---------------------------------------------------------------------------
 # training: partition/gather conservation (ZeRO state)
 # ---------------------------------------------------------------------------

@@ -138,8 +138,17 @@ class InferenceEngineV2:
         #: and flushes drop entries; the scheduler then replays from its
         #: journal exactly as before swap-preemption existed.
         self._swaps: Dict[int, Tuple] = {}
+        #: uids whose swap entry arrived from ANOTHER engine via
+        #: ``import_swap`` (disaggregated handoff, docs/SERVING.md) — when
+        #: such an entry is dropped without being swapped in (flush, rebuild,
+        #: weight swap), the import was orphaned and ``orphan_drops`` counts
+        #: it; a handoff that lands via ``swap_in`` leaves no trace here
+        self._swap_imports: set = set()
         self.swap_stats = {"swap_out": 0, "swap_in": 0,
-                           "swap_out_blocks": 0, "swap_in_blocks": 0}
+                           "swap_out_blocks": 0, "swap_in_blocks": 0,
+                           "swap_export": 0, "swap_import": 0,
+                           "export_blocks": 0, "import_blocks": 0,
+                           "orphan_drops": 0}
         # per-request sampling (docs/SAMPLING.md): duck-typed params records
         # (the engine reads .seed/.temperature/.top_k/.top_p — it never
         # imports serve) ride every greedy-mode dispatch as RUNTIME per-row
@@ -526,9 +535,13 @@ class InferenceEngineV2:
                 cancel()
 
     def _drop_swaps(self) -> None:
-        """Drop every swap-store entry, cancelling its in-flight tickets."""
+        """Drop every swap-store entry, cancelling its in-flight tickets.
+        Imported handoff entries dropped here never reached ``swap_in`` —
+        each is an orphaned export, counted in ``orphan_drops``."""
         for payloads, _, _ in self._swaps.values():
             self._cancel_payloads(payloads)
+        self.swap_stats["orphan_drops"] += len(self._swap_imports)
+        self._swap_imports.clear()
         self._swaps.clear()
 
     def swap_resident(self, uid: int) -> bool:
@@ -547,8 +560,7 @@ class InferenceEngineV2:
         if not self.host_tier_blocks:
             return False
         d = self.state.seqs.get(uid)
-        if (d is None or d.done or d.pending or d.uncommitted
-                or not d.blocks):
+        if d is None or not d.at_rest:
             return False
         gather = self._get_tier_gather()
         # dispatch-only, like demotion: each block rides an open ticket;
@@ -575,6 +587,7 @@ class InferenceEngineV2:
         entry = self._swaps.pop(uid, None)
         if entry is None:
             return False
+        self._swap_imports.discard(uid)  # landing — the import is not orphaned
         payloads, history, seen = entry
         if not self.state.can_allocate():
             self._cancel_payloads(payloads)
@@ -605,6 +618,131 @@ class InferenceEngineV2:
         self.swap_stats["swap_in"] += 1
         self.swap_stats["swap_in_blocks"] += len(payloads)
         return True
+
+    # ------------------------------------------------------------------
+    # cross-engine KV handoff (docs/SERVING.md "Disaggregated serving")
+    # ------------------------------------------------------------------
+    def export_ready(self, uid: int) -> bool:
+        """True when ``uid``'s KV could be exported right now: either
+        already parked in the swap store, or live and at rest (no pending
+        prefill, no uncommitted speculation, holding blocks). A False here
+        is a deferral signal, never an error — the disaggregated pool
+        re-checks next step."""
+        if not self.paged:
+            return False
+        if uid in self._swaps:
+            return True
+        d = self.state.seqs.get(uid)
+        return d is not None and d.at_rest
+
+    def export_swap(self, uid: int):
+        """Pull ``uid``'s at-rest KV OUT of this engine for a cross-engine
+        handoff: gather every held block to the host (riding the same async
+        D2H path as swap-out), materialize the payloads (the handoff's one
+        designed sync — the blocks leave this process, so the tickets
+        cannot stay open), flush the sequence, and return a self-describing
+        payload dict stamped with a CRC32 over the block bytes — the
+        importer verifies it before the KV can reach another device pool,
+        the same never-trust-past-the-checksum discipline as the NVMe tier.
+
+        Handles both residencies: a swap-store entry (preempted victim) is
+        drained and exported directly; a live at-rest sequence is gathered
+        then flushed. Returns ``None`` — and leaves the engine unchanged,
+        except that an unsettleable swap entry is dropped — when export
+        does not apply (non-paged, unknown uid, pending prefill,
+        uncommitted speculation): the caller falls back to journal replay,
+        so like the swap store itself this path is an optimization, never
+        a source of truth."""
+        from ...runtime.transfer_engine import blocks_crc32
+
+        if not self.paged:
+            return None
+        entry = self._swaps.pop(uid, None)
+        if entry is not None:
+            self._swap_imports.discard(uid)
+            payloads, history, seen = entry
+            blocks = self.transfer.drain_before(payloads)
+        else:
+            d = self.state.seqs.get(uid)
+            if d is None or not d.at_rest:
+                return None
+            gather = self._get_tier_gather()
+            tickets = [self.transfer.submit_d2h(gather(self.kv,
+                                                       jnp.int32(b)))
+                       for b in d.blocks]
+            blocks = self.transfer.drain_before(tickets)
+            history, seen = list(d.history), d.seen_tokens
+            self.flush(uid)
+        if any(b is None for b in blocks):
+            return None  # a payload failed to settle — caller replays
+        nbytes = int(sum(int(b.nbytes) for b in blocks))
+        self.swap_stats["swap_export"] += 1
+        self.swap_stats["export_blocks"] += len(blocks)
+        return {
+            "uid": uid,
+            "blocks": list(blocks),
+            "history": list(history),
+            "seen_tokens": int(seen),
+            "nbytes": nbytes,
+            "crc32": blocks_crc32(blocks),
+            "block_shape": tuple(self._tier_buf_shape()[1:]),
+            "dtype": str(np.dtype(self.kv[0].dtype)),
+        }
+
+    def import_swap(self, uid: int, payload) -> int:
+        """Install an exported payload from ANOTHER engine into this
+        engine's swap store, from where the normal ``swap_in`` re-admission
+        path lands it on the device pool. Validates before anything is
+        installed — a rejected import leaves this engine untouched:
+
+        - double import (``uid`` already swap-resident) and import over a
+          live sequence raise :class:`EngineUsageError` — each would make
+          one uid resident in two stores, the exactly-one-owner invariant
+          ``check_disagg_ownership`` enforces;
+        - geometry drift (block shape/dtype vs this pool, block count vs
+          ``blocks_needed(seen_tokens)``) raises :class:`EngineUsageError`
+          — the pools are incompatible and a scatter would corrupt KV;
+        - a CRC32 mismatch raises ``TransferCorruptError`` — the caller
+          degrades the handoff to journal replay.
+
+        Returns the payload byte count (ledger-conservation bookkeeping)."""
+        from ...runtime.transfer_engine import (TransferCorruptError,
+                                                blocks_crc32)
+
+        if not self.paged:
+            raise EngineUsageError("import_swap is paged-mode only", uid=uid)
+        if uid in self._swaps:
+            raise EngineUsageError(
+                f"uid {uid}: double import — already swap-resident here",
+                uid=uid)
+        if uid in self.state.seqs:
+            raise EngineUsageError(
+                f"uid {uid}: import over a live sequence — the uid would "
+                "be resident in two stores", uid=uid)
+        blocks = payload["blocks"]
+        seen = int(payload["seen_tokens"])
+        shape = tuple(self._tier_buf_shape()[1:])
+        dtype = np.dtype(self.kv[0].dtype)
+        need = self.block_mgr.blocks_needed(seen)
+        if len(blocks) != need or len(blocks) > self.block_mgr.max_blocks_per_seq:
+            raise EngineUsageError(
+                f"uid {uid}: import geometry drift — {len(blocks)} blocks "
+                f"for {seen} tokens (this pool needs {need}, cap "
+                f"{self.block_mgr.max_blocks_per_seq})", uid=uid)
+        for b in blocks:
+            if tuple(b.shape) != shape or np.dtype(b.dtype) != dtype:
+                raise EngineUsageError(
+                    f"uid {uid}: import geometry drift — block "
+                    f"{tuple(b.shape)}/{b.dtype} vs pool {shape}/{dtype}",
+                    uid=uid)
+        if blocks_crc32(blocks) != int(payload["crc32"]):
+            raise TransferCorruptError(
+                f"uid {uid}: handoff payload failed CRC verification")
+        self._swaps[uid] = (list(blocks), list(payload["history"]), seen)
+        self._swap_imports.add(uid)
+        self.swap_stats["swap_import"] += 1
+        self.swap_stats["import_blocks"] += len(blocks)
+        return int(payload["nbytes"])
 
     def _get_fused(self):
         """THE fused decode program: one compiled ``lax.scan`` over
@@ -1373,8 +1511,13 @@ class InferenceEngineV2:
             entry = self._swaps.pop(uid, None)
             if entry is not None:
                 # cancel/expiry of a swapped-out victim: drop its payloads,
-                # cancelling any still-open transfer tickets
+                # cancelling any still-open transfer tickets. A dropped
+                # IMPORTED entry is an orphaned handoff export (the adopt
+                # never landed) — counted, like rebuild's wholesale drop.
                 self._cancel_payloads(entry[0])
+                if uid in self._swap_imports:
+                    self._swap_imports.discard(uid)
+                    self.swap_stats["orphan_drops"] += 1
                 return
             self.flush_noops += 1
             log_dist(f"flush({uid}): unknown uid (no-op #{self.flush_noops})",
@@ -1420,7 +1563,7 @@ class InferenceEngineV2:
         deleted so the store never serves a previous incarnation's KV."""
         self.state = DSStateManager(self.max_seqs, self.max_seq_len)
         self.transfer.cancel_all()
-        self._swaps.clear()
+        self._drop_swaps()  # counts any orphaned handoff imports
         # sampling state is per-residency (slot bindings died with the state
         # manager): replay re-registers through set_sampling + put, and the
         # counter-based keys make the replayed samples bitwise identical

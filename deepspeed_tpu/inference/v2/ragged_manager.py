@@ -86,6 +86,16 @@ class SequenceDescriptor:
     def in_flight(self) -> int:
         return len(self.pending)
 
+    @property
+    def at_rest(self) -> bool:
+        """True when the sequence sits between dispatches with every cached
+        token committed — no pending prefill, no uncommitted speculation,
+        holding blocks. The only posture swap-out and cross-engine export
+        may capture: anything in flight would be silently dropped by the
+        gather (docs/SERVING.md "Disaggregated serving")."""
+        return (not self.done and not self.pending and not self.uncommitted
+                and bool(self.blocks))
+
 
 class BlockedKVCache:
     """Paged-block allocator (reference ``ragged/kv_cache.py:40

@@ -61,8 +61,13 @@ LOST = "lost"
 class ReplicaHealth:
     """Per-replica detector record (one per pool member)."""
 
-    def __init__(self, lease_deadline: Optional[float]):
+    def __init__(self, lease_deadline: Optional[float],
+                 role: str = "mixed"):
         self.state = SERVING
+        #: the replica's serving role (docs/SERVING.md "Disaggregated
+        #: serving") — purely observational here, but per-role views make
+        #: a dead prefill tier visible as such, not as generic churn
+        self.role = role
         #: per-unit dispatch latency EMA (seconds per horizon unit)
         self.ema = 0.0
         self.samples = 0
@@ -85,7 +90,7 @@ class ReplicaHealth:
         self.lease_expiries = 0
 
     def view(self) -> Dict[str, object]:
-        return {"state": self.state, "ema_s": self.ema,
+        return {"state": self.state, "role": self.role, "ema_s": self.ema,
                 "breach_windows": self.breach_windows,
                 "lease_deadline": self.lease_deadline,
                 "quarantines": self.quarantines, "probes": self.probes,
@@ -135,9 +140,11 @@ class HealthMonitor:
     # ------------------------------------------------------------------
     # registration
     # ------------------------------------------------------------------
-    def attach(self, replica_id: int, now: Optional[float] = None) -> None:
+    def attach(self, replica_id: int, now: Optional[float] = None,
+               role: str = "mixed") -> None:
         now = self._clock() if now is None else now
-        self._replicas[replica_id] = ReplicaHealth(now + self.lease_s)
+        self._replicas[replica_id] = ReplicaHealth(now + self.lease_s,
+                                                   role=role)
 
     def _rec(self, replica_id: int) -> ReplicaHealth:
         rec = self._replicas.get(replica_id)

@@ -83,6 +83,13 @@ class AdaptiveLimit:
     def has_headroom(self) -> bool:
         return len(self._inflight) < int(self.limit)
 
+    def headroom(self) -> int:
+        """Free admission slots under the current ceiling (0 when
+        saturated). Migration targeting — rebalance moves, disaggregated
+        handoffs — ranks candidates by this, so a replica admission would
+        reject never gets loaded through the side door either."""
+        return max(0, int(self.limit) - len(self._inflight))
+
     def admit(self, uid: int) -> None:
         self._inflight[uid] = True
 

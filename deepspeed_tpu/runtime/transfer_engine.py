@@ -59,6 +59,22 @@ def _nbytes(leaf) -> int:
         return 0
 
 
+def blocks_crc32(arrays) -> int:
+    """CRC32 over a sequence of host arrays, chained in order — the
+    in-memory twin of the NVMe store's per-file ``_crc32``. Cross-engine
+    KV handoff (docs/SERVING.md "Disaggregated serving") stamps every
+    exported swap payload with this checksum and the importer re-verifies
+    it before the blocks can reach a device pool: KV bytes are never
+    trusted across an engine boundary without it, exactly like the NVMe
+    tier never trusts a file past its manifest CRC."""
+    import zlib
+
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(np.ascontiguousarray(a).view(np.uint8), crc)
+    return crc & 0xFFFFFFFF
+
+
 class TransferTicket:
     """Receipt for one submitted transfer.
 

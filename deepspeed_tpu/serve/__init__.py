@@ -15,10 +15,11 @@ from ..resilience import (AdaptiveLimit, CircuitBreaker,  # noqa: F401
                           PoolExhaustedError, ReplicaLostError,
                           RequestFailedError, RetryPolicy, SheddingError,
                           StepWatchdog, TransientEngineError)
+from .disagg import ROLES, DisaggPool  # noqa: F401
 from .metrics import PoolMetrics, ServeMetrics  # noqa: F401
 from .pool import EnginePool, Replica  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
-from .router import Router  # noqa: F401
+from .router import PHASE_ROLES, Router  # noqa: F401
 from .sampling import (LogitProcessor, SamplingParams,  # noqa: F401
                        StopScanner, combined_bias)
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401

@@ -360,7 +360,15 @@ class PoolMetrics:
             "limit_rejects": 0,        # submissions refused: pool at limit
             "restores": 0,             # cold-start restores completed
             "restored_requests": 0,    # live requests replayed at restore
+            # disaggregated prefill/decode serving (docs/SERVING.md
+            # "Disaggregated serving")
+            "handoffs": 0,             # prefill->decode moves completed
+            "handoffs_kv": 0,          # ... that moved KV (vs replay)
+            "handoff_bytes": 0,        # KV bytes moved by handoffs
+            "handoff_deferrals": 0,    # handoffs deferred: no target headroom
+            "handoff_p95_s": 0.0,      # gauge: p95 handoff latency
         }
+        self._handoff_s: List[float] = []
 
     def observe_placement(self, hit_blocks: int) -> None:
         self.pool["placements"] += 1
@@ -401,6 +409,23 @@ class PoolMetrics:
     def observe_restore(self, restored: int) -> None:
         self.pool["restores"] += 1
         self.pool["restored_requests"] += restored
+
+    def observe_handoff(self, kv: bool, nbytes: int,
+                        duration_s: float) -> None:
+        """One completed prefill→decode handoff. ``kv=False`` is the
+        journal-replay fallback (the ladder's safe rung — still a
+        handoff, just a recomputed one)."""
+        self.pool["handoffs"] += 1
+        if kv:
+            self.pool["handoffs_kv"] += 1
+            self.pool["handoff_bytes"] += nbytes
+        self._handoff_s.append(float(duration_s))
+        s = sorted(self._handoff_s)
+        self.pool["handoff_p95_s"] = s[max(0, int(0.95 * len(s)) - 1)] \
+            if len(s) > 1 else s[0]
+
+    def observe_handoff_deferral(self) -> None:
+        self.pool["handoff_deferrals"] += 1
 
     def observe_gauges(self, loads: List[int], serving: int, draining: int,
                        dead: int) -> None:

@@ -89,6 +89,11 @@ class Replica:
         self.replica_id = replica_id
         self.scheduler = scheduler
         self.state = SERVING
+        #: serving role (docs/SERVING.md "Disaggregated serving"):
+        #: ``mixed`` (both phases — the compatible default), ``prefill``
+        #: or ``decode``. The router's phase axis reads it; only
+        #: :class:`~deepspeed_tpu.serve.disagg.DisaggPool` sets it.
+        self.role = "mixed"
         #: adaptive concurrency ceiling (resilience.limits) — None until
         #: the pool arms ``enable_limits``. The router skips replicas
         #: with no headroom; the pool keeps the uid ledger conserved.
@@ -100,6 +105,7 @@ class Replica:
 
     def __repr__(self) -> str:
         return (f"Replica(id={self.replica_id}, state={self.state}, "
+                f"role={self.role}, "
                 f"live={self.scheduler.live_count}, "
                 f"queued={self.scheduler.queue_depth})")
 
@@ -269,7 +275,7 @@ class EnginePool:
         now = self._clock()
         for rep in self.replicas:
             if rep.state != DEAD:
-                monitor.attach(rep.replica_id, now=now)
+                monitor.attach(rep.replica_id, now=now, role=rep.role)
             rep.scheduler.health_tap = self._tap_for(rep)
         return monitor
 
@@ -526,6 +532,21 @@ class EnginePool:
         self.metrics.observe_migration(rebalance=_rebalance)
         return req
 
+    def _replay_target(self, entry, survivors: List[Replica]) -> Replica:
+        """Where a detached entry replays when its owner leaves rotation
+        (drain, quarantine, death). Placement rides the router; with every
+        candidate at its concurrency limit the least-loaded survivor takes
+        it anyway — migrated load is conserved, not new admission, so the
+        limit filter must not strand it. The disaggregated pool overrides
+        this with role-aware targeting (a mid-prefill request belongs on a
+        prefill-capable survivor, a decoding one wherever capacity
+        exists)."""
+        target, _ = self.router.place(entry.replay_tokens(), survivors)
+        if target is None:
+            target = min(survivors,
+                         key=lambda r: (Router.load(r), r.replica_id))
+        return target
+
     def _pick_migratable(self, rep: Replica) -> Optional[int]:
         """The cheapest request to move off ``rep``: the youngest queued
         request (nothing resident to recompute), else the live request
@@ -549,7 +570,15 @@ class EnginePool:
             if len(serving) < 2:
                 break
             hi = max(serving, key=lambda r: (Router.load(r), -r.replica_id))
-            lo = min(serving, key=lambda r: (Router.load(r), r.replica_id))
+            # rebalance-aware limits (docs/RESILIENCE.md "Health &
+            # overload"): a replica admission would reject is not a
+            # replica rebalance may overload — saturated targets are
+            # skipped, unlike drain/death replay where the load MUST land
+            targets = [r for r in serving if r is not hi
+                       and (r.limit is None or r.limit.has_headroom())]
+            if not targets:
+                break
+            lo = min(targets, key=lambda r: (Router.load(r), r.replica_id))
             if Router.load(hi) - Router.load(lo) < 2:
                 break
             uid = self._pick_migratable(hi)
@@ -582,13 +611,7 @@ class EnginePool:
         moved = 0
         for uid in list(rep.scheduler.journal.uids()):
             entry = rep.scheduler.detach(uid)
-            target, _ = self.router.place(entry.replay_tokens(), survivors)
-            if target is None:
-                # every survivor is at its concurrency limit — the drain
-                # must still complete; bypass the limit filter (migrated
-                # load is conserved, not new admission)
-                target = min(survivors,
-                             key=lambda r: (Router.load(r), r.replica_id))
+            target = self._replay_target(entry, survivors)
             target.scheduler.adopt(entry)
             self._owner[uid] = target.replica_id
             if rep.limit is not None:
@@ -697,13 +720,7 @@ class EnginePool:
                 self._owner.pop(uid, None)
                 cancelled += 1
                 continue
-            target, _ = self.router.place(entry.replay_tokens(),
-                                          survivors)
-            if target is None:
-                # death replay bypasses the concurrency-limit filter:
-                # the load already existed, survivors must take it
-                target = min(survivors,
-                             key=lambda r: (Router.load(r), r.replica_id))
+            target = self._replay_target(entry, survivors)
             target.scheduler.adopt(entry)
             self._owner[uid] = target.replica_id
             if target.limit is not None:
@@ -734,7 +751,8 @@ class EnginePool:
         rep.state = SERVING
         if self.health_monitor is not None:
             if self.health_monitor.state_of(rep.replica_id) is None:
-                self.health_monitor.attach(rep.replica_id, now=self._clock())
+                self.health_monitor.attach(rep.replica_id, now=self._clock(),
+                                           role=rep.role)
             else:
                 self.health_monitor.note_revived(rep.replica_id,
                                          now=self._clock())

@@ -15,6 +15,17 @@ zero-hit placements (and ``affinity=False``, the A/B baseline) fall back
 to **least-loaded** (live + queued requests); remaining ties break on the
 lowest replica id.
 
+Disaggregated serving (docs/SERVING.md "Disaggregated serving") adds a
+second placement axis next to affinity: the request's **phase**. New
+submissions are prefill work — they place by prefix affinity among
+prefill-capable replicas (role ``prefill`` or ``mixed``); post-prefill
+handoffs are decode work — they place least-loaded among decode-capable
+replicas (role ``decode`` or ``mixed``), skipping the affinity probe
+entirely (the KV arrives WITH the request, so there is no locality to
+exploit and no reason to pay a probe per handoff). A handle without a
+``role`` attribute is ``mixed``, so single-role-free pools behave exactly
+as before the axis existed.
+
 Determinism (DSTPU005): the decision is a pure function of the replicas'
 current state and the candidate prompt — no wall clock, no RNG, no set
 iteration. The caller passes replicas in id order and the tie-break is
@@ -24,13 +35,23 @@ replica; a replayed trace routes identically.
 
 from typing import List, Optional, Sequence, Tuple
 
+#: replica roles a phase may place on (docs/SERVING.md "Disaggregated
+#: serving"); ``mixed`` replicas serve both phases — the compatible
+#: default for pools that never configured roles
+PHASE_ROLES = {
+    "prefill": ("prefill", "mixed"),
+    "decode": ("decode", "mixed"),
+}
+
 
 class Router:
     """Placement policy over a list of replica handles.
 
     A *replica handle* is duck-typed: ``replica_id`` (int, unique),
     ``scheduler`` (exposes ``live_count`` / ``queue_depth``) and
-    ``engine`` (exposes ``prefix_probe``). ``affinity=False`` disables
+    ``engine`` (exposes ``prefix_probe``); an optional ``role``
+    (``"prefill"`` / ``"decode"`` / ``"mixed"``, default ``"mixed"``)
+    gates which phases it may receive. ``affinity=False`` disables
     the prefix score entirely — pure least-loaded, the bench's A/B
     baseline."""
 
@@ -44,22 +65,28 @@ class Router:
         return replica.scheduler.live_count + replica.scheduler.queue_depth
 
     def place(self, prompt: Sequence[int], replicas: List[object],
-              ) -> Tuple[Optional[object], int]:
+              *, phase: str = "prefill") -> Tuple[Optional[object], int]:
         """Pick the owner for ``prompt`` among ``replicas`` (id order).
+        ``phase`` selects the role axis: ``"prefill"`` (new submissions —
+        affinity-scored) or ``"decode"`` (handoffs — least-loaded only).
         Returns ``(replica, hit_blocks)`` — ``hit_blocks`` is the winning
         affinity score (0 on a least-loaded fallback) — or ``(None, 0)``
         when no replica is offered."""
+        roles = PHASE_ROLES[phase]
+        probe = self.affinity and phase == "prefill"
         best = None
         best_key: Optional[Tuple[int, int, int]] = None
         best_hits = 0
         for rep in replicas:
+            if getattr(rep, "role", "mixed") not in roles:
+                continue
             # adaptive concurrency limit (docs/RESILIENCE.md "Health &
             # overload"): a replica at its Vegas ceiling is not a candidate
             # — affinity never overrides overload protection
             limit = getattr(rep, "limit", None)
             if limit is not None and not limit.has_headroom():
                 continue
-            hits = rep.engine.prefix_probe(prompt) if self.affinity else 0
+            hits = rep.engine.prefix_probe(prompt) if probe else 0
             key = (-hits, self.load(rep), rep.replica_id)
             if best_key is None or key < best_key:
                 best, best_key, best_hits = rep, key, hits

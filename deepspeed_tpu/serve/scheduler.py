@@ -453,6 +453,33 @@ class ContinuousBatchScheduler:
         self.metrics.detaches += 1
         return entry
 
+    def detach_with_kv(self, uid: int):
+        """Detach a request AND export its at-rest KV for a cross-engine
+        handoff (docs/SERVING.md "Disaggregated serving"): returns
+        ``(entry, payload)`` where ``payload`` is the engine's
+        ``export_swap`` dict — or ``None`` whenever the KV path cannot
+        deliver (engine without the seam, request not at rest, transfer
+        failure, engine loss mid-export). ``None`` is the fallback-ladder
+        signal, never an error: the entry always comes back valid and the
+        adopting side replays ``prompt + committed tokens`` from the
+        journal, so a degraded handoff costs recompute, not correctness.
+        Export happens BEFORE detach — export pops the uid from this
+        engine's stores, so by the time detach's flush runs the uid is
+        resident nowhere on the source (no uid in two stores, ever)."""
+        payload = None
+        export = getattr(self.engine, "export_swap", None)
+        if export is not None and self._engine_dead is None:
+            try:
+                payload = export(uid)
+            except UnrecoverableEngineError as e:
+                # next step() recovers; THIS handoff degrades to replay
+                self._note_engine_lost(e)
+                payload = None
+            except TransientEngineError:
+                # a handoff is never worth a retry loop — replay instead
+                payload = None
+        return self.detach(uid), payload
+
     def adopt(self, entry) -> Request:
         """Take ownership of a detached :class:`JournalEntry`: journal it
         here (committed-token record preserved byte for byte), walk the
@@ -863,8 +890,7 @@ class ContinuousBatchScheduler:
                     return
                 self._preempt(victim)
                 continue  # re-check capacity; may need more than one victim
-            if (getattr(self.engine, "host_tier_blocks", 0)
-                    and self.engine.swap_resident(best.uid)):
+            if self._swap_resident(best.uid):
                 # a swap-preempted victim re-admits by block copy, but only
                 # once its full at-rest footprint PLUS one growth block fit
                 # — restoring into an exactly-full pool re-creates the very
@@ -884,6 +910,16 @@ class ContinuousBatchScheduler:
                     return
             self._queue.remove(best)
             self._start(best, now)
+
+    def _swap_resident(self, uid: int) -> bool:
+        """True when ``uid``'s KV is parked in the engine's host swap
+        store. Duck-typed on ``engine.swap_resident`` — and deliberately
+        NOT gated on ``host_tier_blocks``: swap-preemption only populates
+        the store with the tier on, but a disaggregated handoff
+        (``import_swap``) parks KV on tier-less decode workers too, and
+        both re-admit through the same ``_swap_in_readmit`` fast path."""
+        fn = getattr(self.engine, "swap_resident", None)
+        return fn is not None and fn(uid)
 
     def _swap_in_readmit(self, req: Request) -> bool:
         """Re-admit a swap-preempted victim by block copy: ``engine.swap_in``
@@ -957,9 +993,7 @@ class ContinuousBatchScheduler:
                 bias_row=combined_bias(sp, self.engine.cfg.vocab_size,
                                        req.replay_tokens()))
             self.metrics.observe_sampling_admit(sp)
-        if (getattr(self.engine, "host_tier_blocks", 0)
-                and self.engine.swap_resident(req.uid)
-                and self._swap_in_readmit(req)):
+        if self._swap_resident(req.uid) and self._swap_in_readmit(req):
             return  # resumed in place: next decode round feeds tokens[-1]
         if self.chunked_prefill:
             # register + prefix-cache lookup only (max_steps=0): the

@@ -1,0 +1,740 @@
+"""Disaggregated prefill/decode serving tests (docs/SERVING.md
+"Disaggregated serving"): role validation, the router's phase axis,
+KV-transfer handoff bitwise vs the fault-free single-engine oracle
+(greedy + sampled), the fallback ladder (export failure, import
+rejection, CRC corruption, replica death on either end — every rung
+degrades to journal replay and stays bitwise), deadline expiry
+mid-handoff (typed cancel), rebalance/handoff targeting gated by
+``AdaptiveLimit`` headroom, prefix-cache hits on the prefill worker,
+cold restore of a role-configured pool, the engine-level
+``export_swap``/``import_swap`` lifecycle (no uid in two stores, typed
+double-import/import-over-live, orphan accounting), and the
+``check_disagg_ownership`` sanitizer's planted violations."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_disagg_ownership)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience import (AdaptiveLimit, DurableRequestJournal,
+                                      FaultInjector, FaultSpec,
+                                      RequestFailedError, RetryPolicy,
+                                      TransientEngineError)
+from deepspeed_tpu.resilience.errors import EngineUsageError
+from deepspeed_tpu.runtime.transfer_engine import TransferCorruptError
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, DisaggPool,
+                                 RequestState, Router, SamplingParams)
+from deepspeed_tpu.serve.pool import DEAD, SERVING
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, intermediate_size=128,
+                    max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 33)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _workload(seed=61, n=6, lo=8, hi=25, gen=6):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 128, int(rng.integers(lo, hi))).tolist()
+               for _ in range(n)]
+    uids = [8600 + i for i in range(n)]
+    return prompts, uids, gen
+
+
+_REF_MEMO = {}
+
+
+def _sampled(uids):
+    return {u: SamplingParams(temperature=0.8, seed=u) for u in uids}
+
+
+def _reference(m, params, prompts, uids, gen, sampling=None):
+    """Fault-free single-engine run — the bitwise oracle (per-request
+    counter-based keys make placement, handoff, and replay invisible in
+    the tokens, sampled or greedy)."""
+    key = (tuple(map(tuple, prompts)), tuple(uids), gen, repr(sampling))
+    if key in _REF_MEMO:
+        return _REF_MEMO[key]
+    sched = ContinuousBatchScheduler(
+        _engine(m, params), retry=RetryPolicy(max_attempts=5),
+        sleep=lambda s: None)
+    reqs = [sched.submit(p, max_new_tokens=gen, uid=u,
+                         sampling=(sampling or {}).get(u))
+            for p, u in zip(prompts, uids)]
+    sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in reqs)
+    _REF_MEMO[key] = {r.uid: list(r.tokens) for r in reqs}
+    return _REF_MEMO[key]
+
+
+def _disagg(m, params, n, roles, *, specs_for=None, eng_kw=None,
+            clock=None, journal_factory=None, **sched_kw):
+    """Build an n-replica DisaggPool; ``specs_for`` maps replica_id ->
+    fault specs (that replica's engine is injector-wrapped, an empty
+    list wraps without a plan). Returns (pool, raw_engines, injectors)."""
+    engines, injectors = {}, {}
+
+    def factory(i):
+        eng = _engine(m, params, **(eng_kw or {}))
+        engines[i] = eng
+        if specs_for is not None and i in specs_for:
+            injectors[i] = FaultInjector(specs_for[i])
+            return injectors[i].wrap(eng)
+        return eng
+
+    sched_kw.setdefault("retry", RetryPolicy(max_attempts=5))
+    sched_kw.setdefault("sleep", lambda s: None)
+    kw = {} if clock is None else {"clock": clock}
+    if journal_factory is not None:
+        kw["journal_factory"] = journal_factory
+    pool = DisaggPool.build(factory, n, roles=roles, **kw, **sched_kw)
+    return pool, engines, injectors
+
+
+# ---------------------------------------------------------------------------
+# role configuration
+# ---------------------------------------------------------------------------
+
+class TestRoleConfig:
+    def test_roles_assigned_by_sequence_and_dict(self, setup):
+        m, params = setup
+        pool, _, _ = _disagg(m, params, 3, ["prefill", "decode", "mixed"])
+        assert [r.role for r in pool.replicas] == ["prefill", "decode",
+                                                   "mixed"]
+        pool.set_roles({0: "mixed"})       # partial dict: others keep theirs
+        assert [r.role for r in pool.replicas] == ["mixed", "decode",
+                                                   "mixed"]
+        pool.close()
+
+    def test_unknown_role_rejected(self, setup):
+        m, params = setup
+        with pytest.raises(ValueError, match="unknown role"):
+            _disagg(m, params, 2, ["prefill", "verifier"])
+
+    def test_wrong_role_count_rejected(self, setup):
+        m, params = setup
+        with pytest.raises(ValueError, match="roles for"):
+            _disagg(m, params, 2, ["prefill"])
+
+    @pytest.mark.parametrize("roles,missing", [
+        (["decode", "decode"], "prefill-capable"),
+        (["prefill", "prefill"], "decode-capable"),
+    ])
+    def test_uncoverable_phase_rejected(self, setup, roles, missing):
+        m, params = setup
+        with pytest.raises(ValueError, match=missing):
+            _disagg(m, params, 2, roles)
+
+    def test_set_roles_is_atomic(self, setup):
+        m, params = setup
+        pool, _, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        with pytest.raises(ValueError, match="decode-capable"):
+            pool.set_roles({1: "prefill"})  # would strand decode phase
+        assert [r.role for r in pool.replicas] == ["prefill", "decode"]
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# router phase axis (pure: duck-typed replica handles)
+# ---------------------------------------------------------------------------
+
+class _StubSched:
+    def __init__(self, live=0, queued=0):
+        self.live_count = live
+        self.queue_depth = queued
+
+
+class _StubLimit:
+    def __init__(self, headroom):
+        self._headroom = headroom
+
+    def has_headroom(self):
+        return self._headroom
+
+    def headroom(self):
+        return 1 if self._headroom else 0
+
+
+class _StubReplica:
+    def __init__(self, rid, role="mixed", live=0, queued=0, hits=0,
+                 headroom=None):
+        self.replica_id = rid
+        self.role = role
+        self.scheduler = _StubSched(live, queued)
+        self._hits = hits
+        self.probes = 0
+        self.engine = self
+        self.limit = None if headroom is None else _StubLimit(headroom)
+
+    def prefix_probe(self, prompt):
+        self.probes += 1
+        return self._hits
+
+
+class TestPhaseRouting:
+    def test_decode_phase_skips_prefill_only(self):
+        reps = [_StubReplica(0, role="prefill"),
+                _StubReplica(1, role="decode", live=2),
+                _StubReplica(2, role="mixed", live=1)]
+        rep, hits = Router().place([1, 2], reps, phase="decode")
+        assert rep.replica_id == 2 and hits == 0
+
+    def test_decode_phase_never_probes(self):
+        # the cached prefill worker cannot attract a handoff — the KV
+        # arrives WITH the request, affinity is meaningless
+        reps = [_StubReplica(0, role="mixed", live=5, hits=9),
+                _StubReplica(1, role="decode")]
+        rep, hits = Router().place([1, 2], reps, phase="decode")
+        assert rep.replica_id == 1 and hits == 0
+        assert reps[0].probes == 0 and reps[1].probes == 0
+
+    def test_prefill_phase_skips_decode_only(self):
+        reps = [_StubReplica(0, role="decode"),
+                _StubReplica(1, role="prefill", live=3, hits=2),
+                _StubReplica(2, role="mixed")]
+        rep, hits = Router().place([1, 2], reps, phase="prefill")
+        assert rep.replica_id == 1 and hits == 2   # affinity still ranks
+
+    def test_default_phase_is_prefill_and_roleless_is_mixed(self):
+        class _Bare(_StubReplica):
+            pass
+        bare = _Bare(0)
+        del bare.role                          # pre-disagg handle shape
+        rep, _ = Router().place([1], [bare])
+        assert rep is bare
+
+    def test_saturated_decode_worker_skipped(self):
+        # satellite: AdaptiveLimit headroom gates handoff targeting
+        reps = [_StubReplica(0, role="decode", headroom=False),
+                _StubReplica(1, role="decode", live=4, headroom=True)]
+        rep, _ = Router().place([1], reps, phase="decode")
+        assert rep.replica_id == 1
+        reps[1].limit = _StubLimit(False)
+        assert Router().place([1], reps, phase="decode") == (None, 0)
+
+
+# ---------------------------------------------------------------------------
+# the handoff, bitwise
+# ---------------------------------------------------------------------------
+
+class TestHandoffBitwise:
+    @pytest.mark.parametrize("sampled", [False, True],
+                             ids=["greedy", "temp0.8"])
+    def test_1p2d_completes_bitwise_with_kv_handoffs(self, setup, sampled):
+        """The acceptance core: a 1P+2D pool completes the workload
+        bitwise identical to the fault-free single-engine reference —
+        greedy and sampled — with every request moved off the prefill
+        worker by exactly one KV-transfer handoff (no replay
+        degradation in a fault-free run)."""
+        m, params = setup
+        prompts, uids, gen = _workload()
+        sp = _sampled(uids) if sampled else {}
+        ref = _reference(m, params, prompts, uids, gen, sampling=sp or None)
+        pool, engines, _ = _disagg(m, params, 3,
+                                   ["prefill", "decode", "decode"])
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u, sampling=sp.get(u))
+                for p, u in zip(prompts, uids)]
+        assert all(pool.owner_of(u) == 0 for u in uids)  # prefill-phase
+        pool.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        pm = pool.metrics.pool
+        assert pm["handoffs"] == len(uids)
+        assert pm["handoffs_kv"] == len(uids)    # no degradation
+        assert pm["handoff_bytes"] > 0
+        assert pm["handoff_p95_s"] > 0.0
+        assert engines[0].swap_stats["swap_export"] == len(uids)
+        assert (engines[1].swap_stats["swap_import"]
+                + engines[2].swap_stats["swap_import"]) == len(uids)
+        # the prefill worker never ran a fused decode dispatch
+        assert engines[0].fused_cache_size == 0
+        pool.close()
+
+    def test_all_mixed_pool_never_hands_off(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=67, n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _disagg(m, params, 2, None)     # roles unset: mixed
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["handoffs"] == 0
+        assert pool.metrics.pool["handoff_deferrals"] == 0
+        pool.close()
+
+    def test_prefix_hit_on_prefill_worker_then_handoff(self, setup):
+        """A prompt whose leading blocks are already cached on the
+        prefill worker places there by affinity, skips the cached
+        prefill, and still leaves by KV handoff — bitwise."""
+        m, params = setup
+        rng = np.random.default_rng(71)
+        shared = rng.integers(0, 128, 32).tolist()     # two full blocks
+        pa = shared + rng.integers(0, 128, 5).tolist()
+        pb = shared + rng.integers(0, 128, 7).tolist()
+        ref_a = _reference(m, params, [pa], [8701], 5)
+        ref_b = _reference(m, params, [pb], [8702], 5)
+        pool, engines, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        ra = pool.submit(pa, max_new_tokens=5, uid=8701)
+        pool.run_until_complete()
+        assert list(ra.tokens) == ref_a[8701]
+        rb = pool.submit(pb, max_new_tokens=5, uid=8702)
+        pool.run_until_complete()
+        assert list(rb.tokens) == ref_b[8702]
+        pm = pool.metrics.pool
+        assert pm["placement_hits"] >= 1          # b's probe hit the cache
+        assert pm["handoffs"] == 2 and pm["handoffs_kv"] == 2
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# the fallback ladder: every degradation replays, bitwise
+# ---------------------------------------------------------------------------
+
+class TestHandoffDegradation:
+    def _run_degraded(self, m, params, monkeypatch, breaker):
+        """Common shape: 1P+1D, one rung of the ladder broken by
+        ``breaker(engines)``, the workload must still complete bitwise
+        with every handoff degraded to replay (kv count 0)."""
+        prompts, uids, gen = _workload(seed=73, n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, engines, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        breaker(monkeypatch, engines)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        pm = pool.metrics.pool
+        assert pm["handoffs"] == len(uids)
+        assert pm["handoffs_kv"] == 0
+        return pool, engines
+
+    def test_export_failure_degrades_to_replay(self, setup, monkeypatch):
+        m, params = setup
+
+        def breaker(monkeypatch, engines):
+            def boom(uid):
+                raise TransientEngineError("injected export failure")
+            monkeypatch.setattr(engines[0], "export_swap", boom)
+
+        pool, _ = self._run_degraded(m, params, monkeypatch, breaker)
+        pool.close()
+
+    def test_import_rejection_degrades_to_replay(self, setup, monkeypatch):
+        m, params = setup
+
+        def breaker(monkeypatch, engines):
+            def boom(uid, payload):
+                raise EngineUsageError("injected import rejection")
+            monkeypatch.setattr(engines[1], "import_swap", boom)
+
+        pool, engines = self._run_degraded(m, params, monkeypatch, breaker)
+        assert engines[1].swap_stats["orphan_drops"] == 0
+        pool.close()
+
+    def test_crc_corruption_degrades_to_replay(self, setup, monkeypatch):
+        """A payload corrupted in transit fails the importer's CRC check
+        (TransferCorruptError) — the handoff replays; corruption can cost
+        a re-prefill, never a wrong token."""
+        m, params = setup
+
+        def breaker(monkeypatch, engines):
+            orig = engines[0].export_swap
+
+            def tampered(uid):
+                p = orig(uid)
+                if p is not None:
+                    p = dict(p)
+                    p["crc32"] = int(p["crc32"]) ^ 1
+                return p
+            monkeypatch.setattr(engines[0], "export_swap", tampered)
+
+        pool, engines = self._run_degraded(m, params, monkeypatch, breaker)
+        # the rejected import installed nothing on the decode worker
+        assert engines[1].swap_stats["swap_import"] == 0
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# replica death on either end of the handoff
+# ---------------------------------------------------------------------------
+
+class TestHandoffUnderDeath:
+    def test_source_prefill_worker_death_replays_bitwise(self, setup):
+        """The prefill worker dies mid-prefill. No prefill-capable
+        survivor exists, so role purity yields to capacity: the decode
+        workers adopt the replays, run both phases, and every request
+        completes bitwise."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=79, n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, injectors = _disagg(
+            m, params, 3, ["prefill", "decode", "decode"],
+            specs_for={0: [FaultSpec(site="put", kind="device_lost",
+                                     nth=2)]})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert injectors[0].deaths == 1
+        assert pool.replica(0).state == DEAD
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["replica_deaths"] == 1
+        assert pool.metrics.pool["death_replays"] >= 1
+        pool.close()
+
+    def test_destination_decode_worker_death_replays_bitwise(self, setup):
+        """A decode worker dies AFTER accepting KV handoffs. Its
+        requests replay phase-aware onto the surviving decode worker —
+        never back onto the prefill worker — and stay bitwise (the
+        imported KV died with the engine; the journal is the source of
+        truth, exactly the fallback ladder's bottom rung)."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=83, n=5, gen=6)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, injectors = _disagg(m, params, 3,
+                                     ["prefill", "decode", "decode"],
+                                     specs_for={1: []})
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        for _ in range(200):
+            if not pool.step():
+                break
+            if any(pool._owner.get(u) == 1 for u in uids):
+                break
+        assert any(pool._owner.get(u) == 1 for u in uids), \
+            "no handoff ever landed on the doomed decode worker"
+        injectors[1].device_lost = "injected death after KV handoff"
+        pool.run_until_complete()
+        assert pool.replica(1).state == DEAD
+        assert [pool.replica(i).state for i in (0, 2)] == [SERVING] * 2
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["replica_deaths"] == 1
+        # phase-aware absorption: the decode-phase replays landed on the
+        # surviving decode worker, not the prefill worker
+        assert pool.replica(0).scheduler.metrics.adopts == 0
+        assert pool.replica(2).scheduler.metrics.adopts >= 1
+        pool.close()
+
+    def test_deadline_expired_mid_handoff_cancelled_typed(self, setup):
+        """A request whose deadline passes inside the handoff window
+        (detached from the source, not yet adopted) is cancelled TYPED —
+        RequestFailedError, cancel_reason 'deadline' — exactly like the
+        death-replay deadline branch, never adopted half-dead."""
+        m, params = setup
+        t = [0.0]
+        pool, _, _ = _disagg(m, params, 2, ["prefill", "decode"],
+                             clock=lambda: t[0])
+        prompt = np.random.default_rng(89).integers(0, 128, 40).tolist()
+        doomed = pool.submit(prompt, max_new_tokens=4, uid=8800,
+                             deadline=5.0)
+        pool.step()                     # admitted at t=0, mid-prefill
+        t[0] = 10.0                     # expires while the handoff is open
+        moved = pool._handoff(pool.replica(0), pool.replica(1), 8800)
+        assert moved == 0
+        assert doomed.state is RequestState.CANCELLED
+        assert doomed.cancel_reason == "deadline"
+        assert isinstance(doomed.error, RequestFailedError)
+        assert 8800 not in pool._owner
+        assert pool.metrics.pool["handoffs"] == 0
+        assert pool._inflight_handoffs == {}
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# rebalance-aware limits (satellite: headroom gates migration targeting)
+# ---------------------------------------------------------------------------
+
+class TestLimitAwareTargeting:
+    def test_handoffs_skip_saturated_decode_worker(self, setup):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=97, n=4, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _disagg(m, params, 3, ["prefill", "decode", "decode"])
+        sat = pool.replica(1)
+        sat.limit = AdaptiveLimit(initial=1)
+        sat.limit.admit(77001)          # pinned at its ceiling
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        while pool.step():
+            assert all(pool._owner.get(u) != 1 for u in uids), \
+                "handoff landed on a saturated decode worker"
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["handoffs"] == len(uids)
+        pool.close()
+
+    def test_all_decode_workers_saturated_defers_not_strands(self, setup):
+        """With every decode worker at its ceiling the handoff defers:
+        the request keeps decoding on the prefill worker (visible as
+        handoff_deferrals) and still completes bitwise."""
+        m, params = setup
+        prompts, uids, gen = _workload(seed=101, n=3, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        pool, _, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        sat = pool.replica(1)
+        sat.limit = AdaptiveLimit(initial=1)
+        sat.limit.admit(77002)
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert {r.uid: list(r.tokens) for r in reqs} == ref
+        assert pool.metrics.pool["handoffs"] == 0
+        assert pool.metrics.pool["handoff_deferrals"] > 0
+        pool.close()
+
+    def test_rebalance_skips_saturated_target(self, setup):
+        m, params = setup
+        pool, _, _ = _disagg(m, params, 3, None)     # all mixed
+        pool.drain(1)
+        pool.drain(2)
+        uids = [8900 + i for i in range(4)]
+        for u in uids:                    # everything lands on replica 0
+            pool.submit([1, 2, 3, 4, 5, 6], max_new_tokens=4, uid=u)
+        pool.undrain(1)
+        pool.undrain(2)
+        pool.replica(1).limit = AdaptiveLimit(initial=1)
+        pool.replica(1).limit.admit(77003)
+        moved = pool.rebalance(max_moves=2)
+        assert moved == 2
+        assert all(pool.owner_of(u) != 1 for u in uids)
+        assert sum(pool.owner_of(u) == 2 for u in uids) == 2
+        # with EVERY target saturated, rebalance refuses rather than
+        # overloads — the load stays where it is
+        pool.replica(2).limit = AdaptiveLimit(initial=1)
+        pool.replica(2).limit.admit(77004)
+        pool.replica(1).limit.admit(77005)
+        assert pool.rebalance(max_moves=2) == 0
+        pool.replica(1).limit = pool.replica(2).limit = None
+        pool.run_until_complete()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# engine-level export/import lifecycle (satellite: swap-store hardening)
+# ---------------------------------------------------------------------------
+
+class TestSwapSeam:
+    def _mid_decode(self, m, params, uid=8950, gen=6):
+        """A scheduler with one request detached mid-decode WITH its KV:
+        returns (sched, entry, payload, ref_tokens)."""
+        prompt = np.random.default_rng(uid).integers(0, 128, 20).tolist()
+        ref = _reference(m, params, [prompt], [uid], gen)
+        sched = ContinuousBatchScheduler(
+            _engine(m, params), retry=RetryPolicy(max_attempts=5),
+            sleep=lambda s: None)
+        req = sched.submit(prompt, max_new_tokens=gen, uid=uid)
+        for _ in range(100):
+            sched.step()
+            if len(req.tokens) >= 2:
+                break
+        assert req.state is RequestState.DECODE
+        entry, payload = sched.detach_with_kv(uid)
+        return sched, entry, payload, ref[uid]
+
+    def test_export_removes_uid_from_source_atomically(self, setup):
+        m, params = setup
+        sched, entry, payload, _ = self._mid_decode(m, params, uid=8950)
+        assert payload is not None
+        eng = sched.engine
+        # no uid in two stores: the source holds NOTHING after export
+        assert not eng.swap_resident(8950)
+        assert 8950 not in eng.state.seqs
+        assert len(sched.journal) == 0
+        assert eng.swap_stats["swap_export"] == 1
+        assert payload["nbytes"] == sum(int(b.nbytes)
+                                        for b in payload["blocks"])
+        sched.close()
+
+    def test_import_then_adopt_resumes_bitwise(self, setup):
+        m, params = setup
+        sched, entry, payload, ref = self._mid_decode(m, params, uid=8951)
+        dst = ContinuousBatchScheduler(
+            _engine(m, params), retry=RetryPolicy(max_attempts=5),
+            sleep=lambda s: None)
+        nbytes = dst.engine.import_swap(8951, payload)
+        assert nbytes == payload["nbytes"]
+        assert dst.engine.swap_resident(8951)
+        req = dst.adopt(entry)
+        dst.run_until_complete()
+        assert req.state is RequestState.DONE
+        assert list(req.tokens) == ref
+        assert dst.engine.swap_stats["swap_import"] == 1
+        # the import LANDED (swap_in), so it is not an orphan
+        assert dst.engine.swap_stats["orphan_drops"] == 0
+        sched.close()
+        dst.close()
+
+    def test_double_import_raises_typed(self, setup):
+        m, params = setup
+        sched, _, payload, _ = self._mid_decode(m, params, uid=8952)
+        dst = _engine(m, params)
+        dst.import_swap(8952, payload)
+        with pytest.raises(EngineUsageError, match="double import"):
+            dst.import_swap(8952, payload)
+        sched.close()
+
+    def test_import_over_live_uid_raises_typed(self, setup):
+        m, params = setup
+        sched, _, payload, _ = self._mid_decode(m, params, uid=8953)
+        dst = ContinuousBatchScheduler(
+            _engine(m, params), retry=RetryPolicy(max_attempts=5),
+            sleep=lambda s: None)
+        dst.submit([5, 6, 7, 8, 9], max_new_tokens=4, uid=8954)
+        dst.step()                      # 8954 now live on the engine
+        with pytest.raises(EngineUsageError, match="two stores"):
+            dst.engine.import_swap(8954, payload)
+        sched.close()
+        dst.close()
+
+    def test_corrupt_and_drifted_payloads_rejected(self, setup):
+        m, params = setup
+        sched, _, payload, _ = self._mid_decode(m, params, uid=8955)
+        dst = _engine(m, params)
+        bad_crc = dict(payload, crc32=int(payload["crc32"]) ^ 1)
+        with pytest.raises(TransferCorruptError, match="CRC"):
+            dst.import_swap(8955, bad_crc)
+        bad_geom = dict(payload, blocks=list(payload["blocks"])[:-1])
+        with pytest.raises(EngineUsageError, match="geometry drift"):
+            dst.import_swap(8955, bad_geom)
+        # every rejection left the target untouched
+        assert not dst.swap_resident(8955)
+        assert dst.swap_stats["swap_import"] == 0
+        sched.close()
+
+    def test_flush_and_rebuild_count_orphaned_imports(self, setup):
+        m, params = setup
+        sched, _, payload, _ = self._mid_decode(m, params, uid=8956)
+        dst = _engine(m, params)
+        dst.import_swap(8956, payload)
+        dst.flush(8956)                 # dropped before it ever landed
+        assert not dst.swap_resident(8956)
+        assert dst.swap_stats["orphan_drops"] == 1
+        dst.import_swap(8956, payload)
+        dst.rebuild()                   # engine-loss recovery drops swaps
+        assert dst.swap_stats["orphan_drops"] == 2
+        assert not dst.swap_resident(8956)
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# cold restore of a role-configured pool
+# ---------------------------------------------------------------------------
+
+class TestDisaggRestore:
+    def test_restore_reapplies_roles_and_hands_off(self, setup, tmp_path):
+        m, params = setup
+        prompts, uids, gen = _workload(seed=103, n=5, gen=4)
+        ref = _reference(m, params, prompts, uids, gen)
+        roles = ["prefill", "decode"]
+        pool, _, _ = _disagg(
+            m, params, 2, roles,
+            journal_factory=lambda i: DurableRequestJournal(
+                DisaggPool.journal_path(str(tmp_path), i)))
+        for p, u in zip(prompts, uids):
+            pool.submit(p, max_new_tokens=gen, uid=u)
+        pool.step()                     # crash mid-prefill: no close()
+        live = sorted(u for rep in pool.replicas
+                      for u in rep.scheduler.journal.uids())
+        assert live
+
+        pool2 = DisaggPool.restore(
+            str(tmp_path), lambda i: _engine(m, params), roles=roles,
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        assert [r.role for r in pool2.replicas] == roles
+        assert isinstance(pool2, DisaggPool)
+        pool2.run_until_complete()
+        for uid in live:
+            req = pool2._requests[uid]
+            assert req.state is RequestState.DONE
+            assert req.tokens == ref[uid], f"uid {uid} diverged post-restore"
+        # the restored mid-prefill entries re-converged onto the role
+        # topology: prefilled on the prefill worker, handed off to decode
+        assert pool2.metrics.pool["handoffs"] >= 1
+        pool2.close()
+
+
+# ---------------------------------------------------------------------------
+# the disagg ownership sanitizer (satellite: planted violations)
+# ---------------------------------------------------------------------------
+
+class _Journal:
+    def __init__(self, uids=()):
+        self._uids = list(uids)
+
+    def uids(self):
+        return list(self._uids)
+
+
+class _Req:
+    def __init__(self, state):
+        self.state = state
+
+
+class TestDisaggSanitizer:
+    def test_two_owners_detected(self):
+        views = [(0, "prefill", _Journal([9001]), {})]
+        with pytest.raises(SanitizerError, match="two owners"):
+            check_disagg_ownership(views, {9001: None}, set())
+
+    def test_missed_handoff_detected_and_deferral_excused(self):
+        views = [(0, "prefill", _Journal(),
+                  {9002: _Req(RequestState.DECODE)})]
+        with pytest.raises(SanitizerError, match="handoff missed"):
+            check_disagg_ownership(views, {}, set())
+        check_disagg_ownership(views, {}, {9002})        # deferred: green
+        mixed = [(0, "mixed", _Journal(),
+                  {9002: _Req(RequestState.DECODE)})]
+        check_disagg_ownership(mixed, {}, set())         # mixed: green
+
+    def test_unconserved_payload_bytes_detected(self):
+        block = np.zeros(4, dtype=np.float32)            # 16 B
+        good = {9003: {"nbytes": 16, "blocks": [block]}}
+        check_disagg_ownership([], good, set())
+        bad = {9003: {"nbytes": 99, "blocks": [block]}}
+        with pytest.raises(SanitizerError, match="not conserved"):
+            check_disagg_ownership([], bad, set())
+
+    def test_armed_per_step_catches_planted_two_owners(self, setup,
+                                                       monkeypatch):
+        m, params = setup
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        pool, _, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        req = pool.submit([1, 2, 3, 4, 5, 6], max_new_tokens=3, uid=9004)
+        pool.step()                                      # green under check
+        pool._inflight_handoffs[9004] = None             # plant: two owners
+        with pytest.raises(SanitizerError, match="two owners"):
+            pool.step()
+        pool._inflight_handoffs.clear()
+        pool.run_until_complete()                        # green again
+        assert req.state is RequestState.DONE
+        pool.close()
+
+    def test_clean_disagg_run_green_under_sanitizer(self, setup,
+                                                    monkeypatch):
+        m, params = setup
+        monkeypatch.setenv("DSTPU_SANITIZE", "1")
+        prompts, uids, gen = _workload(seed=107, n=3, gen=3)
+        pool, _, _ = _disagg(m, params, 2, ["prefill", "decode"])
+        reqs = [pool.submit(p, max_new_tokens=gen, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        assert all(r.state is RequestState.DONE for r in reqs)
+        assert pool.metrics.pool["handoffs"] == len(uids)
+        pool.close()

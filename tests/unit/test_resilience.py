@@ -308,7 +308,7 @@ class TestChaosContainment:
         assert sched.metrics.faults["persistent_faults"] == 1
         assert sched.metrics.faults["containment_preemptions"] > 0
         assert inj.fired == {"transient": 5, "persistent": 1, "latency": 0,
-                             "device_lost": 0}
+                             "degraded": 0, "device_lost": 0}
         trans = [s for _, s in br.transitions]
         assert trans[:1] == ["open"] and "half_open" in trans
         assert trans[-1] == "closed"
