@@ -67,6 +67,7 @@ and promotion traffic.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -1756,6 +1757,261 @@ def run_transfer_overlap(max_seqs: int, prefix_cache: bool = True) -> dict:
     }
 
 
+def run_multi_tenant(max_seqs: int, prefix_cache: bool = True) -> dict:
+    """The multi-tenant QoS + elastic-scaling acceptance A/B
+    (docs/SERVING.md "Multi-tenant QoS" / "Elastic scaling"): ONE seeded
+    production trace (``serve.trace.generate_trace`` — per-tenant Poisson
+    bursts under a diurnal envelope, heavy-tailed prompts, three tenants
+    on the interactive/standard/batch SLO ladder) replayed in virtual
+    time against
+
+    - a **static** 2-replica :class:`EnginePool`, and
+    - an **elastic** pool (1..2 replicas) driven by
+      :class:`ElasticController` off the same load gauges,
+
+    both under the same shared :class:`TenantRegistry` (WFQ weights
+    4/2/1). The elastic arm rides the diurnal valley down to one replica,
+    so it must WIN on goodput per replica-second while staying bitwise
+    identical to the fault-free single-engine reference (scale-down
+    migration is lossless by construction). A third **aggressor** arm
+    re-generates the trace with the batch tenant at 10x its rate behind
+    its token-bucket limit: the aggressor throttles, the OTHER tenants'
+    arrivals are untouched (per-tenant independent streams) and their
+    p99 TTFT must hold within noise of the clean run — isolation means a
+    misbehaving tenant degrades only its own SLO class."""
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.resilience import RetryPolicy, TenantThrottledError
+    from deepspeed_tpu.serve import (ContinuousBatchScheduler,
+                                     ElasticController, EnginePool,
+                                     RequestState, TenantLoad, TenantRegistry,
+                                     generate_trace, jain_fairness)
+    from deepspeed_tpu.serve.pool import SERVING
+
+    cfg = gpt2_config("125m", max_seq_len=128, hidden_size=128,
+                      num_layers=2, num_heads=4, vocab_size=1024)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    DURATION = 10.0          # virtual seconds; diurnal valley at 3/4
+    DT = 0.1                 # virtual seconds per pool step
+    GEN = 6
+
+    def tenant_loads(batch_rate=0.8):
+        common = dict(prompt_len_median=24, prompt_len_sigma=0.5,
+                      prompt_len_max=64, max_new_tokens=GEN,
+                      shared_prefixes=2, shared_prefix_len=16)
+        return [
+            TenantLoad("t_inter", rate_hz=1.6, slo="interactive", **common),
+            TenantLoad("t_std", rate_hz=1.2, slo="standard", **common),
+            TenantLoad("t_batch", rate_hz=batch_rate, slo="batch", **common),
+        ]
+
+    trace = generate_trace(tenant_loads(), seed=101, duration_s=DURATION,
+                           vocab=1024)
+    # value-keyed (TraceRequest is frozen/hashable): the aggressor trace
+    # re-generates ONLY the batch stream, so its untouched tenants'
+    # requests hash-equal these and inherit the reference uids
+    uid_of = {}
+    for i, tr in enumerate(trace):
+        uid_of.setdefault(tr, 9000 + i)
+
+    def make_engine():
+        return InferenceEngineV2(
+            model, params, max_seqs=max_seqs, max_seq_len=128,
+            prefill_chunk=16, dtype=jnp.bfloat16, paged=True,
+            block_size=16, token_budget=32, num_blocks=1 + max_seqs * 12,
+            prefix_cache=prefix_cache)
+
+    # fault-free single-engine reference — the bitwise oracle for every
+    # arm (untenanted: QoS shapes order, never content)
+    ref_sched = ContinuousBatchScheduler(
+        make_engine(), max_queue=len(trace),
+        retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+    refs = [ref_sched.submit(list(tr.prompt), max_new_tokens=GEN, uid=u)
+            for tr, u in uid_of.items()]
+    ref_sched.run_until_complete()
+    assert all(r.state is RequestState.DONE for r in refs)
+    ref_tokens = {r.uid: list(r.tokens) for r in refs}
+    ref_sched.close()
+    gc.collect()
+    print(f"[multi_tenant] reference done: {len(refs)} requests",
+          file=sys.stderr, flush=True)
+
+    def registry(limit_batch=False):
+        reg = TenantRegistry()
+        reg.register("t_inter", weight=4.0, slo="interactive")
+        reg.register("t_std", weight=2.0, slo="standard")
+        # the aggressor arm arms the batch tenant's token bucket at its
+        # CLEAN peak offered rate (0.8 req/s x ~33 token cost/request) —
+        # honest load passes, the 10x flood throttles
+        reg.register("t_batch", weight=1.0, slo="batch",
+                     rate=(0.8 * 33 if limit_batch else None),
+                     burst=(4.0 * 33 if limit_batch else None))
+        return reg
+
+    class _Clock:
+        t = 0.0
+
+    def arm(name, the_trace, *, elastic, limit_batch=False):
+        clock = _Clock()
+        engines = {}
+
+        def factory(i):
+            engines[i] = make_engine()
+            return engines[i]
+
+        reg = registry(limit_batch)
+        pool = EnginePool.build(
+            factory, 1 if elastic else 2, clock=lambda: clock.t,
+            max_queue=len(the_trace), tenancy=reg,
+            retry=RetryPolicy(max_attempts=5), sleep=lambda s: None)
+        ctl = None
+        if elastic:
+            ctl = ElasticController(
+                pool, min_replicas=1, max_replicas=2,
+                capacity_per_replica=2, scale_up_at=0.75,
+                scale_down_at=0.2, backlog_high_tokens=8 * 16,
+                hysteresis_ticks=3, cooldown_s=1.0)
+        ttft = {}                      # uid -> virtual TTFT
+        throttled = {t: 0 for t in ("t_inter", "t_std", "t_batch")}
+        reqs, idx = [], 0
+        replica_seconds = 0.0
+        steps = 0
+        while True:
+            steps += 1
+            if steps % 200 == 0:
+                print(f"[multi_tenant] {name}: step {steps} vt={clock.t:.1f}"
+                      f" submitted={idx}/{len(the_trace)}",
+                      file=sys.stderr, flush=True)
+            while idx < len(the_trace) and the_trace[idx].at <= clock.t:
+                tr = the_trace[idx]
+                uid = uid_of.get(tr, 9500 + idx)
+                at = tr.at
+
+                def first_tok(req, _tok, at=at):
+                    # on_token(request, token); virtual TTFT at first emit
+                    ttft.setdefault(req.uid, clock.t - at)
+                try:
+                    reqs.append(pool.submit(
+                        list(tr.prompt), max_new_tokens=GEN, uid=uid,
+                        tenant=tr.tenant, slo=tr.slo, arrival_time=at,
+                        on_token=first_tok))
+                except TenantThrottledError:
+                    throttled[tr.tenant] += 1
+                idx += 1
+            n_serving = sum(1 for r in pool.replicas if r.state == SERVING)
+            busy = pool.step()
+            replica_seconds += n_serving * DT
+            clock.t += DT
+            if ctl is not None:
+                ctl.tick()
+            if not busy and idx >= len(the_trace):
+                break
+            # idle gaps are walked in DT steps (NOT fast-forwarded): the
+            # elastic controller only sees the diurnal valley — and can
+            # only earn its scale-downs — through consecutive idle ticks
+        assert all(r.state is RequestState.DONE for r in reqs)
+        bitwise = all(list(r.tokens) == ref_tokens[r.uid]
+                      for r in reqs if r.uid in ref_tokens)
+        by_tenant = {}
+        for r in reqs:
+            by_tenant.setdefault(r.tenant, []).append(ttft[r.uid])
+        offered = {}
+        for tr in the_trace:
+            offered[tr.tenant] = offered.get(tr.tenant, 0) + 1
+        tokens = sum(len(r.tokens) for r in reqs)
+        share = {t: (len(by_tenant.get(t, ())) / offered[t])
+                 for t in offered}
+        out = {
+            "arm": name,
+            "requests_offered": len(the_trace),
+            "requests_completed": len(reqs),
+            "throttled": dict(throttled),
+            "tokens": tokens,
+            "replica_seconds": round(replica_seconds, 2),
+            "goodput_per_replica_second": round(
+                tokens / replica_seconds, 2) if replica_seconds else 0.0,
+            "ttft_p99_virtual_s": {
+                t: round(float(np.percentile(v, 99)), 3)
+                for t, v in sorted(by_tenant.items())},
+            "jain_fairness_completion_share": round(
+                jain_fairness(share), 4),
+            "tokens_bitwise_identical": bitwise,
+        }
+        if ctl is not None:
+            out["scaling"] = {**ctl.counters,
+                              "final_replicas": len(pool.replicas)}
+        pool.close()
+        del pool, engines
+        gc.collect()
+        print(f"[multi_tenant] arm {name} done: {out['requests_completed']}"
+              f"/{out['requests_offered']} completed, "
+              f"{out['replica_seconds']} replica-s",
+              file=sys.stderr, flush=True)
+        return out
+
+    static = arm("static_2x", trace, elastic=False)
+    elastic = arm("elastic_1to2", trace, elastic=True)
+    # the aggressor trace: ONLY the batch tenant's stream changes (10x
+    # rate behind its bucket); the other tenants' arrivals are identical
+    aggro_trace = generate_trace(tenant_loads(batch_rate=8.0), seed=101,
+                                 duration_s=DURATION, vocab=1024)
+    aggro = arm("batch_aggressor_10x", aggro_trace, elastic=False,
+                limit_batch=True)
+
+    # acceptance gates (ISSUE 18): every arm bitwise vs the single-engine
+    # oracle; elastic wins goodput/replica-second by riding the valley;
+    # the aggressor only hurts itself — its flood throttles, the other
+    # tenants' tail latency holds within noise of the clean run
+    assert static["tokens_bitwise_identical"], static
+    assert elastic["tokens_bitwise_identical"], elastic
+    assert aggro["tokens_bitwise_identical"], aggro
+    assert static["requests_completed"] == static["requests_offered"]
+    assert elastic["requests_completed"] == elastic["requests_offered"]
+    assert elastic["goodput_per_replica_second"] > \
+        static["goodput_per_replica_second"], (elastic, static)
+    assert elastic["scaling"]["ups"] >= 1 and \
+        elastic["scaling"]["downs"] >= 1, elastic["scaling"]
+    assert aggro["throttled"]["t_batch"] > 0, aggro
+    assert aggro["throttled"]["t_inter"] == 0
+    assert aggro["throttled"]["t_std"] == 0
+    for t in ("t_inter", "t_std"):
+        clean = static["ttft_p99_virtual_s"][t]
+        under = aggro["ttft_p99_virtual_s"][t]
+        assert under <= max(clean * 2.0, clean + 0.5), (t, clean, under)
+    return {
+        "metric": _metric_name("paged", max_seqs, "multi_tenant",
+                               prefix_cache),
+        "value": elastic["goodput_per_replica_second"],
+        "unit": "tokens/replica-s",
+        "vs_baseline": round(
+            elastic["goodput_per_replica_second"]
+            / static["goodput_per_replica_second"], 3)
+        if static["goodput_per_replica_second"] else None,
+        "detail": {
+            "mode": "paged", "max_seqs": max_seqs,
+            "model": ("gpt2-pool-micro bf16 {'hidden_size': 128, "
+                      "'num_layers': 2, 'num_heads': 4, 'vocab_size': "
+                      "1024} ctx=128 (trace-replay QoS/elastic A/B)"),
+            "workload": (f"seeded trace: 3 tenants (WFQ 4/2/1, "
+                         f"interactive/standard/batch), diurnal Poisson "
+                         f"bursts over {DURATION:.0f} virtual s, "
+                         f"lognormal prompts <=64, gen {GEN}; static 2x "
+                         "vs elastic 1..2 replicas; batch-aggressor 10x "
+                         "isolation twin"),
+            "static_2x": static, "elastic_1to2": elastic,
+            "batch_aggressor_10x": aggro,
+            "tokens_bitwise_identical": True,
+        },
+    }
+
+
 def _metric_name(mode: str, max_seqs: int, workload: str,
                  prefix_cache: bool) -> str:
     name = f"serve_{mode}_{max_seqs}seq"
@@ -1823,6 +2079,14 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
       (``DisaggPool``, KV-transfer handoff) vs 3 mixed replicas at equal
       chip count — TTFT p99 must improve, every long prompt must hand
       off by KV transfer, tokens bitwise both arms.
+    - ``multi_tenant``: the multi-tenant QoS + elastic-scaling A/B
+      (docs/SERVING.md "Multi-tenant QoS" / "Elastic scaling"): one
+      seeded diurnal production trace (3 tenants, WFQ 4/2/1 on the
+      interactive/standard/batch ladder) replayed in virtual time on a
+      static 2-replica pool vs an ElasticController-driven 1..2 pool —
+      goodput per replica-second must improve, tokens bitwise both arms
+      — plus a 10x batch-aggressor twin where only the aggressor
+      throttles and the other tenants' p99 TTFT holds.
     - ``kv_tier`` (``--kv-tier``): the two-tier KV cache acceptance A/B
       (docs/PREFIX_CACHING.md "Two-tier cache"): a shared-prefix
       priority-mix workload over an overcommitted device pool, host tier
@@ -1877,6 +2141,8 @@ def run_config(mode: str, max_seqs: int, workload: str = "mixed",
         return run_pool_health(max_seqs, prefix_cache)
     if workload == "disagg":
         return run_disagg(max_seqs, prefix_cache)
+    if workload == "multi_tenant":
+        return run_multi_tenant(max_seqs, prefix_cache)
     if workload == "kv_tier":
         return run_kv_tier(max_seqs, prefix_cache)
     if workload == "transfer_overlap":
@@ -2024,6 +2290,7 @@ CONFIGS = (
     ("paged", 4, "pool_scaling", True),
     ("paged", 4, "pool_health", True),
     ("paged", 4, "disagg", True),
+    ("paged", 4, "multi_tenant", True),
 )
 
 

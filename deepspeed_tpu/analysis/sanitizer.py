@@ -628,6 +628,68 @@ def check_pool_health(replica_views, owner: Dict[int, int],
                              + "; ".join(problems))
 
 
+def check_tenant_accounting(replica_engines, registry) -> None:
+    """Multi-tenant QoS invariants (docs/SERVING.md "Multi-tenant QoS"),
+    armed per ``pool.step`` when a tenancy registry is wired.
+    ``replica_engines`` is a list of ``(replica_id, engine)`` for every
+    non-dead replica; ``registry`` duck-types ``TenantRegistry``
+    (``tenants()`` → specs with ``tenant_id`` / ``cache_blocks``,
+    ``outstanding(tid)``). Violations this catches:
+
+    - a tenant's AT-REST cached blocks exceeding its quota while an
+      evictable leaf of its own still exists — ``_enforce_quota`` was
+      skipped or its eviction miscounted (pure interior/pinned overage
+      is legal: evicting it would dangle other tenants' chains);
+    - a block manager's per-tenant at-rest ledger disagreeing with a
+      recount of its block-owner map — an incremental charge/uncharge
+      hook was missed (the drift that quota decisions silently feed on);
+    - a negative outstanding-request count can never appear (sets), but a
+      tenant with NO registered spec holding outstanding slots means a
+      release outlived its registration.
+
+    Duck-typed: engines without a paged block manager contribute nothing.
+    """
+    problems: List[str] = []
+    known = {s.tenant_id for s in registry.tenants()}
+    for rid, engine in replica_engines:
+        mgr = getattr(engine, "block_mgr", None)
+        if mgr is None or not hasattr(mgr, "_block_owner"):
+            continue
+        ref = mgr._ref
+        rest: Dict[str, int] = {}
+        for b, o in mgr._block_owner.items():
+            if b not in ref:
+                rest[o] = rest.get(o, 0) + 1
+        if rest != mgr._owner_rest:
+            problems.append(
+                f"replica {rid}: per-tenant at-rest ledger "
+                f"{mgr._owner_rest} != recount {rest} — a charge/uncharge "
+                "hook was missed")
+        for owner, quota in mgr._owner_quota.items():
+            over = rest.get(owner, 0) - quota
+            if over <= 0:
+                continue
+            evictable = any(
+                mgr._block_owner.get(b) == owner
+                and not mgr._children.get(b)
+                for tier in (mgr._lru, mgr._host, mgr._nvme)
+                for b in tier)
+            if evictable:
+                problems.append(
+                    f"replica {rid}: tenant {owner!r} is {over} block(s) "
+                    f"over its cache quota ({quota}) with an evictable "
+                    "leaf of its own still resident — quota enforcement "
+                    "skipped")
+    for tid in list(getattr(registry, "_outstanding", {})):
+        if tid not in known and registry.outstanding(tid):
+            problems.append(
+                f"unregistered tenant {tid!r} holds "
+                f"{registry.outstanding(tid)} outstanding slot(s)")
+    if problems:
+        raise SanitizerError("[sanitizer] tenant accounting violation: "
+                             + "; ".join(problems))
+
+
 def check_disagg_ownership(replica_views, handoffs,
                            deferred) -> None:
     """Disaggregated-serving invariants (docs/SERVING.md "Disaggregated

@@ -259,6 +259,20 @@ class InferenceEngineV2:
             return 0
         return self.block_mgr.probe(tokens)
 
+    def set_kv_owner(self, uid: int, owner: str) -> None:
+        """Tag ``uid``'s KV blocks with a tenant id so the block manager can
+        bill its cached prefixes against that tenant's quota. No-op on slot
+        engines — there is no shared cache to account."""
+        if self.paged:
+            self.block_mgr.set_seq_owner(uid, owner)
+
+    def set_kv_quota(self, owner: str, max_blocks) -> None:
+        """Cap ``owner``'s at-rest prefix-cache blocks (``None`` lifts the
+        cap). The scheduler re-pushes quotas after every rebuild — the fresh
+        block manager starts with an empty ledger."""
+        if self.paged:
+            self.block_mgr.set_owner_quota(owner, max_blocks)
+
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------

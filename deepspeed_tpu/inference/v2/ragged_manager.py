@@ -54,7 +54,7 @@ NVMe-resident and subtree drops never dangle an edge.
 
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -158,7 +158,20 @@ class BlockedKVCache:
                       "demoted_blocks": 0, "promoted_blocks": 0,
                       "host_evicted_blocks": 0, "nvme_spilled_blocks": 0,
                       "nvme_loaded_blocks": 0, "nvme_evicted_blocks": 0,
-                      "nvme_corrupt_blocks": 0}
+                      "nvme_corrupt_blocks": 0, "quota_evicted_blocks": 0}
+        # -- multi-tenant cache quotas (docs/SERVING.md "Multi-tenant QoS").
+        # Ownership is charged when a block is first INDEXED (the first
+        # registering tenant keeps the charge on dedup — shared content is
+        # billed once) and follows the block across tier moves (_rekey).
+        # The quota bounds a tenant's AT-REST footprint: indexed blocks no
+        # live sequence references (_lru / host / NVMe residents). Blocks
+        # pinned by live refs are working set, not cache, and are never
+        # quota-evicted. All four maps stay empty on untenanted engines —
+        # every hook below is then a dict miss, zero behavior change.
+        self._seq_owner: Dict[int, str] = {}     # uid -> tenant
+        self._block_owner: Dict[int, str] = {}   # block (any tier) -> tenant
+        self._owner_quota: Dict[str, int] = {}   # tenant -> max at-rest blocks
+        self._owner_rest: Dict[str, int] = {}    # tenant -> at-rest blocks now
 
     @property
     def free_blocks(self) -> int:
@@ -192,6 +205,7 @@ class BlockedKVCache:
     def _incref(self, block: int):
         if block in self._lru:  # cached block comes back into use
             del self._lru[block]
+            self._rest_uncharge(block)
         self._ref[block] = self._ref.get(block, 0) + 1
 
     def _decref(self, block: int):
@@ -206,10 +220,103 @@ class BlockedKVCache:
             # still carries indexed prefix content: park in the LRU (MRU end)
             # rather than the free list so future prompts can hit it
             self._lru[block] = None
+            owner = self._block_owner.get(block)
+            if owner is not None:
+                self._owner_rest[owner] = self._owner_rest.get(owner, 0) + 1
+                self._enforce_quota(owner)
         else:
             self._free.append(block)
 
+    # ------------------------------------------------------------------
+    # per-tenant at-rest accounting (see __init__ for the model)
+    # ------------------------------------------------------------------
+    def _rest_uncharge(self, block: int) -> None:
+        owner = self._block_owner.get(block)
+        if owner is not None:
+            n = self._owner_rest.get(owner, 0) - 1
+            if n > 0:
+                self._owner_rest[owner] = n
+            else:
+                self._owner_rest.pop(owner, None)
+
+    def _enforce_quota(self, owner: str) -> None:
+        """Shrink ``owner``'s at-rest footprint back under its quota by
+        destructively evicting its own oldest cached leaves — never another
+        tenant's. A tenant may sit OVER quota when every overage block is
+        interior (anchors children, possibly another tenant's extensions) —
+        eviction would dangle the chain, so the overage is tolerated until
+        the subtree unwinds; the sanitizer only flags over-quota tenants
+        that still hold an evictable leaf."""
+        quota = self._owner_quota.get(owner)
+        if quota is None:
+            return
+        while (self._owner_rest.get(owner, 0) > quota
+               and self._evict_owner_one(owner)):
+            pass
+
+    def _evict_owner_one(self, owner: str, device_only: bool = False) -> bool:
+        """Destroy one of ``owner``'s at-rest leaf blocks, oldest first,
+        coldest tier last only for ``device_only`` (allocation needs a
+        *device* block): LRU, then host, then NVMe. Destructive on every
+        tier — a quota is a bound on retained content, demoting would just
+        move the overage down a tier."""
+        for b in self._lru:  # oldest → newest
+            if self._block_owner.get(b) == owner and not self._children.get(b):
+                del self._lru[b]
+                self._unindex(b)
+                self.stats["evicted_blocks"] += 1
+                self.stats["quota_evicted_blocks"] += 1
+                self._free.append(b)
+                return True
+        if device_only:
+            return False
+        for b in self._host:
+            if self._block_owner.get(b) == owner and not self._children.get(b):
+                self._drop_payload(self._host[b])
+                self._unindex(b)
+                del self._host[b]
+                self.stats["host_evicted_blocks"] += 1
+                self.stats["quota_evicted_blocks"] += 1
+                return True
+        for b in self._nvme:
+            if self._block_owner.get(b) == owner and not self._children.get(b):
+                self._unindex(b)
+                del self._nvme[b]
+                if self.drop_fn is not None:
+                    self.drop_fn(b)
+                self.stats["nvme_evicted_blocks"] += 1
+                self.stats["quota_evicted_blocks"] += 1
+                return True
+        return False
+
+    def set_seq_owner(self, uid: int, owner: str) -> None:
+        """Tag sequence ``uid``'s future index registrations with ``owner``
+        (the tenant id). Called by the scheduler at admission, before the
+        first prefill step registers blocks."""
+        self._seq_owner[uid] = owner
+
+    def set_owner_quota(self, owner: str, max_blocks: Optional[int]) -> None:
+        """Cap ``owner``'s at-rest cached blocks; ``None`` lifts the cap.
+        Takes effect immediately: a lowered quota evicts down on the spot."""
+        if max_blocks is None:
+            self._owner_quota.pop(owner, None)
+            return
+        self._owner_quota[owner] = int(max_blocks)
+        self._enforce_quota(owner)
+
+    def owner_view(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant accounting snapshot for metrics / the sanitizer."""
+        out: Dict[str, Dict[str, int]] = {}
+        for o in set(self._owner_rest) | set(self._owner_quota):
+            out[o] = {"at_rest": self._owner_rest.get(o, 0)}
+            if o in self._owner_quota:
+                out[o]["quota"] = self._owner_quota[o]
+        return out
+
     def _unindex(self, block: int):
+        if block not in self._ref:  # at rest in some tier: leave the ledger
+            self._rest_uncharge(block)
+        self._block_owner.pop(block, None)
         key, parent = self._meta.pop(block)
         del self._index[key]
         if parent != _ROOT:
@@ -229,6 +336,9 @@ class BlockedKVCache:
         key, parent = self._meta.pop(old)
         self._index[key] = new
         self._meta[new] = (key, parent)
+        owner = self._block_owner.pop(old, None)
+        if owner is not None:  # the charge follows the content across tiers
+            self._block_owner[new] = owner
         if parent != _ROOT:
             kids = self._children.get(parent)
             if kids is not None:
@@ -366,6 +476,7 @@ class BlockedKVCache:
             if self.drop_fn is not None:
                 self.drop_fn(hid)  # promoted: the disk copy is now stale
             self._rekey(hid, dst)
+            self._rest_uncharge(dst)  # promoted into a live chain: in use
             self._pending_promotions.append((payload, dst))
             self.stats["nvme_loaded_blocks"] += 1
             self.stats["promoted_blocks"] += 1
@@ -377,6 +488,7 @@ class BlockedKVCache:
             self._host[hid] = payload  # re-shelve (MRU end) and give up
             return None
         self._rekey(hid, dst)
+        self._rest_uncharge(dst)  # promoted into a live chain: in use
         self._pending_promotions.append((payload, dst))
         self.stats["promoted_blocks"] += 1
         return dst
@@ -446,7 +558,17 @@ class BlockedKVCache:
             self._evict_one(demote=False)
 
     def _allocate(self, uid: int) -> int:
+        owner = self._seq_owner.get(uid)
         while not self._free:
+            # A tenant allocating AT its cache budget reclaims its own
+            # at-rest device blocks first — its hot prompt churns its own
+            # budget, never another tenant's cached prefixes.
+            if (owner is not None
+                    and owner in self._owner_quota
+                    and self._owner_rest.get(owner, 0)
+                    >= self._owner_quota[owner]
+                    and self._evict_owner_one(owner, device_only=True)):
+                continue
             if not self._evict_one():
                 # typed capacity signal (message kept for compat): the
                 # scheduler dispatches on the type, not the string
@@ -509,6 +631,7 @@ class BlockedKVCache:
         desc.blocks = []
         desc.history = []
         desc.n_indexed = 0
+        self._seq_owner.pop(desc.uid, None)
 
     # ------------------------------------------------------------------
     # prefix cache: lookup / copy-on-write / registration
@@ -633,6 +756,7 @@ class BlockedKVCache:
                 else:
                     self._drop_payload(self._host.pop(existing, None))
                 self._rekey(existing, own)
+                self._rest_uncharge(own)  # adopted into a live chain: in use
                 self.stats["dedup_blocks"] += 1
             elif existing is not None and existing != own:
                 self._incref(existing)
@@ -644,6 +768,11 @@ class BlockedKVCache:
                 self._meta[own] = (key, parent)
                 if parent != _ROOT:
                     self._children.setdefault(parent, set()).add(own)
+                # First indexer owns the block: shared content is billed to
+                # whoever cached it first, later dedup hits ride for free.
+                o = self._seq_owner.get(desc.uid)
+                if o is not None:
+                    self._block_owner[own] = o
             desc.n_indexed = j + 1
 
     # ------------------------------------------------------------------
@@ -690,6 +819,14 @@ class BlockedKVCache:
                     "children edge without matching meta parent"
         for _, dst in self._pending_promotions:
             assert dst in ref, "pending promotion targets an unreferenced block"
+        assert set(self._block_owner) <= set(self._meta), \
+            "owned block missing from the index"
+        rest: Dict[str, int] = {}
+        for b, o in self._block_owner.items():
+            if b not in ref:
+                rest[o] = rest.get(o, 0) + 1
+        assert rest == self._owner_rest, (
+            f"per-tenant at-rest ledger {self._owner_rest} != recount {rest}")
         descs = list(descs)
         if descs:
             counted: Dict[int, int] = {}

@@ -11,7 +11,8 @@ from .breaker import BreakerState, CircuitBreaker  # noqa: F401
 from .errors import (CheckpointCorruptError,  # noqa: F401
                      ContextOverflowError, DeadlineShedError,
                      DeviceLostError, EngineUsageError, PoolExhaustedError,
-                     ReplicaLostError, RequestFailedError, SheddingError,
+                     QuotaExceededError, ReplicaLostError,
+                     RequestFailedError, SheddingError, TenantThrottledError,
                      TransientEngineError, UnrecoverableEngineError,
                      WatchdogTimeoutError)
 from .faults import (ALL_SITES, SITES, TRAIN_SITES,  # noqa: F401

@@ -107,6 +107,32 @@ class DeadlineShedError(SheddingError):
         self.remaining_s = remaining_s
 
 
+class TenantThrottledError(SheddingError):
+    """Per-tenant token-bucket rate limit hit at admission
+    (docs/SERVING.md "Multi-tenant QoS"): the tenant's bucket cannot cover
+    this request's cost. Subclasses :class:`SheddingError` — shed handling
+    keeps working; ``tenant`` names the throttled flow and
+    ``retry_after_s`` how long the bucket needs to refill the shortfall."""
+
+    def __init__(self, message: str, tenant: str = "",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class QuotaExceededError(SheddingError):
+    """A tenant's hard admission quota is exhausted (max outstanding
+    requests): unlike a throttle, no amount of waiting on THIS replica
+    helps until the tenant's own requests finish — and unlike
+    ``QueueFullError`` the pool must not retry it elsewhere (the quota is
+    tenant-global, not per-replica). ``tenant`` names the flow."""
+
+    def __init__(self, message: str, tenant: str = ""):
+        super().__init__(message)
+        self.tenant = tenant
+
+
 class WatchdogTimeoutError(RuntimeError):
     """A step (or the close() drain) exceeded its wall-clock budget past the
     point of escalation. Raised only where there is no in-band way to keep

@@ -350,6 +350,12 @@ class HealthMonitor:
         if rec is not None:
             rec.state = LOST
 
+    def note_retired(self, replica_id: int) -> None:
+        """The pool retired this replica on purpose (elastic scale-down):
+        forget its record entirely — a deliberate retirement is not a
+        loss and must not read as one in the summary."""
+        self._replicas.pop(replica_id, None)
+
     def note_revived(self, replica_id: int,
                      now: Optional[float] = None) -> None:
         """An explicit ``pool.revive`` brought the replica back: fresh

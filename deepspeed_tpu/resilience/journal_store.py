@@ -37,6 +37,11 @@ meta → CRC32-verified manifest written LAST) to an append-only stream:
   written by this version are readable by pre-sampling readers for
   every greedy request (v2 kinds fold to nothing there — the documented
   unknown-kind rule — losing only the sampled requests they describe).
+  ``record.v3`` / ``adopt.v3`` extend the ladder with the multi-tenant
+  identity payload (``tenant`` + ``slo``, sampling included when
+  present): tenant attribution survives preempt, migration, death
+  replay, and host-crash restore, while untenanted entries keep their
+  v1/v2 bytes pinned.
 
 Writes are flushed per append (the commit path is the per-token hot path
 the DSTPU rules police: one buffered ``write`` + ``flush``, no fsync by
@@ -167,7 +172,8 @@ class DurableRequestJournal(RequestJournal):
 
     def _fold(self, rec: dict) -> None:
         kind = rec["kind"]
-        if kind in ("record", "adopt", "record.v2", "adopt.v2"):
+        if kind in ("record", "adopt", "record.v2", "adopt.v2",
+                    "record.v3", "adopt.v3"):
             sampling = None
             if "sampling" in rec:
                 # lazy import: resilience stays importable without serve
@@ -180,7 +186,8 @@ class DurableRequestJournal(RequestJournal):
                 max_new_tokens=rec["max_new_tokens"],
                 priority=rec["priority"], deadline=rec["deadline"],
                 arrival_time=rec["arrival_time"], eos_token=rec["eos_token"],
-                sampling=sampling)
+                sampling=sampling, tenant=rec.get("tenant"),
+                slo=rec.get("slo"))
             self._entries[e.uid] = e
         elif kind == "commit":
             e = self._entries.get(rec["uid"])
@@ -211,9 +218,24 @@ class DurableRequestJournal(RequestJournal):
                "deadline": e.deadline, "arrival_time": e.arrival_time,
                "eos_token": e.eos_token}
         sp = getattr(e, "sampling", None)
-        if sp is not None:
-            # versioned kind: ONLY sampled entries pay the format bump —
-            # greedy logs stay byte-identical to the pre-sampling framing
+        tenant = getattr(e, "tenant", None)
+        if tenant is not None:
+            # versioned kind ladder: a tenant-tagged entry is .v3 (tenant
+            # + SLO class, sampling when present); a sampled untenanted
+            # entry stays .v2; a plain greedy untenanted entry keeps the
+            # original framing byte for byte. Older readers fold unknown
+            # .v3 kinds to nothing — the documented forward-compat rule —
+            # losing only the tenant-tagged requests they describe.
+            rec["kind"] = kind + ".v3"
+            rec["tenant"] = tenant
+            slo = getattr(e, "slo", None)
+            if slo is not None:
+                rec["slo"] = slo
+            if sp is not None:
+                rec["sampling"] = sp.to_dict()
+        elif sp is not None:
+            # ONLY sampled entries pay the format bump — greedy logs stay
+            # byte-identical to the pre-sampling framing
             rec["kind"] = kind + ".v2"
             rec["sampling"] = sp.to_dict()
         return rec

@@ -129,6 +129,7 @@ class AdaptiveLimit:
     # ------------------------------------------------------------------
     def view(self) -> Dict[str, float]:
         return {"limit": self.limit, "inflight": float(self.inflight),
+                "headroom": float(self.headroom()),
                 "min_rtt_s": self.min_rtt or 0.0,
                 "grows": self.grows, "shrinks": self.shrinks}
 

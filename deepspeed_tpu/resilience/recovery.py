@@ -58,6 +58,13 @@ class JournalEntry:
     #: contract — ``None`` stays plain greedy and serializes exactly as
     #: the pre-sampling journal format did
     sampling: Optional[object] = None
+    #: multi-tenant QoS identity (docs/SERVING.md "Multi-tenant QoS"):
+    #: owning tenant id + resolved SLO-class name. Serialized as the
+    #: ``record.v3``/``adopt.v3`` journal kinds so tenant attribution
+    #: survives preempt, migration, death replay, and host-crash restore;
+    #: ``None``/``None`` keeps the exact pre-tenancy byte format.
+    tenant: Optional[str] = None
+    slo: Optional[str] = None
     commits: int = field(default=0, compare=False)  # commit points synced
     #: migration payload (docs/SERVING.md engine pool): ``detach`` attaches
     #: the live ``Request`` object so the adopting scheduler keeps serving
@@ -112,7 +119,9 @@ class RequestJournal:
                          priority=req.priority, deadline=req.deadline,
                          arrival_time=req.arrival_time,
                          eos_token=req.eos_token,
-                         sampling=getattr(req, "sampling", None))
+                         sampling=getattr(req, "sampling", None),
+                         tenant=getattr(req, "tenant", None),
+                         slo=getattr(req, "slo", None))
         self._entries[req.uid] = e
         self.records += 1
         return e

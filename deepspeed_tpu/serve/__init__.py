@@ -16,10 +16,15 @@ from ..resilience import (AdaptiveLimit, CircuitBreaker,  # noqa: F401
                           RequestFailedError, RetryPolicy, SheddingError,
                           StepWatchdog, TransientEngineError)
 from .disagg import ROLES, DisaggPool  # noqa: F401
+from .elastic import ElasticController  # noqa: F401
 from .metrics import PoolMetrics, ServeMetrics  # noqa: F401
 from .pool import EnginePool, Replica  # noqa: F401
 from .request import Request, RequestState  # noqa: F401
 from .router import PHASE_ROLES, Router  # noqa: F401
+from .tenancy import (DEFAULT_SLO_CLASSES, SLOClass,  # noqa: F401
+                      TenantRegistry, TenantSpec)
+from .trace import (TenantLoad, TraceRequest,  # noqa: F401
+                    generate_trace, jain_fairness)
 from .sampling import (LogitProcessor, SamplingParams,  # noqa: F401
                        StopScanner, combined_bias)
 from .scheduler import (ContinuousBatchScheduler, QueueFullError,  # noqa: F401

@@ -87,6 +87,12 @@ class ServeMetrics:
             "steps": 0, "proposed_tokens": 0, "accepted_tokens": 0,
             "bonus_tokens": 0, "rollback_tokens": 0, "degraded_steps": 0,
             "acceptance_rate": 0.0, "draft_horizon": 0.0}
+        #: multi-tenant QoS counters (docs/SERVING.md "Multi-tenant QoS"),
+        #: exported under ``serve/tenant/<tenant>/<k>``: per-tenant
+        #: admission outcomes (submitted/admitted/throttled/quota_rejects)
+        #: and token production. Empty — zero event-stream cost — on
+        #: untenanted schedulers.
+        self.tenant: Dict[str, Dict[str, float]] = {}
         #: KV-tier counters (docs/PREFIX_CACHING.md "Two-tier cache"),
         #: exported under ``serve/kvtier/*``: engine-side tier traffic
         #: (demotions/promotions/host evictions, swap round trips and their
@@ -201,6 +207,12 @@ class ServeMetrics:
 
     def observe_stop_hit(self) -> None:
         self.sampling["stop_hits"] += 1
+
+    def observe_tenant(self, tenant: str, key: str, n: float = 1.0) -> None:
+        """Bump one per-tenant counter (lazily created — tenants appear in
+        the event stream the first time they act on this replica)."""
+        d = self.tenant.setdefault(tenant, {})
+        d[key] = d.get(key, 0.0) + n
 
     def observe_bias_refresh(self) -> None:
         self.sampling["bias_refreshes"] += 1
@@ -322,6 +334,9 @@ class ServeMetrics:
                        "swap_readmit_p95_ms": round(
                            self._pct(self.swap_readmit_s, 95) * 1000, 3),
                    }.items())]
+                + [(f"{p}tenant/{t}/{k}", float(v), step)
+                   for t in sorted(self.tenant)
+                   for k, v in sorted(self.tenant[t].items())]
                 + [(f"{p}faults/{k}", float(v), step)
                    for k, v in sorted(self.faults.items())])
 
@@ -362,6 +377,10 @@ class PoolMetrics:
             "restored_requests": 0,    # live requests replayed at restore
             # disaggregated prefill/decode serving (docs/SERVING.md
             # "Disaggregated serving")
+            # elastic scaling (docs/SERVING.md "Elastic scaling")
+            "scale_ups": 0,            # replicas added by scale_to()
+            "scale_downs": 0,          # replicas retired by scale_to()
+            "scale_up_failures": 0,    # factory failures absorbed mid-grow
             "handoffs": 0,             # prefill->decode moves completed
             "handoffs_kv": 0,          # ... that moved KV (vs replay)
             "handoff_bytes": 0,        # KV bytes moved by handoffs
@@ -405,6 +424,11 @@ class PoolMetrics:
 
     def observe_limit_reject(self) -> None:
         self.pool["limit_rejects"] += 1
+
+    def observe_scale(self, grew: int, shrank: int, failed: int) -> None:
+        self.pool["scale_ups"] += grew
+        self.pool["scale_downs"] += shrank
+        self.pool["scale_up_failures"] += failed
 
     def observe_restore(self, restored: int) -> None:
         self.pool["restores"] += 1

@@ -154,6 +154,15 @@ class EnginePool:
         #: gray-failure detector (resilience.health) — None until
         #: :meth:`enable_health` arms it
         self.health_monitor: Optional[HealthMonitor] = None
+        #: elastic-scaling recipe (docs/SERVING.md "Elastic scaling") —
+        #: :meth:`build` records how it made its replicas so
+        #: :meth:`scale_to` can stamp out more of the same; pools built
+        #: from pre-made schedulers can only shrink
+        self._engine_factory = None
+        self._journal_factory = None
+        self._scheduler_kw: Dict[str, object] = {}
+        self._limit_factory: Optional[Callable[[int], AdaptiveLimit]] = None
+        self._limits_enabled = False
         self._closed = False
 
     @classmethod
@@ -176,7 +185,12 @@ class EnginePool:
             scheds.append(ContinuousBatchScheduler(
                 engine_factory(i), replica_id=i, escalate_losses=True,
                 clock=clock, **kw))
-        return cls(scheds, router=router, recovery=recovery, clock=clock)
+        pool = cls(scheds, router=router, recovery=recovery, clock=clock)
+        # retain the recipe: scale_to() grows the pool by replaying it
+        pool._engine_factory = engine_factory
+        pool._journal_factory = journal_factory
+        pool._scheduler_kw = dict(scheduler_kw)
+        return pool
 
     # ------------------------------------------------------------------
     # cold-start restore (docs/RESILIENCE.md "Health & overload")
@@ -287,6 +301,8 @@ class EnginePool:
         :class:`AdaptiveLimit` (default-configured when omitted). The
         ledger is seeded with the requests each replica already owns, so
         arming mid-flight conserves the accounting invariant."""
+        self._limit_factory = factory
+        self._limits_enabled = True
         for rep in self.replicas:
             rep.limit = (AdaptiveLimit() if factory is None
                          else factory(rep.replica_id))
@@ -391,6 +407,15 @@ class EnginePool:
                 [(r.replica_id, r.scheduler.journal, r.scheduler._all)
                  for r in self.replicas if r.state != DEAD],
                 self._owner)
+            tenancy = next((r.scheduler.tenancy for r in self.replicas
+                            if getattr(r.scheduler, "tenancy", None)
+                            is not None), None)
+            if tenancy is not None:
+                # tenanted pools: per-tenant cache-quota + outstanding-slot
+                # accounting must hold on every non-dead block manager
+                _sanitizer.check_tenant_accounting(
+                    [(r.replica_id, r.engine) for r in self.replicas
+                     if r.state != DEAD], tenancy)
             if self.health_monitor is not None or any(
                     r.limit is not None for r in self.replicas):
                 _sanitizer.check_pool_health(
@@ -669,6 +694,107 @@ class EnginePool:
                 self.step()
 
     # ------------------------------------------------------------------
+    # elastic scaling (docs/SERVING.md "Elastic scaling")
+    # ------------------------------------------------------------------
+    def scale_to(self, n: int) -> int:
+        """Elastic resize to ``n`` SERVING replicas, composed entirely
+        from verbs the pool already proves correct:
+
+        * **grow** — stamp out fresh replicas from the :meth:`build`
+          recipe (engine/journal factories + scheduler kwargs) and enter
+          them into rotation exactly like an undrain: armed supervision
+          (health monitor, adaptive limit, dispatch tap) and the shared
+          tenancy registry's cache quotas attach before the router may
+          offer them. A factory failure mid-grow is absorbed the way a
+          replica death is — counted, logged, the pool continues at
+          whatever size it reached; it never raises mid-resize.
+        * **shrink** — the highest-id serving replicas drain (every owned
+          request migrates to survivors over the journal handoff — the
+          same bitwise-lossless path drain/death replay use) and then
+          retire: removed from membership, scheduler closed, supervision
+          record dropped. In-flight work is never cancelled by a resize.
+
+        Returns the signed change in serving replicas actually achieved
+        (grow failures make it smaller than requested). DRAINING and DEAD
+        replicas are not counted and not touched — quarantine and revival
+        stay the health monitor's business."""
+        if self._closed:
+            raise SchedulerClosedError("pool is closed")
+        if n < 1:
+            raise ValueError(f"cannot scale to {n} replicas (min 1)")
+        current = len(self._serving())
+        if n > current:
+            return self._grow(n - current)
+        if n < current:
+            return -self._shrink(current - n)
+        return 0
+
+    def _grow(self, k: int) -> int:
+        if self._engine_factory is None:
+            raise EngineUsageError(
+                "scale-up needs the build() recipe: this pool was "
+                "constructed from pre-built schedulers — it can shrink "
+                "but not grow")
+        grew = failed = 0
+        next_id = max(r.replica_id for r in self.replicas) + 1
+        for rid in range(next_id, next_id + k):
+            kw = dict(self._scheduler_kw)
+            if self._journal_factory is not None:
+                kw["journal"] = self._journal_factory(rid)
+            try:
+                engine = self._engine_factory(rid)
+            except Exception as e:  # absorbed: death of a replica-to-be
+                failed += 1
+                logger.warning(
+                    "pool: scale-up replica %d failed to build (%s: %s) — "
+                    "absorbed, pool continues at current size",
+                    rid, type(e).__name__, e)
+                continue
+            sched = ContinuousBatchScheduler(
+                engine, replica_id=rid, escalate_losses=True,
+                clock=self._clock, **kw)
+            sched.metrics.replica_id = rid
+            rep = Replica(rid, sched)
+            if self._limits_enabled:
+                rep.limit = (AdaptiveLimit() if self._limit_factory is None
+                             else self._limit_factory(rid))
+            sched.health_tap = self._tap_for(rep)
+            if self.health_monitor is not None:
+                self.health_monitor.attach(rid, now=self._clock(),
+                                           role=rep.role)
+            # a fresh engine starts with an empty quota ledger: push the
+            # shared registry's per-tenant cache budgets before any
+            # placement can land content on it
+            sched._push_tenant_quotas()
+            self.replicas.append(rep)
+            grew += 1
+            logger.info("pool: scaled up — replica %d entered rotation", rid)
+        self.replicas.sort(key=lambda r: r.replica_id)
+        self.metrics.observe_scale(grew, 0, failed)
+        return grew
+
+    def _shrink(self, k: int) -> int:
+        serving = self._serving()
+        if k >= len(serving):
+            raise ValueError(
+                f"cannot retire {k} of {len(serving)} serving replicas "
+                "(min 1 must remain)")
+        shrank = 0
+        # highest id first: deterministic, and retires the newest
+        # (coldest prefix caches) before the oldest
+        for rep in sorted(serving, key=lambda r: -r.replica_id)[:k]:
+            self.drain(rep.replica_id)   # migrates every owned request
+            rep.scheduler.close()
+            if self.health_monitor is not None:
+                self.health_monitor.note_retired(rep.replica_id)
+            self.replicas.remove(rep)
+            shrank += 1
+            logger.info("pool: scaled down — replica %d retired",
+                        rep.replica_id)
+        self.metrics.observe_scale(0, shrank, 0)
+        return shrank
+
+    # ------------------------------------------------------------------
     # replica-death absorption
     # ------------------------------------------------------------------
     def _absorb_replica_loss(self, rep: Replica,
@@ -747,6 +873,9 @@ class EnginePool:
                 f"replica {replica_id} is {rep.state}, not dead")
         rep.engine.rebuild()
         rep.scheduler._engine_dead = None
+        # the rebuilt block manager starts with an empty per-tenant quota
+        # ledger — re-push the registry's cache budgets before rotation
+        rep.scheduler._push_tenant_quotas()
         rep.scheduler.breaker.rearm_half_open(self._clock())
         rep.state = SERVING
         if self.health_monitor is not None:
@@ -773,6 +902,9 @@ class EnginePool:
                 "breaker": r.scheduler.breaker.state_gauge,
                 "live": r.scheduler.live_count,
                 "queued": r.scheduler.queue_depth,
+                "backlog_tokens": (0 if r.state == DEAD
+                                   else r.scheduler.prefill_backlog_tokens()),
+                "load": (0 if r.state == DEAD else Router.load(r)),
                 "rebuilds": r.scheduler.recovery.rebuilds,
                 "weights_version": getattr(r.engine, "weights_version",
                                            None),

@@ -67,6 +67,13 @@ class Request:
     #: expands ``n > 1`` fanout into sibling requests with derived seeds
     #: before any Request exists, so replay never re-fans-out.
     sampling: Optional[object] = None
+    #: multi-tenant QoS identity (docs/SERVING.md "Multi-tenant QoS"):
+    #: the owning tenant id and resolved SLO-class name, set by submit()
+    #: when the scheduler has a ``TenantRegistry``. They ride the journal
+    #: (record.v3) so identity survives preempt/migrate/restore; ``None``
+    #: on untenanted schedulers — behavior is then exactly pre-tenancy.
+    tenant: Optional[str] = None
+    slo: Optional[str] = None
 
     # -- runtime state (scheduler-owned) --------------------------------
     state: RequestState = RequestState.QUEUED

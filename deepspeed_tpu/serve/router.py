@@ -58,11 +58,29 @@ class Router:
     def __init__(self, *, affinity: bool = True):
         self.affinity = affinity
 
+    #: prompt tokens that weigh like one queued request in the load score.
+    #: Matches the order of a typical chunked-prefill round (token_budget),
+    #: so a replica sitting on thousands of admitted-but-unprefilled tokens
+    #: scores as several requests' worth of committed work instead of
+    #: rounding to zero — without letting one long prompt swamp the
+    #: rebalancer's integer gap>=2 logic.
+    BACKLOG_TOKENS_PER_REQUEST = 256
+
     @staticmethod
     def load(replica) -> int:
         """A replica's placement load: requests it owns that are not yet
-        terminal — live members plus its queue."""
-        return replica.scheduler.live_count + replica.scheduler.queue_depth
+        terminal — live members plus its queue — plus its chunked-prefill
+        backlog in request-equivalents. live_count counts an admitted
+        sequence the moment it is admitted, but two replicas with equal
+        member counts can hide wildly different committed work: one may
+        still owe thousands of prompt tokens of prefill. Folding the
+        backlog in stops the router steering new prompts at the replica
+        that looks idle but is still chewing through admissions."""
+        n = replica.scheduler.live_count + replica.scheduler.queue_depth
+        backlog = getattr(replica.scheduler, "prefill_backlog_tokens", None)
+        if backlog is not None:
+            n += backlog() // Router.BACKLOG_TOKENS_PER_REQUEST
+        return n
 
     def place(self, prompt: Sequence[int], replicas: List[object],
               *, phase: str = "prefill") -> Tuple[Optional[object], int]:

@@ -101,8 +101,9 @@ import numpy as np
 from ..analysis import sanitizer as _sanitizer
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.errors import (ContextOverflowError, DeadlineShedError,
-                                 PoolExhaustedError, RequestFailedError,
-                                 SheddingError, TransientEngineError,
+                                 PoolExhaustedError, QuotaExceededError,
+                                 RequestFailedError, SheddingError,
+                                 TenantThrottledError, TransientEngineError,
                                  UnrecoverableEngineError)
 from ..resilience.recovery import RecoveryPolicy, RequestJournal
 from ..resilience.retry import RetryPolicy
@@ -112,6 +113,7 @@ from .metrics import Event, ServeMetrics
 from .request import Request, RequestState
 from .sampling import SamplingParams, StopScanner, combined_bias
 from .speculation import DraftProposer, SpecPolicy
+from .tenancy import TenantRegistry
 
 
 class QueueFullError(RuntimeError):
@@ -166,8 +168,19 @@ class ContinuousBatchScheduler:
                  replica_id: Optional[int] = None,
                  escalate_losses: bool = False,
                  swap_preemption: Optional[bool] = None,
-                 deadline_guard: bool = False):
+                 deadline_guard: bool = False,
+                 tenancy: Optional[TenantRegistry] = None):
         self.engine = engine
+        #: multi-tenant QoS (docs/SERVING.md "Multi-tenant QoS"): when a
+        #: :class:`TenantRegistry` is attached, every submit must name a
+        #: registered tenant; admission order becomes weighted fair
+        #: queueing over (tenant, SLO class) instead of the priority
+        #: score, token buckets / outstanding quotas gate submission, and
+        #: per-tenant prefix-cache quotas are pushed to the engine. Pool
+        #: replicas share ONE registry so quotas and virtual time are
+        #: tenant-global. ``None`` (the default) is byte-for-byte the
+        #: pre-tenancy scheduler.
+        self.tenancy = tenancy
         #: pool membership (docs/SERVING.md engine pool): ``replica_id``
         #: labels this scheduler's metrics/events so N replicas never alias
         #: in one monitor stream; ``escalate_losses`` re-raises engine
@@ -286,7 +299,9 @@ class ContinuousBatchScheduler:
                arrival_time: Optional[float] = None,
                on_token=None, uid: Optional[int] = None,
                eos_token: Optional[int] = None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               tenant: Optional[str] = None,
+               slo: Optional[str] = None) -> Request:
         """Enqueue a request; raises :class:`QueueFullError` on backpressure,
         :class:`SheddingError` while the circuit breaker sheds load, and
         :class:`SchedulerClosedError` after :meth:`close`.
@@ -300,6 +315,27 @@ class ContinuousBatchScheduler:
         so replay never re-fans-out."""
         if self._closed:
             raise SchedulerClosedError("scheduler is closed to new admits")
+        slo_name = None
+        if self.tenancy is not None:
+            # tenancy resolution FIRST: the SLO class decides the priority
+            # the breaker's shed floor and the preemption ordering see, and
+            # its deadline budget feeds the deadline guard below
+            if tenant is None:
+                raise ValueError(
+                    "this scheduler enforces multi-tenant QoS: submit() "
+                    "requires tenant= (register tenants on its "
+                    "TenantRegistry)")
+            spec, cls = self.tenancy.resolve(tenant, slo)
+            slo_name = cls.name
+            priority = cls.priority
+            if arrival_time is None:
+                arrival_time = self._clock()
+            if deadline is None and cls.deadline_s is not None:
+                deadline = arrival_time + cls.deadline_s
+        elif tenant is not None:
+            raise ValueError(
+                "tenant= given but this scheduler has no TenantRegistry "
+                "(pass tenancy= at construction)")
         if self.breaker.should_shed(priority, self._clock()):
             self.metrics.faults["shed"] += 1
             raise SheddingError(
@@ -355,6 +391,16 @@ class ContinuousBatchScheduler:
                         f"serve queue full ({self.max_queue}); fanout of "
                         f"{sampling.n} rejected")
                 at = self._clock() if arrival_time is None else arrival_time
+                if self.tenancy is not None:
+                    # atomic fanout under QoS too: verify the bucket covers
+                    # ALL n streams and the outstanding quota fits them
+                    # before any sibling is admitted — no partial fanout on
+                    # a mid-recursion throttle. Each sibling then charges
+                    # its own share (the precheck guarantees success).
+                    self.tenancy.precheck(
+                        tenant, sampling.n,
+                        sampling.n * float(len(prompt) + max_new_tokens),
+                        self._clock())
                 siblings = [
                     self.submit(prompt, max_new_tokens=max_new_tokens,
                                 priority=priority, deadline=deadline,
@@ -362,7 +408,8 @@ class ContinuousBatchScheduler:
                                 on_token=(on_token if i == 0 else None),
                                 uid=(uid if i == 0 else None),
                                 eos_token=eos_token,
-                                sampling=sampling.child(i))
+                                sampling=sampling.child(i),
+                                tenant=tenant, slo=slo)
                     for i in range(sampling.n)]
                 first = siblings[0]
                 first.fanout = siblings
@@ -373,15 +420,41 @@ class ContinuousBatchScheduler:
             self.metrics.admission_rejects += 1
             raise QueueFullError(
                 f"serve queue full ({self.max_queue}); request rejected")
+        if self.tenancy is not None:
+            # the LAST admission gate: every cheaper rejection above ran
+            # first, so a rejected request never drains the tenant's
+            # bucket. charge() raises typed (QuotaExceededError before the
+            # bucket is touched, TenantThrottledError with the refill time)
+            cost = float(len(prompt) + max_new_tokens)
+            try:
+                self.tenancy.charge(tenant, cost, self._clock())
+            except QuotaExceededError:
+                self.metrics.observe_tenant(tenant, "quota_rejects")
+                self.metrics.faults["shed"] += 1
+                raise
+            except TenantThrottledError:
+                self.metrics.observe_tenant(tenant, "throttled")
+                self.metrics.faults["shed"] += 1
+                raise
         kw = {} if uid is None else {"uid": uid}
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       priority=priority, deadline=deadline,
                       arrival_time=(self._clock() if arrival_time is None
                                     else arrival_time),
                       on_token=on_token, eos_token=eos_token,
-                      sampling=sampling, **kw)
+                      sampling=sampling, tenant=tenant, slo=slo_name, **kw)
         if req.uid in self._all and not self._all[req.uid].finished:
             raise ValueError(f"uid {req.uid} is already in flight")
+        if self.tenancy is not None:
+            # WFQ tags (start-time fair queueing): assigned at submission,
+            # consumed by _admit's min-finish-tag selection. The engine's
+            # per-tenant cache quota rides along lazily so tenants
+            # registered after scheduler construction still get enforced.
+            req._wfq_start, req._wfq_finish = self.tenancy.wfq_tag(
+                tenant, slo_name, cost)
+            self.tenancy.note_outstanding(tenant, req.uid)
+            self._push_tenant_quota(tenant)
+            self.metrics.observe_tenant(tenant, "submitted")
         self._all[req.uid] = req
         self._queue.append(req)
         # write-ahead: journaled before the engine ever sees the request,
@@ -405,6 +478,7 @@ class ContinuousBatchScheduler:
         req.cancel_reason = reason
         req.finish_time = self._clock()
         self.journal.resolve(uid)
+        self._release_tenant(req, "cancelled")
         self.metrics.cancelled += 1
         if self.spec is not None:
             self.spec.forget(uid)
@@ -499,7 +573,9 @@ class ContinuousBatchScheduler:
                           priority=entry.priority, deadline=entry.deadline,
                           arrival_time=entry.arrival_time,
                           eos_token=entry.eos_token, uid=entry.uid,
-                          sampling=getattr(entry, "sampling", None))
+                          sampling=getattr(entry, "sampling", None),
+                          tenant=getattr(entry, "tenant", None),
+                          slo=getattr(entry, "slo", None))
             req.tokens = list(entry.tokens)
             entry.request = req
         sp = getattr(req, "sampling", None)
@@ -522,7 +598,63 @@ class ContinuousBatchScheduler:
         self._queue.append(req)
         self.journal.adopt(entry)
         self.metrics.adopts += 1
+        if self.tenancy is not None and req.tenant is not None:
+            # migration is not new offered load: the uid re-notes as
+            # outstanding (idempotent — the registry is pool-global) and
+            # the bucket is NEVER re-charged. The request does re-enter
+            # the fair queue here, so it takes fresh WFQ tags on this
+            # registry's virtual time (deterministic: adoption order is
+            # replay order).
+            req._wfq_start, req._wfq_finish = self.tenancy.wfq_tag(
+                req.tenant, req.slo or "", float(len(req.prompt)
+                                                 + req.max_new_tokens))
+            self.tenancy.note_outstanding(req.tenant, req.uid)
+            self._push_tenant_quota(req.tenant)
         return req
+
+    # ------------------------------------------------------------------
+    # multi-tenant QoS plumbing (docs/SERVING.md "Multi-tenant QoS")
+    # ------------------------------------------------------------------
+    def _push_tenant_quota(self, tenant: str) -> None:
+        """Push one tenant's prefix-cache block quota to the engine (the
+        ``set_kv_quota`` seam — silently absent on slot engines). Called
+        at submit/adopt so tenants registered after construction are still
+        enforced before their first block is ever cached."""
+        if self.tenancy is None:
+            return
+        setq = getattr(self.engine, "set_kv_quota", None)
+        if setq is None:
+            return
+        try:
+            spec = self.tenancy.spec(tenant)
+        except ValueError:
+            return  # adopted legacy entry naming an unregistered tenant
+        if spec.cache_blocks is not None:
+            setq(tenant, spec.cache_blocks)
+
+    def _push_tenant_quotas(self) -> None:
+        """Re-push EVERY registered tenant's cache quota — a rebuilt
+        engine starts with a fresh :class:`BlockedKVCache` that has
+        forgotten them."""
+        if self.tenancy is None:
+            return
+        setq = getattr(self.engine, "set_kv_quota", None)
+        if setq is None:
+            return
+        for spec in self.tenancy.tenants():
+            if spec.cache_blocks is not None:
+                setq(spec.tenant_id, spec.cache_blocks)
+
+    def _release_tenant(self, req: Request, outcome: str) -> None:
+        """A tenant-tagged request reached a terminal state here: release
+        its pool-global outstanding slot and account the outcome."""
+        if self.tenancy is None or req.tenant is None:
+            return
+        self.tenancy.release(req.tenant, req.uid)
+        self.metrics.observe_tenant(req.tenant, outcome)
+        if req.tokens:
+            self.metrics.observe_tenant(req.tenant, "tokens",
+                                        float(len(req.tokens)))
 
     # ------------------------------------------------------------------
     # fault handling primitives (docs/RESILIENCE.md)
@@ -640,6 +772,7 @@ class ContinuousBatchScheduler:
         req.error = exc
         req.finish_time = now
         self.journal.resolve(req.uid)
+        self._release_tenant(req, "failed")
         self.metrics.failed += 1
         self.metrics.faults["failed_requests"] += 1
         if self.spec is not None:
@@ -713,6 +846,9 @@ class ContinuousBatchScheduler:
             "serve: engine lost (%s); rebuilding — %d live request(s) "
             "replay from the journal", exc, len(self._live))
         self.engine.rebuild()
+        # a rebuilt engine's fresh BlockedKVCache has forgotten every
+        # per-tenant cache quota — re-arm them before any replay registers
+        self._push_tenant_quotas()
         replayed = 0
         for req in list(self._live.values()):
             req.state = RequestState.PREEMPTED
@@ -865,7 +1001,18 @@ class ContinuousBatchScheduler:
             arrived = [r for r in self._queue if r.arrival_time <= now]
             if not arrived:
                 return
-            best = max(arrived, key=lambda r: self._score(r, now))
+            if self.tenancy is not None:
+                # weighted fair queueing (docs/SERVING.md "Multi-tenant
+                # QoS"): serve the smallest finish tag. A flooding tenant
+                # only stretches its OWN flow's tags — admitted shares
+                # converge to the configured weights under saturation.
+                # Ties (and rare untagged legacy adoptions, tag 0.0) break
+                # on arrival then uid: deterministic (DSTPU005).
+                best = min(arrived,
+                           key=lambda r: (getattr(r, "_wfq_finish", 0.0),
+                                          r.arrival_time, r.uid))
+            else:
+                best = max(arrived, key=lambda r: self._score(r, now))
             if (self.chunked_prefill and self._starved_prio is not None
                     and best.priority <= self._starved_prio):
                 # a prefill at this priority or above is starved for
@@ -909,6 +1056,10 @@ class ContinuousBatchScheduler:
                                 for r in self._live.values())):
                     return
             self._queue.remove(best)
+            if self.tenancy is not None:
+                # virtual time advances to the served start tag — the SFQ
+                # service event that keeps idle flows from banking credit
+                self.tenancy.on_service(getattr(best, "_wfq_start", 0.0))
             self._start(best, now)
 
     def _swap_resident(self, uid: int) -> bool:
@@ -981,6 +1132,16 @@ class ContinuousBatchScheduler:
             req.admitted_time = now
         self._live[req.uid] = req
         self.metrics.admitted += 1
+        if req.tenant is not None:
+            # attribute this sequence's KV blocks BEFORE the engine sees
+            # the prompt: the prefix cache charges block ownership at
+            # registration time (docs/SERVING.md "Multi-tenant QoS"), and
+            # every (re-)admission path — fresh, replay, swap-in — funnels
+            # through here first
+            set_owner = getattr(self.engine, "set_kv_owner", None)
+            if set_owner is not None:
+                set_owner(req.uid, req.tenant)
+            self.metrics.observe_tenant(req.tenant, "admitted")
         sp = req.sampling
         if sp is not None and sp.needs_engine:
             # (re-)register with the engine BEFORE any admission path:
@@ -1170,6 +1331,7 @@ class ContinuousBatchScheduler:
         req.state = RequestState.DONE
         req.finish_time = now
         self.journal.resolve(req.uid)
+        self._release_tenant(req, "completed")
         self.metrics.completed += 1
         if self.spec is not None:
             self.spec.forget(req.uid)
@@ -1180,6 +1342,15 @@ class ContinuousBatchScheduler:
         if not getattr(self.engine, "paged", False):
             return 0
         return self.engine.prefill_backlog()
+
+    def prefill_backlog_tokens(self) -> int:
+        """Public gauge for the router and pool health: tokens admitted into
+        the engine but not yet prefilled. Load-bearing for placement — an
+        admitted long prompt is committed work ``live_count`` cannot see
+        until its first token lands."""
+        if self._engine_dead is not None:
+            return 0
+        return self._prefill_backlog()
 
     def _effective_horizon(self, now: float, feed: Dict[int, int]) -> int:
         """The horizon this decode round actually runs at. Collapses to 1 —

@@ -149,6 +149,8 @@ _SANITIZE_FILES = (
     "test_pool.py",
     "test_pool_health.py",
     "test_pool_restore.py",
+    "test_tenancy.py",
+    "test_elastic_pool.py",
     "test_journal_durability.py",
     "test_kv_tier.py",
     "test_zero_sharded.py",

@@ -257,7 +257,8 @@ class TestVersionedSamplingRecords:
         """Back-compat contract both ways: the unknown-kind rule means a
         pre-sampling reader folds ``record.v2`` to nothing (loses only
         the sampled request), and THIS reader must skip a hypothetical
-        ``record.v3`` the same way — never a tear, never a wedge."""
+        ``record.v4`` the same way — never a tear, never a wedge.
+        (``record.v3`` is the tenant-tagged kind this reader parses.)"""
         import json
 
         path = str(tmp_path / "j.log")
@@ -265,7 +266,7 @@ class TestVersionedSamplingRecords:
         with DurableRequestJournal(path) as j:
             j.record(a)
         with open(path, "a", encoding="utf-8") as f:
-            f.write(_frame(json.dumps({"kind": "record.v3", "uid": 4242,
+            f.write(_frame(json.dumps({"kind": "record.v4", "uid": 4242,
                                        "exotic": True})))
         with DurableRequestJournal(path) as j2:
             assert j2.corrupt_tail_truncations == 0
