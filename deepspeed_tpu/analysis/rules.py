@@ -40,6 +40,15 @@ HOT_FUNCTIONS: FrozenSet[str] = frozenset({
     "_decode_once", "_absorb", "_absorb_multi", "_absorb_speculation",
     "step", "_collect_drafts", "propose",
     "_emit_token", "commit", "record",
+    # pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): the
+    # plan/dispatch/absorb stages run once per in-flight round and the
+    # whole point is keeping the host phase off the device's critical
+    # path — ``fetch`` carries the round's ONE designed materialization
+    # sync (suppressed at the site); everything else must stay
+    # dispatch-only or pure host bookkeeping
+    "_decode_sync", "decode_dispatch", "commit_step", "fetch",
+    "step_dispatch", "step_absorb", "_pipeline_dispatch_stage",
+    "_pipeline_absorb_stage", "_drain_inflight", "_engine_commit",
     # the training micro-step loop (ROADMAP item 3): one iteration ≈ one
     # optimizer step — host syncs/allocations here multiply by steps/second
     # exactly like the decode loop's multiply by tokens/second

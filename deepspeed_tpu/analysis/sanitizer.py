@@ -197,21 +197,25 @@ def checked_cache_cls():
             self.verify(f"copy_on_write(uid={desc.uid}, j={j})")
             return src, dst
 
-        def register(self, desc):
+        def register(self, desc, limit=None):
             self._track(desc)
             # speculation-aware rollback accounting (docs/SERVING.md): a
             # fused/verify dispatch marks its K advanced positions as
             # uncommitted; registering them in the content index before
             # rollback commits the step would let the prefix cache serve
-            # unverified draft tokens to other requests
+            # unverified draft tokens to other requests. A bounded
+            # registration (pipelined dispatch: ``limit`` at the committed
+            # boundary) is allowed while a provisional tail is in flight —
+            # but only when the bound truly excludes every such position.
             if getattr(desc, "uncommitted", 0):
-                raise SanitizerError(
-                    f"[sanitizer] register during speculation: uid "
-                    f"{desc.uid} has {desc.uncommitted} uncommitted "
-                    "token(s) from the last fused/verify dispatch — "
-                    "rollback must commit the step before the prefix "
-                    "index may cover it")
-            super().register(desc)
+                if limit is None or limit > desc.seen_tokens - desc.uncommitted:
+                    raise SanitizerError(
+                        f"[sanitizer] register during speculation: uid "
+                        f"{desc.uid} has {desc.uncommitted} uncommitted "
+                        "token(s) from the last fused/verify/pipelined "
+                        "dispatch — the prefix index may only cover "
+                        "positions below the committed boundary")
+            super().register(desc, limit=limit)
             self.verify(f"register(uid={desc.uid})")
 
         def rollback(self, desc, n_tokens):
@@ -291,7 +295,9 @@ def check_prefill_ownership(engine, live: Dict[int, object]) -> None:
 # speculative-decoding commit check
 # ---------------------------------------------------------------------------
 
-def check_speculation_commit(engine) -> None:
+def check_speculation_commit(engine,
+                             inflight: Optional[Dict[int, int]] = None
+                             ) -> None:
     """Speculative decoding (docs/SERVING.md) advances every verified
     row's cache by the full horizon K and relies on the scheduler to
     commit/rollback the step — ``engine.rollback(uid, n)`` — before the
@@ -303,6 +309,12 @@ def check_speculation_commit(engine) -> None:
     - no descriptor's prefix-index registration may cover more tokens than
       it has committed (``seen_tokens``) — the draft-tokens-never-indexed
       guarantee (docs/PREFIX_CACHING.md).
+
+    ``inflight`` (pipelined dispatch, docs/SERVING.md) is the scheduler's
+    declared in-flight ledger, ``{uid: provisional token span}``: exactly
+    that many uncommitted tokens are EXPECTED on those uids at the step
+    boundary — the one legitimately un-absorbed round. Anything beyond the
+    declaration is still a violation.
     """
     state = getattr(engine, "state", None)
     if state is None:
@@ -310,18 +322,94 @@ def check_speculation_commit(engine) -> None:
     mgr = getattr(engine, "block_mgr", None)
     bs = getattr(mgr, "block_size", None)
     for uid, d in state.seqs.items():
-        if getattr(d, "uncommitted", 0):
+        allowed = (inflight or {}).get(uid, 0)
+        if getattr(d, "uncommitted", 0) > allowed:
             raise SanitizerError(
                 f"[sanitizer] uncommitted speculation across a step "
                 f"boundary: uid {uid} still has {d.uncommitted} "
-                "uncommitted token(s) — the scheduler must rollback/commit "
-                "every fused/verify dispatch it absorbs")
+                f"uncommitted token(s) (declared in-flight: {allowed}) — "
+                "the scheduler must rollback/commit every fused/verify/"
+                "pipelined dispatch it absorbs")
         if bs and getattr(d, "n_indexed", 0) * bs > d.seen_tokens:
             raise SanitizerError(
                 f"[sanitizer] prefix index past committed history: uid "
                 f"{uid} registered {d.n_indexed} full block(s) "
                 f"({d.n_indexed * bs} tokens) but committed only "
                 f"{d.seen_tokens}")
+
+
+# ---------------------------------------------------------------------------
+# pipelined-dispatch coherence check
+# ---------------------------------------------------------------------------
+
+def check_pipeline_coherence(engine, journal, live: Dict[int, object],
+                             inflight: Dict[int, int],
+                             dispatch_uids: Optional[List[int]] = None
+                             ) -> None:
+    """Pipelined dispatch (docs/SERVING.md): with one step in flight the
+    scheduler's absorb runs one step LATE, so four invariants tie the
+    in-flight ledger to the engine and the journal at every step boundary:
+
+    - the ledger is exact: each declared uid carries exactly its declared
+      provisional span in ``uncommitted`` (a drifted ledger means commit
+      bookkeeping was skipped or double-counted);
+    - no uid rides two in-flight dispatches: the dispatched row list holds
+      each uid at most once (a double-fed uid would double-advance);
+    - the journal never contains a token from an un-absorbed step: per
+      in-flight uid, ``prompt + journaled tokens`` may exceed the engine's
+      committed positions (``seen_tokens - uncommitted``) by at most the
+      one emitted-but-not-yet-cached token of the ``decode_step`` contract;
+    - rollback-on-absorb leaves refcounts exact: every at-rest live decode
+      row's block list covers its committed positions with at most the
+      standing-retry one-token over-allocation.
+    """
+    if dispatch_uids is not None:
+        if len(dispatch_uids) != len(set(dispatch_uids)):
+            raise SanitizerError(
+                "[sanitizer] pipeline double-feed: uid(s) "
+                f"{sorted(u for u in set(dispatch_uids) if dispatch_uids.count(u) > 1)} "
+                "appear more than once in the in-flight dispatch")
+    state = getattr(engine, "state", None)
+    if state is None:
+        return
+    for uid, span in inflight.items():
+        if uid not in live:
+            raise SanitizerError(
+                f"[sanitizer] pipeline ledger names uid {uid} which has no "
+                "live request — finished/contained uids must leave the "
+                "in-flight ledger at their absorb")
+        d = state.seqs.get(uid)
+        if d is None or getattr(d, "uncommitted", 0) != span:
+            got = "no descriptor" if d is None else d.uncommitted
+            raise SanitizerError(
+                f"[sanitizer] pipeline ledger drift: uid {uid} declared "
+                f"{span} in-flight token(s) but the engine carries {got}")
+        e = journal.get(uid) if journal is not None else None
+        if e is not None:
+            committed = d.seen_tokens - d.uncommitted
+            if len(e.prompt) + len(e.tokens) > committed + 1:
+                raise SanitizerError(
+                    f"[sanitizer] journal ahead of absorb: uid {uid} "
+                    f"journals {len(e.tokens)} token(s) on a {len(e.prompt)}"
+                    f"-token prompt but the engine has committed only "
+                    f"{committed} position(s) — a token from an un-absorbed "
+                    "step was committed")
+    mgr = getattr(engine, "block_mgr", None)
+    if mgr is None:
+        return
+    for uid, req in live.items():
+        if getattr(getattr(req, "state", None), "value", None) != "decode":
+            continue
+        d = state.seqs.get(uid)
+        if d is None or d.in_flight or uid in inflight:
+            continue
+        lo = mgr.blocks_needed(d.seen_tokens)
+        hi = mgr.blocks_needed(d.seen_tokens + 1)
+        if not (lo <= len(d.blocks) <= hi):
+            raise SanitizerError(
+                f"[sanitizer] pipeline rollback refcount drift: uid {uid} "
+                f"holds {len(d.blocks)} block(s) for {d.seen_tokens} "
+                f"committed token(s), expected within [{lo}, {hi}]")
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,45 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+class DecodeDispatchHandle:
+    """One in-flight decode round (docs/SERVING.md pipelined dispatch):
+    :meth:`InferenceEngineV2.decode_dispatch` returns this instead of host
+    tokens, deferring the device→host transfer so the caller can plan and
+    dispatch the NEXT round while this one executes — the TransferEngine
+    ticket discipline applied to the step loop. :meth:`fetch` is the drain
+    boundary: it blocks on the device result (the one designed transfer the
+    synchronous path pays inline) and yields ``{uid: int token}``.
+
+    The handle is single-shot state, not a future registry: fetch it before
+    the next ``decode_dispatch`` (the engine's scratch-reuse contract) and
+    exactly once per dispatch."""
+
+    __slots__ = ("uids", "span", "_dev", "_out", "_eng")
+
+    def __init__(self, uids: List[int], dev, eng=None):
+        self.uids = uids          # row order of the dispatched program
+        self.span = 1             # cache positions each row advanced
+        self._dev = dev           # device logits/token rows, unfetched
+        self._out: Optional[Dict[int, int]] = None
+        self._eng = eng           # owner: cleared of this handle at fetch
+
+    def fetch(self) -> Dict[int, int]:
+        """Block on the in-flight program and return its sampled tokens.
+        Idempotent: later calls return the cached host result."""
+        if self._out is None:
+            # THE deferred transfer: the synchronous twin pays this same
+            # np.asarray inline inside _put_paged; here it lands only after
+            # the next round was dispatched, so the device never idles on it
+            lg = np.asarray(self._dev)  # dstpu-lint: ignore[DSTPU001]
+            self._out = {uid: int(lg[i]) for i, uid in enumerate(self.uids)}
+            self._dev = None
+        if self._eng is not None:
+            if self._eng._undrained_dispatch is self:
+                self._eng._undrained_dispatch = None
+            self._eng = None
+        return self._out
+
+
 class InferenceEngineV2:
     """Continuous-batching engine over a ``TransformerLM``."""
 
@@ -111,6 +150,8 @@ class InferenceEngineV2:
         # its outputs (np.asarray) before the next step refills the scratch,
         # so the previous dispatch has fully consumed its inputs.
         self._scratch: Dict[Tuple, Tuple[np.ndarray, ...]] = {}
+        #: the one un-fetched pipelined dispatch (scratch-reuse contract)
+        self._undrained_dispatch: Optional[DecodeDispatchHandle] = None
         self.prefix_cache = bool(prefix_cache) and paged
         # host-RAM KV tier (docs/PREFIX_CACHING.md "Two-tier cache"): spill
         # capacity in blocks under the device pool. 0 = single-tier (the
@@ -1461,6 +1502,150 @@ class InferenceEngineV2:
             out[d.uid] = [int(t) for t in ys[r, :len(row)]]
         return out
 
+    def decode_dispatch(self, tokens: Dict[int, int]) -> DecodeDispatchHandle:
+        """Dispatch ONE ragged decode round without syncing on its result
+        (docs/SERVING.md pipelined dispatch). Semantically the step is
+        ``decode_step(tokens, greedy=True)`` — one fed token per live uid,
+        the compiled decode-round program, on-device sampling under the same
+        counter-based keys — but the host returns as soon as the program is
+        enqueued, handing back a :class:`DecodeDispatchHandle` whose
+        :meth:`~DecodeDispatchHandle.fetch` is the deferred transfer.
+
+        Host bookkeeping advances at dispatch: ``seen_tokens``/``history``
+        grow by the fed token and ``uncommitted`` grows by 1 (STACKED — with
+        one step in flight a sequence can carry two provisional tokens), but
+        NOTHING is registered in the prefix-cache content index:
+        :meth:`commit_step` publishes absorbed tokens once the scheduler has
+        fetched the round and decided what is kept, so the index never
+        covers a position a speculative-absorb rollback could truncate.
+
+        Validation is all-or-nothing (the ``decode_multi`` discipline) and
+        the previous round's handle must be fetched before this call (the
+        scratch-reuse contract — the scheduler's plan stage does exactly
+        that, since the fetched tokens ARE the next round's feed)."""
+        if not self.paged:
+            raise ValueError("decode_dispatch is paged-mode only")
+        if not tokens:
+            raise EngineUsageError("decode_dispatch with an empty feed")
+        if self._undrained_dispatch is not None:
+            raise EngineUsageError(
+                "decode_dispatch: the previous round's handle is unfetched "
+                "— drain it first (the ragged scratch arrays are reused "
+                "per round, so a second dispatch would corrupt the "
+                "in-flight feed)")
+        if len(tokens) > self.max_seqs:
+            raise EngineUsageError(
+                f"batch of {len(tokens)} exceeds {self.max_seqs} slots")
+        for uid in tokens:
+            d = self.state.seqs[uid]  # unknown uid: loud KeyError
+            if d.in_flight:
+                raise EngineUsageError(
+                    f"uid {uid}: {d.in_flight} pending prefill tokens — "
+                    "drain before pipelined decode", uid=uid)
+            if d.seen_tokens + 1 > self.max_seq_len:
+                raise ContextOverflowError(
+                    f"uid {uid}: context full ({d.seen_tokens} >= "
+                    f"{self.max_seq_len}); flush the sequence or raise "
+                    "max_seq_len", uid=uid)
+        self._drain_promotions()  # queued tier promotions land first
+        for uid in tokens:
+            d = self.state.seqs[uid]
+            self.block_mgr.ensure(d, d.seen_tokens + 1)
+        descs = sorted((self.state.seqs[u] for u in tokens),
+                       key=lambda d: d.slot)
+        if self.prefix_cache:
+            # copy-on-write for the block the single write lands in —
+            # shared blocks are immutable (same discipline as _put_paged)
+            bs = self.block_mgr.block_size
+            for d in descs:
+                j = min(d.seen_tokens // bs, len(d.blocks) - 1)
+                if self.block_mgr.refcount(d.blocks[j]) > 1:
+                    src, dst = self.block_mgr.copy_on_write(d, j)
+                    self.kv = self._get_cow()(
+                        self.kv, jnp.int32(src), jnp.int32(dst))
+        # the decode-round fast shape of the ragged program (see _put_paged):
+        # a pure single-token round never pays the prefill budget's padding
+        T = (self.max_seqs if self.token_budget > self.max_seqs
+             else self.token_budget)
+        M = self.max_seqs
+        (ids, tables, starts, logit_rows, slots, seeds, poss, top_ks,
+         temps, top_ps) = self._scratch_for(
+            ("ragged", T),
+            ((T, 1), (T, self.block_mgr.max_blocks_per_seq), (T,),
+             (M,), (M,), (M,), (M,), (M,), (M,), (M,)),
+            dtypes=(np.int32,) * 8 + (np.float32, np.float32))
+        for r, d in enumerate(descs):
+            tok = int(tokens[d.uid])
+            ids[r, 0] = tok
+            self.block_mgr.fill_table_row(d, tables[r])  # in place, no temp
+            starts[r] = d.seen_tokens
+            logit_rows[r] = r  # every row is a final: one token per uid
+            self._fill_sampling(d, r, slots, seeds, temps, top_ks, top_ps,
+                                poss=poss, pos=d.seen_tokens + 1)
+            if self.prefix_cache:
+                d.history.append(tok)
+            d.seen_tokens += 1
+            d.uncommitted += 1  # stacked: commit_step settles per absorb
+        fn = self._get_ragged()
+        # the whole feed rides ONE batched host→device staging call: at
+        # K=1 the per-call Python dispatch overhead of ten separate small
+        # transfers is itself a large slice of the host-bound round, and
+        # the dispatch stage exists to get off the device's critical path
+        (ids_d, tables_d, starts_d, logit_rows_d, slots_d, seeds_d,
+         poss_d, temps_d, top_ks_d, top_ps_d) = jax.device_put(
+            (ids, tables, starts, logit_rows, slots, seeds, poss,
+             temps, top_ks, top_ps))
+        lg, self.kv = fn(self.params, self.kv, ids_d, tables_d, starts_d,
+                         logit_rows_d, slots_d, seeds_d, poss_d, temps_d,
+                         top_ks_d, top_ps_d, self._bias(), True)
+        # no np.asarray and no register here — both are deferred: the
+        # transfer to fetch(), the prefix-index publish to commit_step()
+        handle = DecodeDispatchHandle([d.uid for d in descs], lg, eng=self)
+        self._undrained_dispatch = handle
+        return handle
+
+    def commit_step(self, uid: int, drop: int = 0, retain: int = 0) -> int:
+        """Settle one absorbed pipelined round for ``uid`` (docs/SERVING.md):
+        truncate the newest ``drop`` provisional tokens (speculative-absorb
+        overrun — tokens dispatched past an EOS/stop/max_new_tokens the host
+        only saw one step late, including any already-in-flight successor
+        token), leave ``retain`` tokens uncommitted (the successor round
+        still executing), and register prefix-cache content strictly below
+        the committed boundary. ``drop=0, retain=0`` is the pure commit —
+        exactly ``rollback(uid, 0)``. Idempotent on unknown uids.
+
+        Safety of truncating under a live in-flight write: freed tail
+        blocks may be re-allocated while the successor program is still
+        executing, but device programs run in dispatch order and attention
+        reads are length-masked, so a stale write to a re-used block's
+        unread offsets is overwritten before any sequence ever reads it.
+        Returns the number of block references released."""
+        if not self.paged:
+            raise ValueError("commit_step is paged-mode only")
+        d = self.state.seqs.get(uid)
+        if d is None:
+            return 0
+        if drop + retain > d.uncommitted:
+            raise EngineUsageError(
+                f"uid {uid}: commit_step(drop={drop}, retain={retain}) "
+                f"exceeds the {d.uncommitted} provisional tokens — committed "
+                "tokens are immutable (the prefix index may already cover "
+                "them)", uid=uid)
+        freed = 0
+        if drop:
+            if drop >= d.seen_tokens:
+                raise ValueError(
+                    f"uid {uid}: cannot roll back {drop} of {d.seen_tokens} "
+                    "cached tokens (at least one must remain)")
+            d.seen_tokens -= drop
+            if self.prefix_cache:
+                del d.history[-drop:]
+            freed = self.block_mgr.rollback(d, d.seen_tokens)
+        d.uncommitted = retain  # committed BEFORE register: in-flight and
+        if self.prefix_cache:   # discarded tokens are never indexed
+            self.block_mgr.register(d, limit=d.seen_tokens - retain)
+        return freed
+
     def rollback(self, uid: int, n: int = 0) -> int:
         """Truncate the last ``n`` cached tokens of a live sequence and
         commit the rest — the scheduler's overrun path for fused decode
@@ -1576,6 +1761,9 @@ class InferenceEngineV2:
         NVMe-tier files (their bookkeeping dies with the block manager) are
         deleted so the store never serves a previous incarnation's KV."""
         self.state = DSStateManager(self.max_seqs, self.max_seq_len)
+        # an in-flight dispatch died with the device: its handle can never
+        # be fetched against the new incarnation
+        self._undrained_dispatch = None
         self.transfer.cancel_all()
         self._drop_swaps()  # counts any orphaned handoff imports
         # sampling state is per-residency (slot bindings died with the state

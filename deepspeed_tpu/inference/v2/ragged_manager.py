@@ -725,15 +725,24 @@ class BlockedKVCache:
         self.stats["cow_copies"] += 1
         return src, dst
 
-    def register(self, desc: SequenceDescriptor):
+    def register(self, desc: SequenceDescriptor,
+                 limit: Optional[int] = None):
         """Index every newly-filled full block of ``desc`` (chained on its
         predecessor). If an identical block is already indexed, the duplicate
         is deduplicated: ``desc`` adopts the canonical block and its own copy
-        returns to the free list — identical content, identical KV."""
+        returns to the free list — identical content, identical KV.
+
+        ``limit`` caps registration at the first ``limit`` logical tokens:
+        only blocks lying ENTIRELY below that boundary are indexed. The
+        pipelined dispatch path uses this to publish absorbed (committed)
+        content while a provisional tail is still in flight — the index must
+        never cover a position a rollback could truncate."""
         if not self.prefix_cache:
             return
         bs = self.block_size
         n_full = desc.seen_tokens // bs
+        if limit is not None:
+            n_full = min(n_full, limit // bs)
         while desc.n_indexed < n_full:
             j = desc.n_indexed
             if len(desc.history) < (j + 1) * bs:

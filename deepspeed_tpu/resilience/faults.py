@@ -76,7 +76,7 @@ _PERSISTENT_SITES = ("put", "decode_step", "decode_multi", "verify_multi")
 #: the lifecycle edges recovery must cover. ``train_batch``/``step`` are the
 #: training equivalents: the death lands mid-train-step, between the last
 #: durable checkpoint and the next — the replay window recovery must close.
-_DEVICE_LOST_SITES = ("put", "decode_multi", "verify_multi",
+_DEVICE_LOST_SITES = ("put", "decode_step", "decode_multi", "verify_multi",
                       "train_batch", "step")
 #: ``random_plan``'s default scatter — the SERVING dispatch surface only,
 #: so pre-training seeded plans are reproduced verbatim (same seed, same
@@ -308,6 +308,13 @@ class InjectedEngine:
     def decode_step(self, tokens, *a, **kw):
         self.injector.on_call("decode_step", list(tokens))
         return self.inner.decode_step(tokens, *a, **kw)
+
+    def decode_dispatch(self, tokens, *a, **kw):
+        # the pipelined deferred-sync twin of decode_step shares its fault
+        # site: a faulted dispatch never enters the in-flight ledger, so the
+        # retry (or the recovery replay) re-plans the WHOLE round
+        self.injector.on_call("decode_step", list(tokens))
+        return self.inner.decode_dispatch(tokens, *a, **kw)
 
     def decode_multi(self, tokens, *a, **kw):
         # fires BEFORE delegation like every site: a faulted fused step never

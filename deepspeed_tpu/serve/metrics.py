@@ -87,6 +87,22 @@ class ServeMetrics:
             "steps": 0, "proposed_tokens": 0, "accepted_tokens": 0,
             "bonus_tokens": 0, "rollback_tokens": 0, "degraded_steps": 0,
             "acceptance_rate": 0.0, "draft_horizon": 0.0}
+        #: pipelined-dispatch counters (docs/SERVING.md "Pipelined
+        #: dispatch"), exported under ``serve/pipeline/*``: ``dispatches``
+        #: deferred-sync decode rounds put in flight, ``in_flight`` the
+        #: end-of-step in-flight row count (gauge — 0 whenever the pipe is
+        #: drained), ``speculative_rollbacks`` in-flight successor positions
+        #: dropped at absorb because the late token finished the request
+        #: (stop-sequence overrun), ``pipeline_stalls`` rounds that had to
+        #: drain and fall back to the synchronous twin (fused/spec horizon,
+        #: prefill backlog, dynamic sampling, admission stall), and the
+        #: stage-timing split gauges ``host_plan_ms`` / ``device_wait_ms``
+        #: / ``absorb_ms`` of the latest absorbed round — the one number
+        #: ``observe_step`` used to conflate.
+        self.pipeline: Dict[str, float] = {
+            "dispatches": 0, "in_flight": 0.0,
+            "speculative_rollbacks": 0, "pipeline_stalls": 0,
+            "host_plan_ms": 0.0, "device_wait_ms": 0.0, "absorb_ms": 0.0}
         #: multi-tenant QoS counters (docs/SERVING.md "Multi-tenant QoS"),
         #: exported under ``serve/tenant/<tenant>/<k>``: per-tenant
         #: admission outcomes (submitted/admitted/throttled/quota_rejects)
@@ -159,11 +175,43 @@ class ServeMetrics:
         }
 
     def observe_step(self, latency_s: float, batch: int,
-                     horizon: int = 1) -> None:
+                     horizon: int = 1,
+                     plan_s: Optional[float] = None,
+                     wait_s: Optional[float] = None,
+                     absorb_s: Optional[float] = None) -> None:
         """One decode dispatch: ``batch`` sequences advanced ``horizon``
-        tokens each — ``step_batch`` records tokens per dispatch."""
+        tokens each — ``step_batch`` records tokens per dispatch. Pipelined
+        rounds also pass the stage split (host planning, device wait at
+        ``fetch()``, host absorb), routed into the ``serve/pipeline/*``
+        timing gauges; the synchronous twin leaves them ``None`` and the
+        gauges untouched."""
         self.step_lat_s.append(latency_s)
         self.step_batch.append(batch * horizon)
+        if plan_s is not None:
+            self.pipeline["host_plan_ms"] = round(plan_s * 1000, 3)
+        if wait_s is not None:
+            self.pipeline["device_wait_ms"] = round(wait_s * 1000, 3)
+        if absorb_s is not None:
+            self.pipeline["absorb_ms"] = round(absorb_s * 1000, 3)
+
+    def observe_pipeline_dispatch(self, batch: int) -> None:
+        """One deferred-sync decode round put in flight (``batch`` rows)."""
+        self.pipeline["dispatches"] += 1
+        self.pipeline["in_flight"] = float(batch)
+
+    def observe_pipeline_in_flight(self, batch: int) -> None:
+        """End-of-step in-flight gauge (0 when the pipe is drained)."""
+        self.pipeline["in_flight"] = float(batch)
+
+    def observe_pipeline_rollback(self, n_tokens: int) -> None:
+        """In-flight successor positions dropped at absorb because the late
+        token finished the request (also counted in
+        ``serve/decode/rollback_tokens`` by the engine commit)."""
+        self.pipeline["speculative_rollbacks"] += n_tokens
+
+    def observe_pipeline_stall(self) -> None:
+        """A round drained the pipe and fell back to the synchronous twin."""
+        self.pipeline["pipeline_stalls"] += 1
 
     def observe_decode(self, horizon: int, fused: bool) -> None:
         self.decode["horizon"] = float(horizon)
@@ -324,6 +372,8 @@ class ServeMetrics:
                    for k, v in sorted(self.prefill.items())]
                 + [(f"{p}spec/{k}", float(v), step)
                    for k, v in sorted(self.spec.items())]
+                + [(f"{p}pipeline/{k}", float(v), step)
+                   for k, v in sorted(self.pipeline.items())]
                 + [(f"{p}sampling/{k}", float(v), step)
                    for k, v in sorted(self.sampling.items())]
                 + [(f"{p}kvtier/{k}", float(v), step)

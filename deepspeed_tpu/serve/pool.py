@@ -368,15 +368,34 @@ class EnginePool:
     # stepping
     # ------------------------------------------------------------------
     def step(self) -> bool:
-        """One pool iteration: step every non-dead replica in id order;
-        an escalated engine loss routes to :meth:`_absorb_replica_loss`.
-        Returns True while any replica has work."""
+        """One pool iteration, two-phase (docs/SERVING.md "Pipelined
+        dispatch"): phase 1 dispatches every non-dead replica's next round
+        (``step_dispatch``) so N devices execute concurrently, phase 2
+        absorbs each replica's tokens (``step_absorb``) — on synchronous
+        (non-pipelined) schedulers ``step_dispatch`` is a no-op and
+        ``step_absorb`` runs the whole step, so the loop degrades to the
+        old sequential order exactly. An escalated engine loss in either
+        phase routes to :meth:`_absorb_replica_loss`; a replica lost in
+        phase 1 is skipped in phase 2. The heartbeat lease is fed per
+        replica at its OWN absorb — never once for the whole pool pass —
+        so one straggler's host phase cannot expire its neighbours'
+        leases. Returns True while any replica has work."""
         work = False
+        lost: set = set()
         for rep in self.replicas:
             if rep.state == DEAD:
                 continue
             try:
-                if rep.scheduler.step():
+                rep.scheduler.step_dispatch()
+            except UnrecoverableEngineError as e:
+                lost.add(rep.replica_id)
+                self._absorb_replica_loss(rep, e)
+                work = True
+        for rep in self.replicas:
+            if rep.state == DEAD or rep.replica_id in lost:
+                continue
+            try:
+                if rep.scheduler.step_absorb():
                     work = True
                 if self.health_monitor is not None:
                     # a completed control-loop pass IS the liveness

@@ -169,6 +169,7 @@ class ContinuousBatchScheduler:
                  escalate_losses: bool = False,
                  swap_preemption: Optional[bool] = None,
                  deadline_guard: bool = False,
+                 pipelined: bool = False,
                  tenancy: Optional[TenantRegistry] = None):
         self.engine = engine
         #: multi-tenant QoS (docs/SERVING.md "Multi-tenant QoS"): when a
@@ -289,6 +290,26 @@ class ContinuousBatchScheduler:
         #: an admitted request's prefill hit pool exhaustion; its pending
         #: tokens sit inside the engine and must drain before it decodes
         self._stalled = False
+        # pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): with
+        # ``pipelined=True`` the decode loop keeps ONE step in flight —
+        # plan/dispatch round N+1 while N executes on device, absorb N's
+        # tokens one step late (speculative: late stop detections roll the
+        # in-flight successor back). ``False`` is the bitwise synchronous
+        # twin, the same discipline as ``overlap=False`` on the
+        # TransferEngine.
+        if pipelined and not getattr(engine, "paged", False):
+            raise ValueError(
+                "pipelined=True needs a paged engine (the deferred-sync "
+                "decode_dispatch rides the compiled ragged decode round)")
+        self.pipelined = pipelined
+        #: the one in-flight decode round: a dict with the engine's
+        #: DecodeDispatchHandle, the per-uid staleness record
+        #: ``{uid: (req, desc, emitted_len)}``, and dispatch timing.
+        #: None = the pipe is dry.
+        self._inflight: Optional[Dict[str, object]] = None
+        #: absorb work staged by step_dispatch for step_absorb (the pool's
+        #: two-phase drive): (prev record, fetched tokens, timing)
+        self._pending_absorb: Optional[Dict[str, object]] = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -502,6 +523,12 @@ class ContinuousBatchScheduler:
         req = self._all.get(uid)
         if req is None or req.finished:
             raise ValueError(f"uid {uid} is not live on this scheduler")
+        if self._inflight is not None and uid in self._inflight["rows"]:
+            # pipelined dispatch: the uid has an unabsorbed token in flight.
+            # Detach is a drain boundary (the TransferEngine discipline) —
+            # absorb first so the migrating JournalEntry carries every token
+            # the device already produced and the export sees at-rest KV.
+            self._drain_inflight(self._clock())
         if req in self._queue:
             self._queue.remove(req)
         if uid in self._live:
@@ -540,6 +567,11 @@ class ContinuousBatchScheduler:
         Export happens BEFORE detach — export pops the uid from this
         engine's stores, so by the time detach's flush runs the uid is
         resident nowhere on the source (no uid in two stores, ever)."""
+        if self._inflight is not None and uid in self._inflight["rows"]:
+            # absorb the in-flight round before export: export_swap demands
+            # at-rest KV (no uncommitted positions), and the payload must
+            # cover every token the journal entry will claim
+            self._drain_inflight(self._clock())
         payload = None
         export = getattr(self.engine, "export_swap", None)
         if export is not None and self._engine_dead is None:
@@ -865,6 +897,10 @@ class ContinuousBatchScheduler:
         self._stalled = False
         self._starved_prio = None
         self._fused_since_prefill = 0
+        # a round in flight died with the device — its tokens were never
+        # absorbed, so the journal replay regenerates them bitwise
+        self._inflight = None
+        self._pending_absorb = None
         cancelled = 0
         rnow = self._clock()
         for req in [r for r in self._queue
@@ -1417,6 +1453,22 @@ class ContinuousBatchScheduler:
             self.decode_horizon - 1)
 
     def _decode_once(self, now: float) -> None:
+        """One decode iteration. ``pipelined=False``: the synchronous loop —
+        plan, dispatch, wait, absorb, all in this call
+        (:meth:`_decode_sync`). ``pipelined=True``: the plan/dispatch/absorb
+        stages run with ONE step in flight — this call fetches the previous
+        round, plans and dispatches the next from its tokens, and only then
+        absorbs the fetched round (:meth:`_pipeline_dispatch_stage` +
+        :meth:`_pipeline_absorb_stage`), so the device executes round N+1
+        through the whole host phase of round N."""
+        if self.pipelined:
+            staged = self._pipeline_dispatch_stage(now)
+            if staged is not None:
+                self._pipeline_absorb_stage(staged, now)
+            return
+        self._decode_sync(now)
+
+    def _decode_sync(self, now: float) -> None:
         """One engine dispatch: the live decode feed plus — under chunked
         interleaved prefill — as many pending prefill-chunk rows as the
         token budget holds, in ONE compiled ragged program. Pure decode
@@ -1546,6 +1598,262 @@ class ContinuousBatchScheduler:
         else:
             self._absorb(out, now)
 
+    # ------------------------------------------------------------------
+    # pipelined dispatch (docs/SERVING.md "Pipelined dispatch")
+    # ------------------------------------------------------------------
+    def _pipeline_barrier(self, now: float, feed: Dict[int, int],
+                          backlog: int) -> bool:
+        """True when THIS round cannot run with a step in flight and must
+        take the synchronous path (after draining the pipe):
+
+        - a chunked-prefill backlog: prompt chunks ride the mixed ragged
+          dispatch, whose host sync is inherent;
+        - a stalled monolithic prefill draining;
+        - speculation configured, or the adaptive horizon choosing a fused
+          round: both commit/rollback against their absorb the SAME step;
+        - a fed request with a dynamic logit processor: its bias row must
+          be refreshed from the absorbed token BEFORE the next dispatch
+          samples it — a one-late absorb would sample under a stale mask.
+        """
+        if backlog or self._stalled or self.spec is not None:
+            return True
+        if feed and self._effective_horizon(now, feed) > 1:
+            return True
+        for uid in feed:
+            sp = self._live[uid].sampling
+            if sp is not None and sp.dynamic:
+                return True
+        return False
+
+    def _pipeline_dispatch_stage(self, now: float
+                                 ) -> Optional[Dict[str, object]]:
+        """PLAN + DISPATCH with one step in flight. Fetches the previous
+        round's tokens (the deferred host sync — by now the device had the
+        whole intervening host phase to run), plans the next feed from
+        them, dispatches it, and returns the fetched round staged for
+        :meth:`_pipeline_absorb_stage` — which runs while the new dispatch
+        executes. Returns None when the round took the synchronous path
+        (pipeline barrier) or there was nothing to fetch."""
+        t_plan0 = time.perf_counter()
+        backlog = self._prefill_backlog() if self.chunked_prefill else 0
+        if not backlog:
+            # same re-arm rule as the synchronous loop (see _decode_sync)
+            self._starved_prio = None
+            self._fused_since_prefill = 0
+        # candidate decode rows, the sync twin's feed-build rule: a token
+        # deferred inside the engine (in_flight) is never double-fed
+        cands: Dict[int, int] = {}
+        for uid, r in self._live.items():
+            if r.state is not RequestState.DECODE:
+                continue
+            d = self.engine.state.seqs.get(uid)
+            if d is not None and d.in_flight == 0:
+                cands[uid] = r.tokens[-1]
+        if self._pipeline_barrier(now, cands, backlog):
+            if self._inflight is not None:
+                self.metrics.observe_pipeline_stall()
+                self._drain_inflight(now)
+            self._decode_sync(now)
+            return None
+        prev = self._inflight
+        raw: Optional[Dict[int, int]] = None
+        wait_dt = 0.0
+        if prev is not None:
+            t_wait0 = time.perf_counter()
+            try:
+                raw = prev["handle"].fetch()
+            except UnrecoverableEngineError:
+                # the round died with the device: nothing of it was
+                # absorbed, so journal replay regenerates its tokens
+                # bitwise from the last committed state
+                self._inflight = None
+                raise
+            wait_dt = time.perf_counter() - t_wait0
+        if not cands and prev is None:
+            return None
+        # plan the next feed. Rows riding the fetched round are fed their
+        # brand-new token; predicted finishes (EOS / max_new_tokens —
+        # decidable from the raw token alone) are NOT fed. Stop-sequence
+        # finishes are NOT predicted (the scan is stateful): those rows
+        # are fed speculatively and the successor token rolled back at
+        # absorb — the speculative-absorb rule.
+        next_feed: Dict[int, int] = {}
+        for uid, last_tok in cands.items():
+            r = self._live[uid]
+            if prev is not None and raw is not None and uid in prev["rows"]:
+                rec_req, rec_desc, rec_emitted = prev["rows"][uid]
+                if (r is rec_req and len(r.tokens) == rec_emitted
+                        and self.engine.state.seqs.get(uid) is rec_desc):
+                    tok = raw[uid]
+                    if (len(r.tokens) + 1 >= r.max_new_tokens
+                            or (r.eos_token is not None
+                                and tok == r.eos_token)):
+                        continue  # finishes at absorb: never fed
+                    next_feed[uid] = tok
+                    continue
+                # stale row (preempted/re-admitted since dispatch): its
+                # in-flight token is discarded at absorb; feeding the
+                # committed last token regenerates it bitwise
+            next_feed[uid] = last_tok
+        plan_dt = time.perf_counter() - t_plan0 - wait_dt
+        handle = None
+        enqueue_dt = 0.0
+        if next_feed:
+            attempt = 0
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    handle = self.engine.decode_dispatch(next_feed)
+                    enqueue_dt = time.perf_counter() - t0
+                    break
+                except TransientEngineError as e:
+                    if not self._retry_transient("decode_step", attempt, e):
+                        raise
+                    attempt += 1
+                except (RequestFailedError, ContextOverflowError) as e:
+                    if e.uid is None or e.uid not in self._all:
+                        raise
+                    self._contain(e.uid, e, now)
+                    break  # absorb the fetched round below (stale rows skip)
+                except PoolExhaustedError:
+                    if not self.preemption:
+                        raise
+                    if prev is not None:
+                        # fed rows still carry the fetched round's
+                        # provisional position, so swap_out would decline
+                        # every victim: let the pipe run dry, absorb (and
+                        # commit) below, and re-plan next step against
+                        # at-rest rows — preempting there keeps the
+                        # swap-vs-recompute economics of the sync twin
+                        break
+                    victim = self._pick_victim()
+                    if victim is None or (
+                            len(self._live) == 1
+                            and victim.state is RequestState.PREFILL):
+                        raise
+                    self._preempt(victim)
+                    break  # the pipe restarts next step, smaller batch
+        if handle is not None:
+            self._inflight = {
+                "handle": handle,
+                "rows": {uid: (self._live[uid],
+                               self.engine.state.seqs.get(uid),
+                               len(self._live[uid].tokens))
+                         for uid in handle.uids},
+                "enqueue_dt": enqueue_dt,
+            }
+            self.metrics.observe_pipeline_dispatch(len(handle.uids))
+        else:
+            self._inflight = None
+            if next_feed:
+                self.metrics.observe_pipeline_stall()  # pipe ran dry
+        if prev is None or raw is None:
+            return None
+        return {"prev": prev, "raw": raw, "wait_dt": wait_dt,
+                "plan_dt": plan_dt}
+
+    def _pipeline_absorb_stage(self, staged: Dict[str, object],
+                               now: float) -> None:
+        """ABSORB one fetched round — one step late. Runs while the
+        successor dispatch executes on device. Per row: emit the token
+        (the journal's one commit point — in-flight tokens are never
+        journaled), then settle the engine's provisional positions via
+        ``commit_step``: a surviving row retains its successor's in-flight
+        position; a finishing row detected HERE (a stop sequence — the
+        speculative miss) drops the successor position it was speculatively
+        fed, counted as a speculative rollback; stale rows (preempted /
+        re-admitted / cancelled since dispatch) are skipped — their tokens
+        regenerate bitwise from committed state on replay."""
+        prev, raw = staged["prev"], staged["raw"]
+        cur = self._inflight
+        t0 = time.perf_counter()
+        absorbed = 0
+        for uid, (req, desc, emitted) in prev["rows"].items():
+            r = self._live.get(uid)
+            if r is None:  # cancelled between dispatch and absorb
+                self._engine_flush(uid)
+                continue
+            if (r is not req or r.state is not RequestState.DECODE
+                    or len(r.tokens) != emitted
+                    or self.engine.state.seqs.get(uid) is not desc):
+                continue  # stale: the in-flight token is discarded
+            finished = self._emit_token(r, raw[uid], now)
+            absorbed += 1
+            drop = 0
+            retain = 0
+            if cur is not None and uid in cur["rows"]:
+                if finished:
+                    drop = 1
+                    del cur["rows"][uid]
+                    self.metrics.observe_pipeline_rollback(1)
+                else:
+                    retain = 1
+                    # the successor round snapshotted this row BEFORE the
+                    # emit above; refresh its expected-emitted count so the
+                    # next absorb's staleness check sees the new length
+                    c_req, c_desc, _ = cur["rows"][uid]
+                    cur["rows"][uid] = (c_req, c_desc, len(r.tokens))
+            self._engine_commit(uid, drop, retain)
+            if finished:
+                self._finish(r, now)
+        absorb_dt = time.perf_counter() - t0
+        dt = prev["enqueue_dt"] + staged["wait_dt"]
+        self._observe_engine_ok("decode", dt, scale=1.0)
+        if absorbed:
+            self.metrics.observe_step(
+                dt, absorbed, horizon=1, plan_s=staged["plan_dt"],
+                wait_s=staged["wait_dt"], absorb_s=absorb_dt)
+            self.metrics.observe_decode(1, fused=False)
+            self._token_est_s = (dt if self._token_est_s == 0.0
+                                 else 0.5 * self._token_est_s + 0.5 * dt)
+        self.metrics.observe_pipeline_in_flight(
+            len(cur["rows"]) if cur is not None else 0)
+
+    def _drain_inflight(self, now: float) -> None:
+        """Drain boundary: fetch and absorb the in-flight round NOW. Every
+        synchronous-path interaction (mixed prefill dispatch, fused or
+        speculative rounds, migration detach, close) runs against an
+        at-rest engine — the TransferEngine drain-at-boundary discipline."""
+        prev = self._inflight
+        if prev is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            raw = prev["handle"].fetch()
+        except UnrecoverableEngineError:
+            self._inflight = None
+            raise
+        wait_dt = time.perf_counter() - t0
+        self._inflight = None
+        self._pipeline_absorb_stage(
+            {"prev": prev, "raw": raw, "wait_dt": wait_dt, "plan_dt": 0.0},
+            now)
+
+    def _engine_commit(self, uid: int, drop: int, retain: int) -> None:
+        """``engine.commit_step`` with the flush/preempt fault contract: an
+        engine loss is absorbed (the positions died with the pool; the
+        next step recovers), transients retry with the same arguments."""
+        attempt = 0
+        while True:
+            try:
+                self.engine.commit_step(uid, drop, retain)
+                return
+            except UnrecoverableEngineError as e:
+                self._note_engine_lost(e)
+                return
+            except TransientEngineError as e:
+                if not self._retry_transient("flush", attempt, e):
+                    raise
+                attempt += 1
+
+    def _inflight_ledger(self) -> Dict[int, int]:
+        """The declared in-flight provisional spans, ``{uid: tokens}`` —
+        what the sanitizers are told to expect in ``uncommitted``."""
+        if self._inflight is None:
+            return {}
+        return {uid: self._inflight["handle"].span
+                for uid in self._inflight["rows"]}
+
     def _absorb_speculation(self, out: Dict[int, List[int]],
                             drafts: Dict[int, List[int]],
                             now: float) -> None:
@@ -1606,13 +1914,71 @@ class ContinuousBatchScheduler:
         decode+prefill-chunk rows when a backlog is pending. Returns True
         while work remains.
 
+        Internally ``step()`` is the two-phase drive run back to back:
+        :meth:`step_dispatch` then :meth:`step_absorb`. A pool calls the
+        phases separately across its replicas (dispatch-all, then
+        absorb-all) so N devices execute concurrently instead of
+        serializing behind each other's host phases.
+
         Engine-loss wrapper (docs/RESILIENCE.md): an
         :class:`UnrecoverableEngineError` from any engine-touching phase —
         or one recorded earlier on a teardown path — routes to
         :meth:`_recover` instead of propagating; the step ends after the
         rebuild and the replay proceeds from the next step's normal
         admission."""
+        self.step_dispatch()
+        return self.step_absorb()
+
+    def step_dispatch(self) -> None:
+        """Pool phase 1 (docs/SERVING.md "Pipelined dispatch"): admission +
+        plan + dispatch WITHOUT waiting on the device, so a pool can start
+        every replica's round before absorbing any. A synchronous scheduler
+        waits on the device inside its one dispatch call, so for it phase 1
+        is a no-op and the whole classic step runs in :meth:`step_absorb` —
+        the two-phase drive degrades to the sequential loop, byte for
+        byte."""
+        if not self.pipelined:
+            return
         now = self._clock()
+        if self._engine_dead is not None:
+            exc, self._engine_dead = self._engine_dead, None
+            if self.escalate_losses:
+                raise exc
+            self._recover(exc, now)
+            now = self._clock()
+        self.breaker.poll(now)
+        self._expire_deadlines(now)
+        try:
+            self._admit(now)
+            if self._stalled:
+                self._absorb(self._engine_put([], []), now)
+            self._pending_absorb = self._pipeline_dispatch_stage(now)
+        except UnrecoverableEngineError as e:
+            self._inflight = None
+            self._pending_absorb = None
+            if self.escalate_losses:
+                raise
+            self._recover(e, now)
+
+    def step_absorb(self) -> bool:
+        """Pool phase 2: absorb what :meth:`step_dispatch` staged — while
+        the successor round executes on device — or, for a synchronous
+        scheduler, run the whole classic step; then close the step with
+        gauges, sanitizers, and the work-remaining verdict."""
+        now = self._clock()
+        if self.pipelined:
+            staged, self._pending_absorb = self._pending_absorb, None
+            try:
+                if staged is not None:
+                    self._pipeline_absorb_stage(staged, now)
+            except UnrecoverableEngineError as e:
+                self._inflight = None
+                if self.escalate_losses:
+                    raise
+                self._recover(e, now)
+            self._step_postamble()
+            return bool(self._queue or self._live
+                        or self._inflight is not None)
         if self._engine_dead is not None:
             exc, self._engine_dead = self._engine_dead, None
             if self.escalate_losses:
@@ -1634,6 +2000,12 @@ class ContinuousBatchScheduler:
                 # the pool's detach sweep.
                 raise
             self._recover(e, now)
+        self._step_postamble()
+        return bool(self._queue or self._live)
+
+    def _step_postamble(self) -> None:
+        """End-of-step bookkeeping shared by both drive modes: gauges and
+        (under ``DSTPU_SANITIZE``) the between-steps invariant sweep."""
         self.metrics.observe_gauges(len(self._queue), len(self._live))
         self.metrics.observe_prefill_backlog(self._prefill_backlog())
         self.metrics.observe_resilience(self.breaker, self.watchdog)
@@ -1647,12 +2019,20 @@ class ContinuousBatchScheduler:
             _sanitizer.check_prefill_ownership(self.engine, self._live)
             # and every speculative dispatch must have been committed or
             # rolled back — uncommitted draft positions crossing a step
-            # boundary would let the prefix index cover unverified tokens
-            _sanitizer.check_speculation_commit(self.engine)
+            # boundary would let the prefix index cover unverified tokens.
+            # Pipelined mode declares its ONE in-flight round's spans; any
+            # uncommitted position beyond the declaration still trips.
+            ledger = self._inflight_ledger()
+            _sanitizer.check_speculation_commit(self.engine,
+                                                inflight=ledger or None)
             # with a host tier: every block in exactly one tier state, and
             # demoted index entries must resolve through the host tier
             _sanitizer.check_tier_conservation(self.engine)
-        return bool(self._queue or self._live)
+            if self.pipelined:
+                _sanitizer.check_pipeline_coherence(
+                    self.engine, self.journal, self._live, ledger,
+                    dispatch_uids=(self._inflight["handle"].uids
+                                   if self._inflight is not None else None))
 
     def run_until_complete(self) -> None:
         while self.step():
@@ -1693,7 +2073,7 @@ class ContinuousBatchScheduler:
                 self.cancel(req.uid, reason="drain")
         budget = self.watchdog.drain_budget_s
         deadline = None if budget is None else time.perf_counter() + budget
-        while self._live or self._queue:
+        while self._live or self._queue or self._inflight is not None:
             self.step()
             if deadline is not None and time.perf_counter() > deadline and (
                     self._live or self._queue):
@@ -1707,6 +2087,10 @@ class ContinuousBatchScheduler:
                 for req in list(self._queue):
                     self.cancel(req.uid, reason="drain_timeout")
                 break
+        # a bounded-drain abort may leave a round in flight with every row
+        # cancelled — discard it; block_until_ready settles the device
+        self._inflight = None
+        self._pending_absorb = None
         import jax
 
         jax.block_until_ready(self.engine.kv)

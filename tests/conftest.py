@@ -138,6 +138,7 @@ _SANITIZE_FILES = (
     "test_serve.py",
     "test_resilience.py",
     "test_fused_decode.py",
+    "test_pipelined_dispatch.py",
     "test_speculation.py",
     "test_inference_v2.py",
     "test_prefix_cache.py",

@@ -1,0 +1,511 @@
+"""Pipelined dispatch (docs/SERVING.md "Pipelined dispatch"): the
+``pipelined=True`` scheduler keeps one decode round in flight — plan N+1
+while N executes, absorb N while N+1 executes — and must stay BITWISE
+identical to the synchronous twin across the whole replay matrix: plain
+greedy, sampled, EOS / max_new / stop-sequence finishes (the
+speculative-absorb rollback), preemption churn, KV swap, mid-step engine
+loss, migration detach/adopt, and cancellation mid-flight. Plus: the
+``check_pipeline_coherence`` sanitizer's planted violations, the relaxed
+in-flight allowances on the existing checks, the per-replica heartbeat
+regression (fed at each replica's OWN absorb), and the two-phase pool
+step. Runs under ``DSTPU_SANITIZE=1`` in tier-1 via the conftest fixture."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.sanitizer import (SanitizerError,
+                                              check_pipeline_coherence,
+                                              check_speculation_commit,
+                                              checked_cache_cls)
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.resilience.errors import EngineUsageError
+from deepspeed_tpu.resilience.recovery import RequestJournal
+from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
+                                 FaultInjector, FaultSpec, HealthMonitor,
+                                 Request, RequestState, RetryPolicy,
+                                 SamplingParams)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    m = build_model("llama-tiny", vocab_size=128, hidden_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=128)
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _engine(m, params, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("block_size", 16)
+    kw.setdefault("token_budget", 16)
+    kw.setdefault("num_blocks", 64)
+    return InferenceEngineV2(m, params, paged=True, **kw)
+
+
+def _prompts(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 128, ln).tolist() for ln in (33, 30, 28)][:n]
+
+
+def _run(m, params, prompts, *, pipelined, gen=16, eos=None, sampling=None,
+         uids=None, injector=None, eng_kw=None, sched_kw=None):
+    """One full workload on a fresh engine; returns (engine, sched, reqs)."""
+    eng = _engine(m, params, **(eng_kw or {}))
+    wrapped = injector.wrap(eng) if injector is not None else eng
+    kw = dict(sched_kw or {})
+    kw.setdefault("sleep", lambda s: None)
+    sched = ContinuousBatchScheduler(wrapped, pipelined=pipelined, **kw)
+    reqs = [sched.submit(p, max_new_tokens=gen, eos_token=eos,
+                         uid=None if uids is None else uids[i],
+                         sampling=None if sampling is None else sampling[i])
+            for i, p in enumerate(prompts)]
+    sched.run_until_complete()
+    return eng, sched, reqs
+
+
+def _twin(m, params, prompts, **kw):
+    """Run the synchronous and pipelined twins; assert bitwise identity and
+    a clean drain; return (sync_reqs, pipe_reqs, pipe_sched)."""
+    _, _, sync = _run(m, params, prompts, pipelined=False, **kw)
+    eng, sched, pipe = _run(m, params, prompts, pipelined=True, **kw)
+    assert [r.tokens for r in pipe] == [r.tokens for r in sync]
+    assert sched._inflight is None
+    assert not eng.state.seqs and not eng.block_mgr._ref
+    return sync, pipe, sched
+
+
+# ---------------------------------------------------------------------------
+# bitwise twins across the replay matrix
+# ---------------------------------------------------------------------------
+
+class TestBitwiseTwins:
+    def test_pipelined_requires_paged(self, setup):
+        m, params = setup
+        eng = InferenceEngineV2(m, params, paged=False, max_seqs=4,
+                                max_seq_len=128)
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchScheduler(eng, pipelined=True)
+
+    def test_plain_greedy(self, setup):
+        """max_new_tokens finishes are PREDICTED at plan time (never fed to
+        the successor round) — no rollback traffic on a plain workload."""
+        m, params = setup
+        _, _, sched = _twin(m, params, _prompts())
+        p = sched.metrics.pipeline
+        assert p["dispatches"] > 0
+        assert p["in_flight"] == 0.0  # pipe drained at close
+        assert p["speculative_rollbacks"] == 0
+
+    def test_eos_finish(self, setup):
+        """An EOS landing mid-stream is decidable from the raw token at
+        plan time: the row is not fed, finishes at its absorb, and the
+        remaining rows keep the pipe full."""
+        m, params = setup
+        _, _, sync = _run(m, params, _prompts(), pipelined=False, gen=16)
+        # pick an eos that fires mid-stream for at least one request
+        eos = sync[0].tokens[7]
+        sref, pipe, sched = _twin(m, params, _prompts(), gen=16, eos=eos)
+        assert any(len(r.tokens) < 16 for r in pipe)
+        assert sched.metrics.pipeline["speculative_rollbacks"] == 0
+
+    def test_stop_sequence_speculative_rollback(self, setup):
+        """A stop-sequence finish is NOT predictable at plan time (the scan
+        is stateful): the row is fed speculatively and the successor
+        position rolled back at absorb — the speculative-absorb rule."""
+        m, params = setup
+        _, _, sync = _run(m, params, _prompts(), pipelined=False, gen=16)
+        # a 2-token stop ending mid-stream: matched only by the StopScanner
+        stop = tuple(sync[1].tokens[5:7])
+        sampling = [SamplingParams(stop=(stop,)) for _ in range(3)]
+        sref, pipe, sched = _twin(m, params, _prompts(), gen=16,
+                                  sampling=sampling)
+        assert len(pipe[1].tokens) < 16  # cut at the match
+        assert sched.metrics.sampling["stop_hits"] >= 1
+        assert sched.metrics.pipeline["speculative_rollbacks"] >= 1
+
+    def test_sampled(self, setup):
+        """Counter-based per-request PRNG keys make the one-late absorb
+        invisible to sampled decoding too."""
+        m, params = setup
+        sampling = [SamplingParams(temperature=0.8, top_k=40, seed=100 + i)
+                    for i in range(3)]
+        _twin(m, params, _prompts(), sampling=sampling,
+              uids=[901, 902, 903])
+
+    def test_preemption_churn(self, setup):
+        """A starved pool preempts an IN-FLIGHT row: the engine declines to
+        swap uncommitted sequences (flush+replay), and the replay
+        regenerates the discarded in-flight token bitwise."""
+        m, params = setup
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 127, 17).tolist() for _ in range(4)]
+        _, _, sched = _twin(m, params, prompts, gen=40,
+                            eng_kw={"num_blocks": 13,
+                                    "host_tier_blocks": 0},
+                            sched_kw={"retry": RetryPolicy(max_attempts=5)})
+        assert sched.metrics.preemptions > 0
+
+    def test_kv_swap(self, setup):
+        """Same churn with a host tier and forced swap preemption: victims
+        leave through swap-out and re-admit through swap-in under the
+        pipelined loop."""
+        m, params = setup
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, 127, 17).tolist() for _ in range(4)]
+        _, _, sched = _twin(m, params, prompts, gen=40,
+                            eng_kw={"num_blocks": 13,
+                                    "host_tier_blocks": 32},
+                            sched_kw={"retry": RetryPolicy(max_attempts=5),
+                                      "swap_preemption": True})
+        assert sched.metrics.preemptions > 0
+        kv = sched.metrics.kvtier
+        assert kv["swap_out"] >= 1 and kv["swap_in"] >= 1
+
+    def test_mid_step_engine_loss(self, setup):
+        """A device loss with one step in flight: nothing of the in-flight
+        round was absorbed, so journal replay from the last committed state
+        regenerates every token bitwise."""
+        m, params = setup
+        _, _, sync = _run(m, params, _prompts(), pipelined=False, gen=12)
+        inj = FaultInjector([FaultSpec(site="decode_step",
+                                       kind="device_lost", nth=4)])
+        eng, sched, pipe = _run(
+            m, params, _prompts(), pipelined=True, gen=12, injector=inj,
+            sched_kw={"retry": RetryPolicy(max_attempts=5)})
+        assert inj.deaths == 1 and eng.rebuilds == 1
+        assert all(r.state is RequestState.DONE for r in pipe)
+        assert [r.tokens for r in pipe] == [r.tokens for r in sync]
+        assert sched.metrics.faults["engine_losses"] == 1
+        assert len(sched.journal) == 0
+
+    def test_migration_detach_adopt(self, setup):
+        """detach() is a drain boundary: the JournalEntry carries every
+        device-produced token (including the one that was in flight), so
+        the adopting scheduler resumes bitwise."""
+        m, params = setup
+        prompts = _prompts()
+        _, _, sync = _run(m, params, prompts, pipelined=False, gen=12)
+        src = ContinuousBatchScheduler(_engine(m, params), pipelined=True,
+                                       sleep=lambda s: None)
+        reqs = [src.submit(p, max_new_tokens=12) for p in prompts]
+        for _ in range(30):  # past prefill, into pipelined decode
+            src.step()
+            if src._inflight is not None:
+                break
+        assert src._inflight is not None
+        uid = reqs[0].uid
+        entry = src.detach(uid)
+        assert src._inflight is None  # detach drained the pipe
+        dst = ContinuousBatchScheduler(_engine(m, params), pipelined=True,
+                                       sleep=lambda s: None)
+        moved = dst.adopt(entry)
+        src.run_until_complete()
+        dst.run_until_complete()
+        assert moved.tokens == sync[0].tokens
+        assert [r.tokens for r in reqs[1:]] == [r.tokens for r in sync[1:]]
+        src.close()
+        dst.close()
+
+    def test_cancel_mid_flight(self, setup):
+        """Cancelling a request whose row is in flight: the absorb skips it
+        (flushed), survivors are unperturbed."""
+        m, params = setup
+        prompts = _prompts()
+        _, _, sync = _run(m, params, prompts, pipelined=False, gen=12)
+        eng = _engine(m, params)
+        sched = ContinuousBatchScheduler(eng, pipelined=True,
+                                         sleep=lambda s: None)
+        reqs = [sched.submit(p, max_new_tokens=12) for p in prompts]
+        for _ in range(30):
+            sched.step()
+            if (sched._inflight is not None
+                    and reqs[2].uid in sched._inflight["rows"]):
+                break
+        assert sched._inflight is not None and reqs[2].uid in (
+            sched._inflight["rows"])
+        assert sched.cancel(reqs[2].uid)
+        sched.run_until_complete()
+        assert reqs[2].state is RequestState.CANCELLED
+        assert [r.tokens for r in reqs[:2]] == [r.tokens for r in sync[:2]]
+        sched.close()
+        assert not eng.state.seqs and not eng.block_mgr._ref
+
+    def test_stage_timing_split(self, setup):
+        """observe_step's conflated number is split: the pipelined run
+        populates the plan/wait/absorb gauges, the sync twin leaves them 0."""
+        m, params = setup
+        _, sync_sched, _ = _run(m, params, _prompts(), pipelined=False)
+        assert sync_sched.metrics.pipeline["device_wait_ms"] == 0.0
+        _, _, sched = _twin(m, params, _prompts())
+        p = sched.metrics.pipeline
+        assert p["device_wait_ms"] > 0.0 and p["absorb_ms"] > 0.0
+        events = dict((k, v) for k, v, _ in sched.metrics.events())
+        assert "serve/pipeline/dispatches" in events
+        assert events["serve/pipeline/dispatches"] == p["dispatches"]
+
+
+# ---------------------------------------------------------------------------
+# engine seam: decode_dispatch / commit_step contracts
+# ---------------------------------------------------------------------------
+
+class TestEngineSeam:
+    def test_dispatch_matches_decode_step_bitwise(self, setup):
+        m, params = setup
+        prompt = _prompts(1)[0]
+        ref = _engine(m, params)
+        t = int(ref.put([1], [prompt], greedy=True)[1])
+        singles = []
+        for _ in range(6):
+            t = int(ref.decode_step({1: t}, greedy=True)[1])
+            singles.append(t)
+        eng = _engine(m, params)
+        t = int(eng.put([7], [prompt], greedy=True)[7])
+        got = []
+        for _ in range(6):
+            h = eng.decode_dispatch({7: t})
+            t = h.fetch()[7]
+            eng.commit_step(7, 0, 0)
+            got.append(t)
+        assert got == singles
+        assert eng.state.seqs[7].uncommitted == 0
+        eng.flush(7)
+
+    def test_double_dispatch_same_uid_raises(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        t = int(eng.put([1], [_prompts(1)[0]], greedy=True)[1])
+        h = eng.decode_dispatch({1: t})
+        with pytest.raises(EngineUsageError, match="drain"):
+            eng.decode_dispatch({1: t})
+        h.fetch()
+        eng.commit_step(1, 0, 0)
+        eng.flush(1)
+
+    def test_commit_drop_rolls_back_the_fed_position(self, setup):
+        m, params = setup
+        eng = _engine(m, params)
+        t = int(eng.put([1], [_prompts(1)[0]], greedy=True)[1])
+        d = eng.state.seqs[1]
+        seen0 = d.seen_tokens
+        h = eng.decode_dispatch({1: t})
+        assert d.seen_tokens == seen0 + 1 and d.uncommitted == 1
+        h.fetch()
+        eng.commit_step(1, drop=1, retain=0)
+        assert d.seen_tokens == seen0 and d.uncommitted == 0
+        eng.flush(1)
+        assert not eng.block_mgr._ref
+
+
+# ---------------------------------------------------------------------------
+# check_pipeline_coherence: planted violations
+# ---------------------------------------------------------------------------
+
+class _FakeReq:
+    def __init__(self, state=RequestState.DECODE):
+        self.state = state
+
+
+def _inflight_state(m, params):
+    """A real engine with uid 1 in flight plus a coherent journal/live
+    view — the fixture every planted violation perturbs."""
+    eng = _engine(m, params)
+    prompt = _prompts(1)[0]
+    t = int(eng.put([1], [prompt], greedy=True)[1])
+    journal = RequestJournal()
+    req = Request(prompt=list(prompt), max_new_tokens=8, uid=1)
+    journal.record(req)
+    req.tokens.append(t)
+    journal.commit(req)
+    handle = eng.decode_dispatch({1: t})
+    live = {1: _FakeReq()}
+    return eng, journal, live, handle
+
+
+class TestCoherenceSanitizer:
+    def test_coherent_state_is_silent(self, setup):
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        check_pipeline_coherence(eng, journal, live, {1: 1},
+                                 dispatch_uids=[1])
+        handle.fetch()
+        eng.commit_step(1, 0, 0)
+        check_pipeline_coherence(eng, journal, live, {})
+        eng.flush(1)
+
+    def test_double_feed_raises(self, setup):
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        with pytest.raises(SanitizerError, match="double-feed"):
+            check_pipeline_coherence(eng, journal, live, {1: 1},
+                                     dispatch_uids=[1, 1])
+
+    def test_ledger_drift_raises(self, setup):
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        with pytest.raises(SanitizerError, match="ledger drift"):
+            check_pipeline_coherence(eng, journal, live, {1: 2})
+
+    def test_ledger_uid_without_live_request_raises(self, setup):
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        with pytest.raises(SanitizerError, match="no live request"):
+            check_pipeline_coherence(eng, journal, {}, {1: 1})
+
+    def test_journal_ahead_of_absorb_raises(self, setup):
+        """Committing the in-flight step's token before its absorb is THE
+        corruption this sanitizer exists for (a recovery after it would
+        replay a token the device never confirmed)."""
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        journal.get(1).tokens.append(42)  # token from the un-absorbed step
+        with pytest.raises(SanitizerError, match="journal ahead"):
+            check_pipeline_coherence(eng, journal, live, {1: 1})
+
+    def test_rollback_refcount_drift_raises(self, setup):
+        """After absorb+commit an at-rest row's block list must cover its
+        committed positions exactly (modulo the standing one-token
+        over-allocation)."""
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        handle.fetch()
+        eng.commit_step(1, 0, 0)
+        d = eng.state.seqs[1]
+        d.blocks = d.blocks + [d.blocks[-1]] * 2  # leak two phantom blocks
+        with pytest.raises(SanitizerError, match="refcount drift"):
+            check_pipeline_coherence(eng, journal, live, {})
+
+    def test_speculation_check_honours_inflight_allowance(self, setup):
+        m, params = setup
+        eng, journal, live, handle = _inflight_state(m, params)
+        with pytest.raises(SanitizerError, match="uncommitted speculation"):
+            check_speculation_commit(eng)  # no allowance declared
+        check_speculation_commit(eng, inflight={1: 1})  # declared: silent
+        handle.fetch()
+        eng.commit_step(1, 0, 0)
+        check_speculation_commit(eng)
+        eng.flush(1)
+
+    def test_checked_register_rejects_inflight_index(self, setup):
+        """The checked cache's register() guard: a prefix-index limit that
+        would cover in-flight positions is the bug, a bounded one is the
+        designed pipelined commit."""
+        m, params = setup
+        cache = checked_cache_cls()(16, 16, 8, prefix_cache=True)
+        from deepspeed_tpu.inference.v2.ragged_manager import (
+            SequenceDescriptor)
+        d = SequenceDescriptor(uid=1, slot=0)
+        cache.ensure(d, 17)
+        d.seen_tokens = 17
+        d.history = list(range(17))
+        d.uncommitted = 1
+        with pytest.raises(SanitizerError):
+            cache.register(d)  # unbounded: would index the in-flight tail
+        cache.register(d, limit=16)  # bounded below the in-flight tail
+        d.uncommitted = 0
+        cache.free(d)
+
+
+# ---------------------------------------------------------------------------
+# pool: two-phase step + per-replica heartbeat regression
+# ---------------------------------------------------------------------------
+
+def _pool(m, params, n, *, pipelined, clock=None, eng_kw=None):
+    def factory(i):
+        return _engine(m, params, **(eng_kw or {}))
+    kw = {} if clock is None else {"clock": clock}
+    return EnginePool.build(factory, n, pipelined=pipelined,
+                            sleep=lambda s: None, **kw)
+
+
+class TestPoolTwoPhase:
+    def test_pool_pipelined_bitwise(self, setup):
+        """N pipelined replicas, dispatch-all then absorb-all: every
+        request matches the fault-free single-engine synchronous oracle."""
+        m, params = setup
+        prompts = _prompts(3, seed=5) + _prompts(3, seed=6)
+        uids = [700 + i for i in range(len(prompts))]
+        ref = {}
+        for p, u in zip(prompts, uids):
+            _, _, reqs = _run(m, params, [p], pipelined=False, gen=8,
+                              uids=[u])
+            ref[u] = list(reqs[0].tokens)
+        pool = _pool(m, params, 3, pipelined=True)
+        reqs = [pool.submit(p, max_new_tokens=8, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        for r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[r.uid], f"uid {r.uid} diverged"
+        pool.close()
+
+    def test_heartbeat_fed_at_each_replicas_own_absorb(self, setup):
+        """Regression (the satellite bugfix): with dispatch-all/absorb-all
+        the lease must be fed per replica AT ITS OWN ABSORB. A straggler
+        burning wall-clock in its host phase must not stamp its
+        neighbours' leases with a stale (or pool-end) timestamp: each
+        replica's lease deadline reflects the clock at ITS absorb, so the
+        deadlines strictly increase across the absorb order."""
+        m, params = setup
+        t = [0.0]
+        pool = _pool(m, params, 3, pipelined=True, clock=lambda: t[0])
+        mon = pool.enable_health(HealthMonitor(clock=lambda: t[0],
+                                               lease_s=30.0))
+        for rep in pool.replicas:
+            orig = rep.scheduler.step_absorb
+
+            def absorb(_orig=orig):
+                out = _orig()
+                t[0] += 10.0  # this replica's host phase burns 10s
+                return out
+            rep.scheduler.step_absorb = absorb
+        pool.step()
+        deadlines = [mon.lease_deadline_of(r.replica_id)
+                     for r in pool.replicas]
+        # fed at own absorb: replica i's lease was stamped after its own
+        # 10s host phase — strictly increasing, 10s apart
+        assert deadlines[1] == pytest.approx(deadlines[0] + 10.0)
+        assert deadlines[2] == pytest.approx(deadlines[1] + 10.0)
+        # and nobody's lease is stale relative to the pool-step end
+        assert all(d > t[0] for d in deadlines)
+        pool.close()
+
+    def test_replica_lost_in_dispatch_phase_is_skipped_in_absorb(self,
+                                                                 setup):
+        """A replica dying in phase 1 is absorbed (journal replay onto
+        survivors) and NOT stepped again in phase 2; its requests finish
+        bitwise on the survivors."""
+        m, params = setup
+        prompts = _prompts(3, seed=9)
+        uids = [810, 811, 812]
+        ref = {}
+        for p, u in zip(prompts, uids):
+            _, _, reqs = _run(m, params, [p], pipelined=False, gen=6,
+                              uids=[u])
+            ref[u] = list(reqs[0].tokens)
+
+        engines = {}
+
+        def factory(i):
+            eng = _engine(m, params)
+            engines[i] = eng
+            if i == 0:
+                inj = FaultInjector([FaultSpec(site="decode_step",
+                                               kind="device_lost", nth=2)])
+                return inj.wrap(eng)
+            return eng
+
+        pool = EnginePool.build(factory, 2, pipelined=True,
+                                sleep=lambda s: None,
+                                retry=RetryPolicy(max_attempts=5))
+        reqs = [pool.submit(p, max_new_tokens=6, uid=u)
+                for p, u in zip(prompts, uids)]
+        pool.run_until_complete()
+        for r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == ref[r.uid], f"uid {r.uid} diverged"
+        pool.close()
