@@ -85,6 +85,7 @@ import numpy as np
 # transfer discipline: SIGTERM drains in-flight device work instead of dying
 # mid-transfer (the r4 relay-wedge cause; see deepspeed_tpu/utils/transfer.py)
 from deepspeed_tpu.utils.transfer import install_transfer_guard
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 install_transfer_guard()
 
@@ -441,8 +442,7 @@ def run_decode_horizon(max_seqs: int, prefix_cache: bool = True) -> dict:
         toks_by_k[K] = r.pop("request_tokens")
         r.pop("request_states")
         r["compiled_programs"] = eng.ragged_cache_size + eng.fused_cache_size
-        assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1, (
-            eng.ragged_cache_size, eng.fused_cache_size)
+        assert_trace_bounds(eng)
         horizons[f"K{K}"] = r
         del eng
         gc.collect()
@@ -516,8 +516,7 @@ def run_pipelined_dispatch(max_seqs: int, prefix_cache: bool = True) -> dict:
             prefix_cache=prefix_cache)
 
     def _bounds(eng):
-        assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1, (
-            eng.ragged_cache_size, eng.fused_cache_size)
+        assert_trace_bounds(eng)
 
     # ---- engine arm: K=1 steady-state decode, sync twin vs pipelined ----
     load_kw = dict(arrival_rate=1e9, prompt_lo=8, prompt_hi=16)
@@ -741,10 +740,7 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
     # warm the degraded-path fused K=16 program off the clock too
     measure(eng_s, rep_prompts, [GEN], spec=False, passes=1)
     rep_spec, rep_spec_toks = measure(eng_s, rep_prompts, [GEN], spec=True)
-    assert eng_s.ragged_cache_size <= 4 and eng_s.fused_cache_size <= 1 \
-        and eng_s.verify_cache_size <= 1, (
-            eng_s.ragged_cache_size, eng_s.fused_cache_size,
-            eng_s.verify_cache_size)
+    assert_trace_bounds(eng_s)
     rep_programs = (eng_s.ragged_cache_size + eng_s.fused_cache_size
                     + eng_s.verify_cache_size)
     del eng_s
@@ -766,10 +762,7 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
         eng_d, rep_prompts, [GEN], spec=True,
         proposer=DraftModelProposer(model, params, window=64,
                                     max_draft=K_SPEC - 1))
-    assert eng_d.ragged_cache_size <= 4 and eng_d.fused_cache_size <= 1 \
-        and eng_d.verify_cache_size <= 1, (
-            eng_d.ragged_cache_size, eng_d.fused_cache_size,
-            eng_d.verify_cache_size)
+    assert_trace_bounds(eng_d)
     del eng_d
     gc.collect()
 
@@ -783,10 +776,7 @@ def run_spec_decode(max_seqs: int, prefix_cache: bool = True) -> dict:
                                       spec=False)
     nat_spec, nat_spec_toks = measure(eng_n, nat_prompts, nat_gens,
                                       spec=True)
-    assert eng_n.ragged_cache_size <= 4 and eng_n.fused_cache_size <= 1 \
-        and eng_n.verify_cache_size <= 1, (
-            eng_n.ragged_cache_size, eng_n.fused_cache_size,
-            eng_n.verify_cache_size)
+    assert_trace_bounds(eng_n)
     del eng_n
     gc.collect()
 
@@ -928,10 +918,7 @@ def run_sampling(max_seqs: int, prefix_cache: bool = True) -> dict:
     # sampling must actually sample (any tie-free logit row diverges from
     # argmax almost surely at temperature 0.8)
     assert sampled_toks != greedy_toks
-    assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1 \
-        and eng.verify_cache_size <= 1, (
-            eng.ragged_cache_size, eng.fused_cache_size,
-            eng.verify_cache_size)
+    assert_trace_bounds(eng)
     programs = (eng.ragged_cache_size + eng.fused_cache_size
                 + eng.verify_cache_size)
 
@@ -996,10 +983,7 @@ def run_sampling(max_seqs: int, prefix_cache: bool = True) -> dict:
             "tokens_token_for_token": rep_spec_toks == rep_plain_toks,
             "acceptance_rate": rep_spec["spec"]["acceptance_rate"],
         }
-    assert eng_s.ragged_cache_size <= 4 and eng_s.fused_cache_size <= 1 \
-        and eng_s.verify_cache_size <= 1, (
-            eng_s.ragged_cache_size, eng_s.fused_cache_size,
-            eng_s.verify_cache_size)
+    assert_trace_bounds(eng_s)
     del eng_s
     gc.collect()
 
@@ -1102,7 +1086,7 @@ def run_prefill_convoy(max_seqs: int, prefix_cache: bool = True) -> dict:
         toks[label] = r.pop("request_tokens")
         r.pop("request_states")
         r["compiled_programs"] = eng.ragged_cache_size
-        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert_trace_bounds(eng)
         runs[label] = r
         del eng
         gc.collect()
@@ -1205,9 +1189,7 @@ def run_pool_scaling(max_seqs: int, prefix_cache: bool = True) -> dict:
             prefix_cache=prefix_cache)
 
     def _bounds(eng):
-        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
-        assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1, (
-            eng.fused_cache_size, eng.verify_cache_size)
+        assert_trace_bounds(eng)
 
     # fault-free single-engine reference — the bitwise oracle (greedy
     # decoding makes placement/migration/replay invisible in the tokens)

@@ -2,12 +2,22 @@
 (docs/ANALYSIS.md).
 
 Static side: ``python -m deepspeed_tpu.analysis deepspeed_tpu/`` (or the
-``dstpu-lint`` console script) runs five AST rule families — host syncs
+``dstpu-lint`` console script) runs seven AST rule families — host syncs
 and fresh allocations in serving hot paths (DSTPU001/002), untyped raises
 and string-matched dispatch (DSTPU003), retrace hazards in jitted code
-(DSTPU004), nondeterministic scheduler decisions (DSTPU005) — against a
-checked-in suppression baseline; tier-1 asserts zero unsuppressed
-findings.
+(DSTPU004), nondeterministic scheduler decisions (DSTPU005), transfer-
+ticket discipline (DSTPU006), mutate-before-raise exception safety in hot
+paths (DSTPU007) — against a checked-in suppression baseline; tier-1
+asserts zero unsuppressed findings.
+
+Program audit: every compiled program goes through
+:func:`audited_jit`, which fingerprints the jaxpr (op multiset, aval
+shapes collapsed to ``dtype[rank]``, donation map, narrow→wide float
+promotions, host callbacks) and pins it in the checked-in
+``analysis/programs.json`` manifest. ``DSTPU_AUDIT=1`` arms checking
+(unpinned program, digest drift, callback hazard, or trace-count
+overflow raise :class:`ProgramAuditError` with the registration site);
+``DSTPU_AUDIT=write`` re-pins. Off by default and zero-cost when off.
 
 Runtime side: ``DSTPU_SANITIZE=1`` arms checked mode — the engine builds
 a self-verifying KV block cache, every ``Request.state`` assignment is
@@ -20,6 +30,10 @@ from .baseline import default_path as default_baseline_path  # noqa: F401
 from .baseline import load as load_baseline  # noqa: F401
 from .baseline import save as save_baseline  # noqa: F401
 from .lint import Finding, lint_file, lint_paths, lint_source  # noqa: F401
+from .program_audit import (ProgramAuditError,  # noqa: F401
+                            ProgramRegistry, assert_trace_bounds,
+                            audited_jit, check_manifest,
+                            default_manifest_path)
 from .rules import ALL_RULE_IDS, HOT_FUNCTIONS, RULES, Rule  # noqa: F401
 from .sanitizer import (IllegalTransitionError,  # noqa: F401
                         LEGAL_TRANSITIONS, SanitizerError, check_drained,

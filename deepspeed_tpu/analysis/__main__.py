@@ -38,7 +38,17 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="accept current findings as intentional: rewrite "
                         "the baseline and exit 0")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit findings as a JSON array")
+                   help="emit a machine-readable JSON object: findings "
+                        "array + run summary (CI consumers key on "
+                        ".findings[].rule / .summary.unsuppressed)")
+    p.add_argument("--check-programs", action="store_true",
+                   help="program-audit dry mode (no retrace, no jax): "
+                        "verify analysis/programs.json parses, pins every "
+                        "audited_jit registration under PATHS, and carries "
+                        "no stale entries; exit 1 on drift")
+    p.add_argument("--programs", default=None, metavar="FILE",
+                   help="program manifest for --check-programs (default: "
+                        "the packaged analysis/programs.json)")
     p.add_argument("--cache", default=None, metavar="FILE", nargs="?",
                    const=default_cache_path(),
                    help="mtime-keyed finding cache: unchanged files lint "
@@ -74,6 +84,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"dstpu-lint: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.check_programs:
+        from .program_audit import check_manifest
+        problems = check_manifest(paths, args.programs)
+        for msg in problems:
+            print(msg)
+        if not args.quiet:
+            print(f"dstpu-lint: program manifest "
+                  f"{'DRIFTED' if problems else 'consistent'} "
+                  f"({len(problems)} problem"
+                  f"{'' if len(problems) == 1 else 's'})")
+        return 1 if problems else 0
 
     rule_ids = None
     if args.rules:
@@ -111,10 +133,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         unsuppressed, stale = baseline_mod.apply(findings, keys)
 
     if args.as_json:
-        print(json.dumps([{
-            "path": f.path, "line": f.line, "col": f.col, "rule": f.rule,
-            "message": f.message, "hint": f.hint, "qualname": f.qualname,
-        } for f in unsuppressed], indent=2))
+        print(json.dumps({
+            "version": 1,
+            "findings": [{
+                "path": f.path, "line": f.line, "col": f.col,
+                "rule": f.rule, "message": f.message, "hint": f.hint,
+                "qualname": f.qualname,
+            } for f in unsuppressed],
+            "summary": {
+                "unsuppressed": len(unsuppressed),
+                "suppressed": len(findings) - len(unsuppressed),
+                "stale_baseline": sorted("\t".join(k) for k in stale),
+            },
+        }, indent=2))
     else:
         for f in unsuppressed:
             print(f.render())

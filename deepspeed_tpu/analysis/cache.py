@@ -9,9 +9,13 @@ untouched tree lints from pure dict lookups.
 
 Only per-file lint results are cached; the two suppression tiers (inline
 pragmas live in the cached findings, the baseline is applied by the
-caller) and exit-code policy are computed fresh every run, so a baseline
-edit never needs a cache flush. A corrupt or version-skewed cache file is
-ignored, never an error.
+caller) and exit-code policy are computed fresh every run. The linter
+signature nonetheless covers the package's checked-in *data* files too —
+``baseline.txt`` and ``programs.json`` — so a baseline re-pin or a
+program-manifest update flushes the cache outright: belt and braces
+against any consumer that snapshots suppressed-or-not into its own
+artifacts. A corrupt or version-skewed cache file is ignored, never an
+error.
 """
 
 import json
@@ -30,13 +34,21 @@ def default_cache_path(start: str = ".") -> str:
     return os.path.join(start, ".dstpu_build", "lint_cache.json")
 
 
+#: non-``.py`` package files that shape lint/audit outcomes: an edited
+#: baseline or a re-pinned program manifest must invalidate the cache
+#: exactly like a linter upgrade (a stale cache serving pre-re-pin
+#: findings is the bug ISSUE 20's satellite fixed)
+_DATA_FILES = ("baseline.txt", "programs.json")
+
+
 def _linter_signature() -> List[List[object]]:
-    """(name, mtime_ns, size) for every source of this package — a new
-    linter version must never serve stale findings."""
+    """(name, mtime_ns, size) for every source — and checked-in data
+    file — of this package: a new linter version or a baseline/manifest
+    re-pin must never serve stale findings."""
     pkg = os.path.dirname(os.path.abspath(__file__))
     sig: List[List[object]] = []
     for name in sorted(os.listdir(pkg)):
-        if not name.endswith(".py"):
+        if not (name.endswith(".py") or name in _DATA_FILES):
             continue
         st = os.stat(os.path.join(pkg, name))
         sig.append([name, st.st_mtime_ns, st.st_size])

@@ -1,7 +1,7 @@
 """AST linter for TPU serving hazards (docs/ANALYSIS.md).
 
 Pure static analysis — no jax import, no execution of the linted code.
-``lint_paths`` walks ``.py`` files, parses each once, and runs five rule
+``lint_paths`` walks ``.py`` files, parses each once, and runs seven rule
 families over the tree:
 
 - **DSTPU001** host-device syncs (``block_until_ready`` / ``device_get`` /
@@ -14,7 +14,9 @@ families over the tree:
   (``resilience.errors``) is mandatory there.
 - **DSTPU004** retrace/concretization hazards inside functions that are
   jitted (decorated with ``jax.jit``, passed to ``jax.jit``/``pjit``/
-  ``pmap`` by name, or used as a ``lax.scan``/``cond``/``while_loop``/
+  ``pmap`` by name, wrapped by ``jax.checkpoint``/``jax.remat``/
+  ``jax.custom_vjp``/``custom_jvp`` (or registered via ``defvjp``), or
+  used as a ``lax.scan``/``cond``/``while_loop``/
   ``fori_loop`` body or a ``lax.switch`` branch): Python branches on
   traced parameters (``static_argnums``/``static_argnames`` are parsed
   and exempted), f-strings built at trace time, and ``int()``/``float()``/
@@ -28,6 +30,16 @@ families over the tree:
   (docs/SAMPLING.md) requires counter-based keys
   (``fold_in(PRNGKey(seed), position)``), which the check recognizes as
   safe (constants, carried names, and ``fold_in`` chains never flag).
+- **DSTPU006** transfer-ticket discipline: a ``.value`` read on a
+  ``submit_d2h`` ticket still open on the path (no dominating
+  ``drain_before``/``wait``) — the inline sync that defeats the
+  TransferEngine's overlap. ``submit_h2d`` settles at submit and is
+  exempt; escape via ``return`` is ownership transfer and legal.
+- **DSTPU007** mutate-before-raise exception safety in the
+  serve/inference hot functions: a ``raise`` reached after a ``self.*``
+  state write on the same path (numeric counter bumps, handled ``try``
+  bodies, and sibling branches exempt) — the half-mutated-engine bug
+  class the fault injector exists to catch.
 
 Suppression is two-tier: an inline ``# dstpu-lint: ignore[DSTPU00X]``
 pragma on the flagged line for sites whose justification belongs in the
@@ -42,7 +54,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .rules import (ALLOC_NAMES, ARRAY_ROOTS, HOT_FUNCTIONS,
+from .rules import (ALLOC_NAMES, ARRAY_ROOTS, DRAIN_CALLS, HOT_FUNCTIONS,
                     KEY_HAZARD_CALLS, RNG_KEY_BASES, RNG_KEY_SCOPE, RULES,
                     SEEDED_RNG, STDLIB_RANDOM_LEAVES, SYNC_ATTRS,
                     SYNC_DOTTED, UNTYPED_RAISES)
@@ -123,6 +135,17 @@ _BODY_CALL_ARGS = {"scan": (0,), "cond": (1, 2), "while_loop": (0, 1),
 _BODY_DOTTED = {form.format(name)
                 for name in _BODY_CALL_ARGS
                 for form in ("{}", "lax.{}", "jax.lax.{}")}
+#: rematerialization / custom-derivative wrappers whose first argument is
+#: traced exactly like a jit target (the training-path remat coverage):
+#: ``jax.checkpoint``/``jax.remat`` (``static_argnums`` honoured) and
+#: ``jax.custom_vjp``/``custom_jvp`` (``nondiff_argnums`` exempts params).
+#: Matched by FULL dotted spelling, never the last segment alone — the
+#: engine's ``self.checkpoint(path)`` (checkpoint *saving*) must not
+#: register as a trace context.
+_WRAP_CALL_DOTTED = {form.format(name)
+                     for name in ("checkpoint", "remat", "custom_vjp",
+                                  "custom_jvp")
+                     for form in ("{}", "jax.{}")}
 
 
 def _param_names(fn: ast.AST) -> List[str]:
@@ -138,7 +161,10 @@ def _static_names(fn: ast.AST, call: Optional[ast.Call]) -> Set[str]:
         return names
     params = _param_names(fn)
     for kw in call.keywords:
-        if kw.arg not in ("static_argnums", "static_argnames"):
+        # nondiff_argnums (custom_vjp/custom_jvp) are passed as plain
+        # Python values, not tracers — statics for linting purposes
+        if kw.arg not in ("static_argnums", "static_argnames",
+                          "nondiff_argnums"):
             continue
         try:
             val = ast.literal_eval(kw.value)
@@ -189,10 +215,12 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
                 d = _dotted(fn_ref) or ""
                 if d.split(".")[-1] == "partial" and call and call.args:
                     inner = _dotted(call.args[0]) or ""
-                    if inner.split(".")[-1] in _JIT_CALL_LASTS:
+                    if (inner.split(".")[-1] in _JIT_CALL_LASTS
+                            or inner in _WRAP_CALL_DOTTED):
                         targets[node] = _static_names(node, call)
                         break
-                if d.split(".")[-1] in _JIT_CALL_LASTS:
+                if (d.split(".")[-1] in _JIT_CALL_LASTS
+                        or d in _WRAP_CALL_DOTTED):
                     targets[node] = _static_names(node, call)
                     break
 
@@ -201,8 +229,16 @@ def _collect_jit_targets(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
             continue
         d = _dotted(node.func) or ""
         last = d.split(".")[-1]
-        if last in _JIT_CALL_LASTS:
+        if last in _JIT_CALL_LASTS or d in _WRAP_CALL_DOTTED:
             positions, statics_call = (0,), node
+        elif last == "audited_jit":
+            # audited_jit("name", fun, ...): the manifest-pinned jit wrapper
+            # (program_audit.py) — fun rides at position 1, after the name;
+            # static_argnums parses exactly like jax.jit's
+            positions, statics_call = (1,), node
+        elif last == "defvjp" and isinstance(node.func, ast.Attribute):
+            # fn.defvjp(fwd, bwd): both custom-derivative rules are traced
+            positions, statics_call = (0, 1), None
         elif d in _BODY_DOTTED:
             positions, statics_call = _BODY_CALL_ARGS[last], None
         else:
@@ -309,6 +345,10 @@ class _FileLint(ast.NodeVisitor):
     def _visit_func(self, node: ast.AST) -> None:
         self._funcs.append(node)
         self._names.append(node.name)
+        if self._enabled("DSTPU006"):
+            self._check_transfer_discipline(node)
+        if self._enabled("DSTPU007") and node.name in HOT_FUNCTIONS:
+            self._check_mutate_before_raise(node)
         self.generic_visit(node)
         self._names.pop()
         self._funcs.pop()
@@ -510,6 +550,176 @@ class _FileLint(ast.NodeVisitor):
         if self._enabled("DSTPU005"):
             self._set_iter_check(node.iter)
         self.generic_visit(node)
+
+    # -- DSTPU006: transfer-ticket discipline ----------------------------
+    def _check_transfer_discipline(self, fn: ast.AST) -> None:
+        """Path-sensitive statement walk over ONE function body (nested
+        defs are analyzed on their own visit): a name bound from
+        ``submit_d2h(...)`` is an *open* ticket until a drain/wait settles
+        it; reading ``.value`` while open is the undrained-dependent-read
+        hazard the runtime's ``TransferTicket.value`` only catches at
+        execution time. ``submit_h2d`` settles at submit and never flags;
+        escape via ``return``/storage is ownership transfer (the consumer
+        drains) and is legal."""
+
+        def is_submit_d2h(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit_d2h")
+
+        def scan_expr(node: ast.AST, opens: Set[str]) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and sub.attr == "value":
+                    if is_submit_d2h(sub.value):
+                        self._emit(sub, "DSTPU006",
+                                   "`.value` read directly on the "
+                                   "`submit_d2h(...)` result — the ticket "
+                                   "is still open; this forces an inline "
+                                   "sync and defeats the overlap")
+                    elif (isinstance(sub.value, ast.Name)
+                          and sub.value.id in opens):
+                        self._emit(sub, "DSTPU006",
+                                   f"`.value` read on open TransferTicket "
+                                   f"`{sub.value.id}` with no dominating "
+                                   "drain on this path")
+                elif isinstance(sub, ast.Call):
+                    d = _dotted(sub.func) or ""
+                    if d.split(".")[-1] not in DRAIN_CALLS:
+                        continue
+                    mentioned = {n.id for a in (*sub.args,
+                                                *(k.value
+                                                  for k in sub.keywords))
+                                 for n in ast.walk(a)
+                                 if isinstance(n, ast.Name)}
+                    if isinstance(sub.func, ast.Attribute) and isinstance(
+                            sub.func.value, ast.Name):
+                        mentioned.add(sub.func.value.id)  # t.wait()
+                    if mentioned & opens:
+                        opens.difference_update(mentioned)
+                    else:
+                        # a blanket drain (drain_all, or tickets reached
+                        # through a container) settles everything in flight
+                        opens.clear()
+
+        def walk(stmts: Sequence[ast.stmt], opens: Set[str]) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.If):
+                    scan_expr(st.test, opens)
+                    o1, o2 = set(opens), set(opens)
+                    walk(st.body, o1)
+                    walk(st.orelse, o2)
+                    opens.clear()
+                    opens.update(o1 | o2)
+                elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                    scan_expr(st.iter if isinstance(
+                        st, (ast.For, ast.AsyncFor)) else st.test, opens)
+                    o = set(opens)
+                    walk(st.body, o)
+                    walk(st.orelse, o)
+                    opens.update(o)
+                elif isinstance(st, ast.Try):
+                    walk(st.body, opens)
+                    for h in st.handlers:
+                        oh = set(opens)
+                        walk(h.body, oh)
+                        opens.update(oh)
+                    walk(st.finalbody, opens)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    for item in st.items:
+                        scan_expr(item.context_expr, opens)
+                    walk(st.body, opens)
+                elif (isinstance(st, ast.Assign) and len(st.targets) == 1
+                      and isinstance(st.targets[0], ast.Name)):
+                    scan_expr(st.value, opens)
+                    if is_submit_d2h(st.value):
+                        opens.add(st.targets[0].id)
+                    else:
+                        opens.discard(st.targets[0].id)  # rebinding
+                else:
+                    scan_expr(st, opens)
+
+        walk(fn.body, set())
+
+    # -- DSTPU007: mutate-before-raise exception safety ------------------
+    def _check_mutate_before_raise(self, fn: ast.AST) -> None:
+        """Per-hot-function path walk: a ``raise`` reached after a
+        ``self.*`` state write on the same path leaves the engine
+        half-mutated. Exempt: numeric-literal counter bumps
+        (``self.stat += 1`` — monotonic bookkeeping, not state), bare
+        re-raises, raises inside a ``try`` that has handlers (the
+        rollback pattern), and sibling branches (mutation in one ``if``
+        arm never taints a ``raise`` in the other)."""
+
+        def mutation_of(st: ast.stmt) -> Optional[str]:
+            if isinstance(st, ast.Assign):
+                targets = st.targets
+            elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+                targets = [st.target]
+            elif isinstance(st, ast.Delete):
+                targets = st.targets
+            else:
+                return None
+            if (isinstance(st, ast.AugAssign)
+                    and isinstance(st.value, ast.Constant)
+                    and isinstance(st.value.value, (int, float))):
+                return None  # counter bump
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if not isinstance(base, ast.Attribute):
+                    continue
+                root = base.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "self":
+                    return _dotted(base) or "self.<attr>"
+            return None
+
+        Mutation = Tuple[int, str]
+
+        def merge(a: List[Mutation], b: List[Mutation]) -> List[Mutation]:
+            return a + [m for m in b if m not in a]
+
+        def walk(stmts: Sequence[ast.stmt], mutated: List[Mutation],
+                 exempt: bool) -> List[Mutation]:
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                    continue
+                if isinstance(st, ast.Raise):
+                    if st.exc is not None and mutated and not exempt:
+                        line, desc = mutated[0]
+                        self._emit(st, "DSTPU007",
+                                   f"`raise` after state write `{desc}` "
+                                   f"(line {line}) in hot function "
+                                   f"`{self._qualname()}` — an exception "
+                                   "here leaves the engine half-mutated")
+                    continue
+                desc = mutation_of(st)
+                if desc is not None:
+                    mutated = mutated + [(st.lineno, desc)]
+                elif isinstance(st, ast.If):
+                    m1 = walk(st.body, list(mutated), exempt)
+                    m2 = walk(st.orelse, list(mutated), exempt)
+                    mutated = merge(m1, m2)
+                elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                    m = walk(st.body, list(mutated), exempt)
+                    mutated = merge(mutated, walk(st.orelse, m, exempt))
+                elif isinstance(st, ast.Try):
+                    # a try WITH handlers is the rollback idiom: raises in
+                    # its body are assumed handled/rolled back there
+                    m = walk(st.body, list(mutated),
+                             exempt or bool(st.handlers))
+                    for h in st.handlers:
+                        walk(h.body, [], exempt)
+                    mutated = walk(st.finalbody, merge(mutated, m), exempt)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    mutated = walk(st.body, mutated, exempt)
+            return mutated
+
+        walk(fn.body, [], False)
 
     def _visit_comp(self, node) -> None:
         if self._enabled("DSTPU005"):

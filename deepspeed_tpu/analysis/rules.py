@@ -105,6 +105,13 @@ TAXONOMY_SCOPE = ("serve", "inference", "resilience")
 #: where the determinism rule (005) applies — scheduling/containment
 #: decisions must be replayable (seeded faults, injectable clocks)
 DECISION_SCOPE = ("serve", "resilience")
+#: where the transfer-ticket rule (006) applies — everywhere TransferEngine
+#: clients live (the engines, the tiers, the offload paths)
+TRANSFER_SCOPE = ("serve", "inference", "resilience", "runtime")
+#: where the exception-safety rule (007) applies — the engine/scheduler hot
+#: paths whose half-mutated state the fault injector fires before
+#: delegation specifically to catch
+MUTATE_RAISE_SCOPE = ("serve", "inference")
 
 #: device-sync call names (attribute or dotted) flagged by DSTPU001
 SYNC_ATTRS: FrozenSet[str] = frozenset({"block_until_ready", "device_get"})
@@ -170,6 +177,16 @@ STDLIB_RANDOM_LEAVES: FrozenSet[str] = frozenset({
     "uniform", "choice", "gauss", "betavariate", "expovariate",
 })
 
+#: calls that settle outstanding transfer tickets (DSTPU006): the engine's
+#: drain family, and wait/cancel on the ticket itself. A drain whose
+#: arguments the linter cannot tie to specific tickets settles everything
+#: in flight (conservative: the runtime's drain_before passes through
+#: non-ticket dependents untouched, so over-approximating is safe).
+DRAIN_CALLS: FrozenSet[str] = frozenset({
+    "drain_before", "drain_all", "drain_oldest", "wait", "cancel",
+    "cancel_all", "cancel_ticket",
+})
+
 RULES: Dict[str, Rule] = {r.id: r for r in (
     Rule(
         id="DSTPU001",
@@ -203,6 +220,25 @@ RULES: Dict[str, Rule] = {r.id: r for r in (
              "int()/float() on traced values) out of compiled code "
              "(docs/ANALYSIS.md#dstpu004)",
         scope=(),
+    ),
+    Rule(
+        id="DSTPU006",
+        title="open TransferTicket read without a dominating drain",
+        hint="settle the ticket first — te.drain_before([deps])/"
+             "ticket.wait() — or move the .value read to the consumer "
+             "that drains; submit_h2d tickets settle at submit and are "
+             "exempt (docs/ANALYSIS.md#dstpu006)",
+        scope=TRANSFER_SCOPE,
+    ),
+    Rule(
+        id="DSTPU007",
+        title="state write precedes a raise in a serving hot path",
+        hint="validate every precondition before the first self.* write, "
+             "or roll the writes back before re-raising — a mid-mutation "
+             "raise leaves the engine half-mutated, the bug class the "
+             "fault injector fires before delegation to catch "
+             "(docs/ANALYSIS.md#dstpu007)",
+        scope=MUTATE_RAISE_SCOPE,
     ),
     Rule(
         id="DSTPU005",

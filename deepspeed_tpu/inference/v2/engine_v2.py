@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis.program_audit import audited_jit
 from ...analysis.sanitizer import checked_cache_cls, sanitize_enabled
 from ...models.transformer import sample_or_argmax
 from ...resilience.errors import (ContextOverflowError, EngineUsageError,
@@ -343,7 +344,8 @@ class InferenceEngineV2:
             v = v.at[:, slots].set(nv.transpose(1, 0, 2, 3, 4))
             return lg, (k, v)
 
-        fn = jax.jit(prefill, donate_argnums=(1,))
+        fn = audited_jit("engine_v2.prefill", prefill, max_traces=32,
+                         donate_argnums=(1,))
         self._prefill_fns[S] = fn
         return fn
 
@@ -371,8 +373,9 @@ class InferenceEngineV2:
                 return jnp.argmax(lg, axis=-1).astype(jnp.int32), (k, v)
             return lg, (k, v)
 
-        self._decode_fn = jax.jit(decode, donate_argnums=(1,),
-                                  static_argnums=(5,))
+        self._decode_fn = audited_jit("engine_v2.decode", decode,
+                                      max_traces=2, donate_argnums=(1,),
+                                      static_argnums=(5,))
         return self._decode_fn
 
     def _get_ragged(self):
@@ -415,7 +418,8 @@ class InferenceEngineV2:
                                         temps, top_ks, top_ps), pool
             return lg, pool
 
-        fn = jax.jit(ragged, donate_argnums=(1,), static_argnums=(13,))
+        fn = audited_jit("engine_v2.ragged", ragged, max_traces=4,
+                         donate_argnums=(1,), static_argnums=(13,))
         self._prefill_fns["ragged"] = fn
         return fn
 
@@ -433,7 +437,8 @@ class InferenceEngineV2:
                 v = v.at[:, :, dst].set(v[:, :, src])
                 return k, v
 
-            self._cow_fn = jax.jit(cow, donate_argnums=(0,))
+            self._cow_fn = audited_jit("engine_v2.cow", cow,
+                                       donate_argnums=(0,))
         return self._cow_fn
 
     # ------------------------------------------------------------------
@@ -452,7 +457,8 @@ class InferenceEngineV2:
                 k, v = kv  # (L, kvh, NB, BS, hd) each; block axis = 2
                 return jnp.stack((k[:, :, src], v[:, :, src]))
 
-            self._tier_gather_fn = jax.jit(gather)
+            self._tier_gather_fn = audited_jit("engine_v2.tier_gather",
+                                               gather)
         return self._tier_gather_fn
 
     def _get_tier_scatter(self):
@@ -471,7 +477,8 @@ class InferenceEngineV2:
                 v = v.at[:, :, dst].set(blk[1])
                 return k, v
 
-            self._tier_scatter_fn = jax.jit(scatter, donate_argnums=(0,))
+            self._tier_scatter_fn = audited_jit("engine_v2.tier_scatter",
+                                                scatter, donate_argnums=(0,))
         return self._tier_scatter_fn
 
     def _tier_buf_shape(self):
@@ -820,7 +827,8 @@ class InferenceEngineV2:
                     sampling=(seeds, temps, top_ks, top_ps,
                               bias_pool[slots]))
 
-            self._fused_fn = jax.jit(fused, donate_argnums=(1,))
+            self._fused_fn = audited_jit("engine_v2.fused", fused,
+                                         donate_argnums=(1,))
         return self._fused_fn
 
     def _get_verify(self):
@@ -844,7 +852,8 @@ class InferenceEngineV2:
                     sampling=(seeds, temps, top_ks, top_ps,
                               bias_pool[slots]))
 
-            self._verify_fn = jax.jit(verify, donate_argnums=(1,))
+            self._verify_fn = audited_jit("engine_v2.verify", verify,
+                                          donate_argnums=(1,))
         return self._verify_fn
 
     # ------------------------------------------------------------------
@@ -868,7 +877,8 @@ class InferenceEngineV2:
             def setrow(bp, slot, row):
                 return bp.at[slot].set(row)
 
-            self._bias_set_fn = jax.jit(setrow, donate_argnums=(0,))
+            self._bias_set_fn = audited_jit("engine_v2.bias_set", setrow,
+                                            donate_argnums=(0,))
         return self._bias_set_fn
 
     def _zero_row(self) -> np.ndarray:

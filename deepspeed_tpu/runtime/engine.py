@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import comm as dist
+from ..analysis.program_audit import audited_jit
 from ..comm.topology import MeshTopology
 from ..resilience.errors import CheckpointCorruptError, EngineUsageError
 from ..ops.optimizers import Optimizer, build_optimizer
@@ -771,8 +772,8 @@ class DeepSpeedEngine:
                 (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(lp_params)
                 return loss, grads
 
-            return jax.jit(
-                fwd_bwd,
+            return audited_jit(
+                "engine.fwd_bwd", fwd_bwd, max_traces=4,
                 out_shardings=(self._replicated, self._grad_shardings),
             )
 

@@ -173,6 +173,41 @@ def _sanitize_serving_modules(request):
         yield
 
 
+# modules that run with the compiled-program audit armed (DSTPU_AUDIT=1,
+# docs/ANALYSIS.md "Program audit"): every program these suites compile is
+# retraced once per dispatch signature, fingerprinted, and checked against
+# the pinned analysis/programs.json — an unpinned program, a digest drift,
+# a host callback, or an extra trace fails the test with the registration
+# site's file:line. An explicit DSTPU_AUDIT in the environment (e.g.
+# DSTPU_AUDIT=0 to bisect, DSTPU_AUDIT=write to re-pin) wins.
+_AUDIT_FILES = (
+    "test_retrace_guard.py",
+    "test_inference_v2.py",
+    "test_fused_decode.py",
+    "test_speculation.py",
+    "test_sampling.py",
+    "test_kv_tier.py",
+    "test_prefix_cache.py",
+    "test_chunked_prefill.py",
+    "test_serve.py",
+    "test_engine.py",
+)
+
+
+@pytest.fixture(autouse=True)
+def _audit_compiled_programs(request):
+    fspath = str(getattr(request.node, "fspath", ""))
+    if (os.path.basename(fspath) in _AUDIT_FILES
+            and "DSTPU_AUDIT" not in os.environ):
+        os.environ["DSTPU_AUDIT"] = "1"
+        try:
+            yield
+        finally:
+            os.environ.pop("DSTPU_AUDIT", None)
+    else:
+        yield
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_state():
     """Each test gets a fresh topology (mesh) — mirrors per-test process groups."""

@@ -378,6 +378,95 @@ def build(router, i, x):
         assert rules_of(src, path=TRAIN) == []
 
 
+class TestWrappedTraceContexts:
+    """DSTPU004 over rematerialization / custom-derivative wrappers
+    (ISSUE 20 satellite): ``jax.checkpoint``/``jax.remat`` bodies and
+    ``custom_vjp``/``custom_jvp`` rules are traced code too."""
+
+    def test_checkpoint_body_is_traced(self):
+        src = """
+import jax
+
+def build():
+    def block(params, x):
+        if x > 0:          # traced under remat exactly like under jit
+            return x
+        return -x
+    return jax.checkpoint(block)
+"""
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_remat_decorator_is_traced(self):
+        src = """
+import jax
+
+@jax.remat
+def block(params, x):
+    n = int(x)             # concretization at trace time
+    return params
+"""
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_custom_vjp_and_defvjp_rules_are_traced(self):
+        src = """
+import jax
+
+def build():
+    def f(x):
+        if x > 0:
+            return x
+        return -x
+    f = jax.custom_vjp(f)
+    def f_fwd(x):
+        name = f"x={x}"    # f-string at trace time
+        return x, x
+    def f_bwd(res, g):
+        return (float(g),) # concretization at trace time
+    f.defvjp(f_fwd, f_bwd)
+    return f
+"""
+        assert sorted(rules_of(src, path=TRAIN)) == ["DSTPU004"] * 3
+
+    def test_nondiff_argnums_params_are_static(self):
+        src = """
+import jax
+
+def build():
+    def f(mode, x):
+        if mode:           # nondiff arg: plain Python value, never traced
+            return x
+        return -x
+    return jax.custom_jvp(f, nondiff_argnums=(0,))
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+    def test_audited_jit_is_a_trace_context(self):
+        src = """
+from deepspeed_tpu.analysis import audited_jit
+
+def build():
+    def step(params, x, greedy):
+        if greedy:         # static: exempt
+            return x
+        if x > 0:          # traced param: flagged
+            return x
+        return -x
+    return audited_jit("t.step", step, max_traces=2, static_argnums=(2,))
+"""
+        assert rules_of(src, path=TRAIN) == ["DSTPU004"]
+
+    def test_self_checkpoint_is_not_a_trace_context(self):
+        src = """
+def save(self, path):
+    def writer(path):
+        if path:           # checkpoint SAVING, not jax.checkpoint: host code
+            return path
+        return "ckpt"
+    return self.checkpoint(writer(path))
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+
 # ---------------------------------------------------------------------------
 # DSTPU005 — nondeterminism in decision logic
 # ---------------------------------------------------------------------------
@@ -466,6 +555,180 @@ def parse(s):
     return s.split(",")
 """
         assert rules_of(src, path=INFER) == []
+
+
+# ---------------------------------------------------------------------------
+# DSTPU006 — transfer-ticket discipline
+# ---------------------------------------------------------------------------
+
+class TestTransferDiscipline:
+    """``submit_d2h`` ticket ``.value`` reads must be dominated by a drain
+    (``drain_before``/``drain_lower_tiers``/``wait``) on every path —
+    d2h results settle at drain time, not submit time (ISSUE 20)."""
+
+    def test_flags_value_read_on_open_ticket(self):
+        src = """
+class Engine:
+    def collect(self, blocks):
+        t = self.transfer.submit_d2h(blocks)
+        return t.value
+"""
+        assert rules_of(src) == ["DSTPU006"]
+
+    def test_flags_direct_chained_value_read(self):
+        src = """
+class Engine:
+    def collect(self, blocks):
+        return self.transfer.submit_d2h(blocks).value
+"""
+        assert rules_of(src) == ["DSTPU006"]
+
+    def test_drain_before_settles_the_ticket(self):
+        src = """
+class Engine:
+    def collect(self, blocks):
+        t = self.transfer.submit_d2h(blocks)
+        self.transfer.drain_before([t])
+        return t.value
+
+    def collect_waited(self, blocks):
+        t = self.transfer.submit_d2h(blocks)
+        t.wait()
+        return t.value
+"""
+        assert rules_of(src) == []
+
+    def test_h2d_tickets_settle_at_submit(self):
+        src = """
+class Engine:
+    def upload(self, blocks):
+        return self.transfer.submit_h2d(blocks).value
+"""
+        assert rules_of(src) == []
+
+    def test_returning_the_ticket_is_ownership_transfer(self):
+        src = """
+class Engine:
+    def start(self, blocks):
+        return self.transfer.submit_d2h(blocks)
+"""
+        assert rules_of(src) == []
+
+    def test_drain_on_one_branch_only_still_flags(self):
+        src = """
+class Engine:
+    def collect(self, blocks, eager):
+        t = self.transfer.submit_d2h(blocks)
+        if eager:
+            self.transfer.drain_before([t])
+        return t.value
+"""
+        assert rules_of(src) == ["DSTPU006"]
+
+    def test_rebinding_discards_the_open_ticket(self):
+        src = """
+class Engine:
+    def collect(self, blocks):
+        t = self.transfer.submit_d2h(blocks)
+        t = self.transfer.submit_h2d(blocks)
+        return t.value
+"""
+        assert rules_of(src) == []
+
+    def test_silent_outside_transfer_scope(self):
+        src = """
+class Engine:
+    def collect(self, blocks):
+        t = self.transfer.submit_d2h(blocks)
+        return t.value
+"""
+        assert rules_of(src, path=TRAIN) == []
+
+
+# ---------------------------------------------------------------------------
+# DSTPU007 — mutate-before-raise in hot paths
+# ---------------------------------------------------------------------------
+
+class TestMutateBeforeRaise:
+    """A typed raise reached after a ``self.*`` write on the same path
+    leaves the engine half-mutated for the resilience layer's typed
+    containment to retry against (ISSUE 20)."""
+
+    def test_flags_raise_after_state_write(self):
+        src = """
+class Engine:
+    def decode_step(self, req):
+        self.active[req.rid] = req
+        if req.bad:
+            raise ValueError("bad request")
+"""
+        assert rules_of(src) == ["DSTPU007"]
+
+    def test_validate_before_mutate_is_fine(self):
+        src = """
+class Engine:
+    def decode_step(self, req):
+        if req.bad:
+            raise ValueError("bad request")
+        self.active[req.rid] = req
+"""
+        assert rules_of(src) == []
+
+    def test_counter_bumps_are_exempt(self):
+        src = """
+class Engine:
+    def _put_paged(self, req):
+        self.plan_deferrals += 1
+        if req.bad:
+            raise ValueError("bad request")
+"""
+        assert rules_of(src) == []
+
+    def test_try_with_handler_is_the_rollback_idiom(self):
+        src = """
+class Engine:
+    def decode_step(self, req):
+        self.active[req.rid] = req
+        try:
+            if req.bad:
+                raise ValueError("bad request")
+        except ValueError:
+            del self.active[req.rid]
+            raise
+"""
+        assert rules_of(src) == []
+
+    def test_sibling_branches_are_isolated(self):
+        src = """
+class Engine:
+    def decode_step(self, req):
+        if req.fresh:
+            self.active[req.rid] = req
+        elif req.bad:
+            raise ValueError("bad request")
+"""
+        assert rules_of(src) == []
+
+    def test_mutation_unioned_after_branches(self):
+        src = """
+class Engine:
+    def decode_step(self, req):
+        if req.fresh:
+            self.active[req.rid] = req
+        if req.bad:
+            raise ValueError("bad request")
+"""
+        assert rules_of(src) == ["DSTPU007"]
+
+    def test_silent_in_cold_function(self):
+        src = """
+class Engine:
+    def setup(self, req):
+        self.active[req.rid] = req
+        if req.bad:
+            raise ValueError("bad request")
+"""
+        assert rules_of(src) == []
 
 
 # ---------------------------------------------------------------------------
@@ -584,7 +847,7 @@ class TestCLI:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("DSTPU001", "DSTPU002", "DSTPU003", "DSTPU004",
-                    "DSTPU005"):
+                    "DSTPU005", "DSTPU006", "DSTPU007"):
             assert rid in out
 
     def test_syntax_error_fails_loudly(self, tmp_path, capsys):
@@ -592,6 +855,38 @@ class TestCLI:
         f.write_text("def f(:\n")
         assert lint_main([str(f), "--baseline", "none"]) == 1
         assert "DSTPU000" in capsys.readouterr().out
+
+    def test_check_programs_dry_mode(self, tmp_path, capsys):
+        """``--check-programs`` (ISSUE 20 satellite): the no-retrace
+        manifest consistency gate pre-commit runs — registration coverage
+        and staleness from a pure AST scan, no jax import."""
+        import json
+
+        src = tmp_path / "deepspeed_tpu" / "serve" / "mod.py"
+        src.parent.mkdir(parents=True)
+        src.write_text(
+            "from deepspeed_tpu.analysis import audited_jit\n"
+            "def build(step):\n"
+            "    return audited_jit('serve.step', step, max_traces=2)\n")
+        man = tmp_path / "programs.json"
+        man.write_text(json.dumps({"version": 1, "jax": "0.0", "programs": {
+            "serve.step": {"max_traces": 2, "sites": [],
+                           "variants": [{"digest": "abc"}]}}}))
+        argv = [str(tmp_path), "--check-programs", "--programs", str(man)]
+        assert lint_main(argv) == 0
+        assert "consistent" in capsys.readouterr().out
+
+        # an unpinned registration drifts, attributed to its file:line
+        src.write_text(src.read_text().replace("serve.step", "serve.other"))
+        assert lint_main(argv) == 1
+        out = capsys.readouterr().out
+        assert "serve.other" in out and "mod.py:3" in out   # unpinned
+        assert "serve.step" in out and "stale" in out        # stale pin
+
+        # a corrupt manifest is a loud failure, not a silent pass
+        man.write_text("{not json")
+        assert lint_main(argv) == 1
+        assert "not valid JSON" in capsys.readouterr().out
 
 
 class TestLintCache:
@@ -646,6 +941,33 @@ class TestLintCache:
         cache = LintCache(str(cpath))
         found = lint_paths_cached([str(root)], None, cache)
         assert cache.misses == 2 and len(found) >= 1
+
+    def test_data_file_edit_invalidates(self, tmp_path, monkeypatch):
+        """Editing a checked-in data file (baseline.txt / programs.json)
+        flushes the whole cache like a linter upgrade (ISSUE 20
+        satellite): a re-pin must never serve pre-re-pin findings."""
+        import deepspeed_tpu.analysis.cache as cache_mod
+        from deepspeed_tpu.analysis.cache import LintCache, lint_paths_cached
+
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "lint.py").write_text("# linter source\n")
+        bl = pkg / "baseline.txt"
+        bl.write_text("DSTPU001\tdeepspeed_tpu/serve/mod.py\tx\n")
+        monkeypatch.setattr(cache_mod, "__file__", str(pkg / "cache.py"))
+        self._tree(tmp_path)
+        root = tmp_path / "deepspeed_tpu"
+        cpath = str(tmp_path / "cache.json")
+        lint_paths_cached([str(root)], None, LintCache(cpath))
+        warm = LintCache(cpath)
+        lint_paths_cached([str(root)], None, warm)
+        assert warm.hits == 2 and warm.misses == 0
+        # a baseline re-pin (content + mtime change) = full cold cache
+        bl.write_text("DSTPU001\tdeepspeed_tpu/serve/mod.py\ty\n")
+        os.utime(bl, ns=(1, 1))
+        cold = LintCache(cpath)
+        lint_paths_cached([str(root)], None, cold)
+        assert cold.hits == 0 and cold.misses == 2
 
     def test_cli_cache_flag_and_pragma_on_cached_findings(self, tmp_path,
                                                           capsys):

@@ -18,6 +18,7 @@ from deepspeed_tpu.inference.v2.ragged_manager import SequenceDescriptor
 from deepspeed_tpu.models import build_model
 from deepspeed_tpu.resilience import PoolExhaustedError
 from deepspeed_tpu.serve import ContinuousBatchScheduler, RequestState
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -73,7 +74,7 @@ class TestEngineMaxSteps:
         mono = _engine(m, params)
         ref = mono.put([7], [prompt], greedy=True)
         assert out[7] == ref[7]
-        assert eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
 
     def test_max_steps_is_paged_only(self, setup):
         m, params = setup
@@ -114,7 +115,7 @@ class TestInterleaving:
         assert p["interleaved_steps"] >= 3 and p["chunks"] >= 3
         assert p["chunk_tokens"] >= 48 and p["backlog_peak"] >= 33
         assert b.tokens == _run_solo(m, params, long_prompt, 4)
-        assert eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
         events = dict((k, v) for k, v, _ in sched.monitor_events())
         assert events["serve/prefill/interleaved_steps"] >= 3
 
@@ -141,7 +142,7 @@ class TestInterleaving:
             assert all(r.state is RequestState.DONE for r in reqs)
             streams[chunked] = [list(r.tokens) for r in reqs]
             metrics[chunked] = sched.metrics.prefill
-            assert eng.ragged_cache_size <= 4
+            assert_trace_bounds(eng)
             sched.close()
         assert streams[True] == streams[False]
         assert metrics[True]["chunks"] > 0
@@ -250,7 +251,7 @@ class TestHorizonBacklogTrade:
         assert a.state is RequestState.DONE and b.state is RequestState.DONE
         assert b.tokens == _run_solo(m, params, long_prompt, 4)
         assert a.tokens == _run_solo(m, params, list(a.prompt), 28)
-        assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1
+        assert_trace_bounds(eng)
 
 
 class TestSanitizerOwnership:

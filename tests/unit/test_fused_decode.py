@@ -15,6 +15,7 @@ from deepspeed_tpu.models import build_model
 from deepspeed_tpu.resilience.errors import ContextOverflowError
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, FaultInjector,
                                  RequestState, StepWatchdog)
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -87,7 +88,7 @@ class TestFusedEngine:
         eng.decode_multi({1: out1[1][0]}, 4)
         eng.decode_multi({1: 5}, 4)
         assert eng.fused_cache_size == 1
-        assert eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
         with pytest.raises(ValueError):
             _engine(m, params, decode_horizon=0)
         with pytest.raises(ValueError, match="paged"):
@@ -160,7 +161,7 @@ class TestFusedScheduler:
         assert s1.metrics.decode["fused_steps"] == 0
         # kept-token accounting matches the single-step path exactly
         assert (s4.metrics.tokens_generated == s1.metrics.tokens_generated)
-        assert e4.ragged_cache_size <= 4 and e4.fused_cache_size <= 1
+        assert_trace_bounds(e4)
         assert not e4.state.seqs
 
     def test_bitwise_under_preemption_churn(self, setup):
@@ -177,7 +178,7 @@ class TestFusedScheduler:
         assert sched.metrics.preemptions > 0
         assert sched.metrics.decode["fused_steps"] > 0
         assert [r.tokens for r in reqs] == refs
-        assert eng.ragged_cache_size <= 4 and eng.fused_cache_size <= 1
+        assert_trace_bounds(eng)
         eng.block_mgr.check_invariants([])
 
     def test_bitwise_under_injected_faults(self, setup):

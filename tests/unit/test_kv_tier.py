@@ -25,6 +25,7 @@ from deepspeed_tpu.resilience import FaultInjector, RetryPolicy
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
                                  RequestState, SamplingParams)
 from deepspeed_tpu.serve.metrics import ServeMetrics
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -89,8 +90,7 @@ def _baseline(m, params, sampled=False):
 
 
 def _assert_bounds(eng):
-    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
-    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+    assert_trace_bounds(eng)
 
 
 # ---------------------------------------------------------------------------

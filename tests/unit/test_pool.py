@@ -23,6 +23,7 @@ from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
                                  Router, SamplingParams, SchedulerClosedError)
 from deepspeed_tpu.serve.metrics import PoolMetrics
 from deepspeed_tpu.serve.pool import DEAD, DRAINING, SERVING
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -107,8 +108,7 @@ def _pool(m, params, n, *, specs_for=None, eng_kw=None, router=None,
 
 
 def _assert_bounds(eng):
-    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
-    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+    assert_trace_bounds(eng)
 
 
 def _views(pool):

@@ -29,6 +29,7 @@ from deepspeed_tpu.serve import (ContinuousBatchScheduler, EnginePool,
                                  SamplingParams)
 from deepspeed_tpu.serve.pool import DEAD, DRAINING
 from deepspeed_tpu.serve.pool import SERVING as POOL_SERVING
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -97,8 +98,7 @@ def _pool(m, params, n, *, specs_for=None, clock=None, **sched_kw):
 
 
 def _assert_bounds(eng):
-    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
-    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+    assert_trace_bounds(eng)
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ from deepspeed_tpu.inference.v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.ragged_manager import (BlockedKVCache,
                                                        SequenceDescriptor)
 from deepspeed_tpu.models import build_model
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -272,7 +273,7 @@ class TestPrefixCacheEngine:
                 eng.flush(u)
         s = eng.prefix_cache_stats()
         assert s["hits"] > 0  # the workload really exercised the cache
-        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert_trace_bounds(eng)
         eng.block_mgr.check_invariants(eng.state.seqs.values())
 
     def test_monitor_events_surface(self, setup):
@@ -328,7 +329,8 @@ def test_bench_shared_prefix_workload_counters():
     assert s["skipped_prefill_tokens"] >= 64 * s["hits"] > 0
     assert eng_off.prefix_cache_stats() == {}
     assert on["generated_tokens"] == off["generated_tokens"]
-    assert 1 <= eng_on.ragged_cache_size <= 4
+    assert eng_on.ragged_cache_size >= 1  # the workload really compiled
+    assert_trace_bounds(eng_on)
     eng_on.block_mgr.check_invariants(eng_on.state.seqs.values())
 
 
@@ -350,5 +352,5 @@ def test_shared_prefix_serve_smoke():
     assert s["hits"] == 1 and s["skipped_prefill_tokens"] == 16
     out = eng.decode_step({1: int(t1[1]), 2: int(t2[2])}, greedy=True)
     assert set(out) == {1, 2}
-    assert eng.ragged_cache_size <= 4
+    assert_trace_bounds(eng)
     eng.block_mgr.check_invariants(eng.state.seqs.values())

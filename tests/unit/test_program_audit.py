@@ -1,0 +1,355 @@
+"""Compiled-program auditor tests (docs/ANALYSIS.md "Program audit"):
+geometry-free fingerprints, the manifest gate (unpinned / digest drift /
+host callback / trace-count overflow, each attributed to the registration
+site's file:line), write-mode re-pin round-trip, the no-retrace dry mode,
+and the manifest-backed trace-bound helper that replaced the scattered
+``*_cache_size <= N`` asserts."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.program_audit import (ENGINE_TRACE_PROPS,
+                                                  ProgramAuditError,
+                                                  ProgramRegistry,
+                                                  assert_trace_bounds,
+                                                  audited_jit, audit_mode,
+                                                  check_manifest, fingerprint,
+                                                  registered_program_names)
+
+
+@pytest.fixture
+def registry(tmp_path):
+    return ProgramRegistry(str(tmp_path / "programs.json"))
+
+
+@pytest.fixture
+def check(monkeypatch):
+    monkeypatch.setenv("DSTPU_AUDIT", "1")
+
+
+@pytest.fixture
+def write(monkeypatch):
+    monkeypatch.setenv("DSTPU_AUDIT", "write")
+
+
+def step(params, x):
+    return jnp.dot(x, params).sum()
+
+
+def mk_step():
+    """A FRESH function object per wrapper: jit shares its trace cache
+    across wrappers of the same callable, and the engines only ever jit
+    per-build closures — tests mirror that."""
+    def step_(params, x):
+        return jnp.dot(x, params).sum()
+    return step_
+
+
+def pin(registry, name, fun, shapes=((4, 4),), **kw):
+    """Trace ``fun`` over ``shapes`` in write mode so ``name`` lands in
+    the registry's manifest, then return the wrapped fn (restoring the
+    caller's audit mode)."""
+    prev = os.environ.get("DSTPU_AUDIT")
+    os.environ["DSTPU_AUDIT"] = "write"
+    try:
+        fn = audited_jit(name, fun, registry=registry, **kw)
+        for shp in shapes:
+            x = jnp.ones(shp, jnp.float32)
+            fn(jnp.eye(shp[-1], dtype=jnp.float32), x)
+    finally:
+        if prev is None:
+            os.environ.pop("DSTPU_AUDIT", None)
+        else:
+            os.environ["DSTPU_AUDIT"] = prev
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_geometry_free_across_shapes_and_sizes(self):
+        fp4 = fingerprint(jax.make_jaxpr(step)(
+            jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32)))
+        fp16 = fingerprint(jax.make_jaxpr(step)(
+            jnp.eye(16, dtype=jnp.float32), jnp.ones((7, 16), jnp.float32)))
+        assert fp4["digest"] == fp16["digest"]
+        assert fp4["in"] == ["float32[r2]"]
+
+    def test_different_programs_differ(self):
+        fp_a = fingerprint(jax.make_jaxpr(step)(
+            jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32)))
+        fp_b = fingerprint(jax.make_jaxpr(
+            lambda p, x: jnp.tanh(jnp.dot(x, p)).sum())(
+            jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32)))
+        assert fp_a["digest"] != fp_b["digest"]
+
+    def test_donation_perturbs_the_digest(self):
+        closed = jax.make_jaxpr(step)(
+            jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        assert fingerprint(closed)["digest"] != \
+            fingerprint(closed, donate=(1,))["digest"]
+
+    def test_narrow_to_wide_promotion_is_recorded(self):
+        def promoting(x):
+            return x.astype(jnp.float32) * 2.0
+
+        fp = fingerprint(jax.make_jaxpr(promoting)(
+            jnp.ones((4,), jnp.bfloat16)))
+        assert fp["promotions"] == ["bfloat16->float32"]
+        # the sub-jaxpr walk sees ops inside scan bodies too
+        def scanned(x):
+            def body(c, v):
+                return c + v.astype(jnp.float32).sum(), None
+            return jax.lax.scan(body, 0.0, x)[0]
+
+        fp2 = fingerprint(jax.make_jaxpr(scanned)(
+            jnp.ones((3, 4), jnp.bfloat16)))
+        assert fp2["promotions"] == ["bfloat16->float32"]
+        assert "scan" in fp2["ops"]
+
+    def test_host_callbacks_reported_outside_the_canonical_form(self):
+        def chatty(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        fp = fingerprint(jax.make_jaxpr(chatty)(jnp.ones((4,))))
+        assert fp["callbacks"], fp["ops"]
+
+
+# ---------------------------------------------------------------------------
+# the jit wrapper
+# ---------------------------------------------------------------------------
+
+class TestAuditedFunction:
+    def test_off_by_default_and_transparent(self, registry, monkeypatch):
+        monkeypatch.delenv("DSTPU_AUDIT", raising=False)
+        assert audit_mode() == ""
+        fn = audited_jit("t.step", mk_step(), registry=registry)
+        out = fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        assert float(out) == pytest.approx(16.0)
+        assert fn._cache_size() == 1          # delegated to the jit cache
+        assert not fn._seen                   # no audit work happened
+        assert not os.path.exists(registry.manifest_path)
+
+    def test_unpinned_program_trips_with_file_line(self, registry, check):
+        fn = audited_jit("t.ghost", step, registry=registry)
+        with pytest.raises(ProgramAuditError) as e:
+            fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        msg = str(e.value)
+        assert "t.ghost" in msg and "not pinned" in msg
+        assert "test_program_audit.py:" in msg
+
+    def test_write_then_check_round_trip(self, registry, check):
+        pin(registry, "t.step", mk_step())
+        man = json.load(open(registry.manifest_path))
+        assert man["jax"] == jax.__version__
+        entry = man["programs"]["t.step"]
+        assert entry["max_traces"] == 1 and len(entry["variants"]) == 1
+        assert entry["sites"] == ["test_program_audit.py"]
+        # a fresh registry + wrapper in check mode accepts the pin
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.step", mk_step(), registry=reg2)
+        fn(jnp.eye(8, dtype=jnp.float32), jnp.ones((8, 8), jnp.float32))
+
+    def test_extra_trace_trips_the_gate_with_file_line(self, registry,
+                                                       check):
+        """THE acceptance drift test: a deliberately added shape variant
+        fails with the registration site's file:line."""
+        pin(registry, "t.step", mk_step())           # max_traces=1 pinned
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.step", mk_step(), registry=reg2)
+        fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        with pytest.raises(ProgramAuditError) as e:
+            # same digest family (same ranks), but a SECOND live trace:
+            # exactly the silent-retrace class the count gate exists for
+            fn(jnp.eye(8, dtype=jnp.float32), jnp.ones((8, 8), jnp.float32))
+        msg = str(e.value)
+        assert "2 compiled traces" in msg and "bound 1" in msg
+        assert "test_program_audit.py:" in msg
+
+    def test_rank_drift_trips_the_digest_gate(self, registry, check):
+        pin(registry, "t.step", mk_step())
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.step", mk_step(), registry=reg2)
+        with pytest.raises(ProgramAuditError, match="drifted"):
+            # a (2, 4, 4) batch changes the aval signature (r2 -> r3)
+            fn(jnp.eye(4, dtype=jnp.float32),
+               jnp.ones((2, 4, 4), jnp.float32))
+
+    def test_declared_bound_admits_the_trace_family(self, registry, check):
+        pin(registry, "t.step", mk_step(), shapes=((4, 4), (8, 8)),
+            max_traces=2)
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.step", mk_step(), max_traces=2, registry=reg2)
+        for n in (4, 8, 4):   # two sizes, one digest, bound 2: clean
+            fn(jnp.eye(n, dtype=jnp.float32), jnp.ones((n, n), jnp.float32))
+        assert fn._cache_size() == 2
+
+    def test_digest_drift_trips_and_names_what_moved(self, registry, check):
+        pin(registry, "t.step", mk_step())
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.step",
+                         lambda p, x: jnp.tanh(jnp.dot(x, p)).sum(),
+                         registry=reg2)
+        with pytest.raises(ProgramAuditError) as e:
+            fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        msg = str(e.value)
+        assert "drifted" in msg and "tanh" in msg
+        assert "test_program_audit.py:" in msg
+
+    def test_host_callback_trips_even_when_pinned(self, registry, check):
+        def chatty(p, x):
+            jax.debug.print("s={s}", s=x.sum())
+            return jnp.dot(x, p).sum()
+
+        pin(registry, "t.chatty", chatty)
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.chatty", chatty, registry=reg2)
+        with pytest.raises(ProgramAuditError, match="host-callback"):
+            fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+        # ...unless the pin carries a reviewed allow_host_callbacks
+        man = json.load(open(registry.manifest_path))
+        man["programs"]["t.chatty"]["allow_host_callbacks"] = True
+        with open(registry.manifest_path, "w") as fh:
+            json.dump(man, fh)
+        reg3 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.chatty", chatty, registry=reg3)
+        fn(jnp.eye(4, dtype=jnp.float32), jnp.ones((4, 4), jnp.float32))
+
+    def test_static_argnums_variants_pin_distinct_digests(self, registry,
+                                                          check):
+        def branchy(x, greedy):
+            return jnp.argmax(x) if greedy else x.sum()
+
+        os.environ["DSTPU_AUDIT"] = "write"
+        try:
+            fn = audited_jit("t.branchy", branchy, max_traces=2,
+                             static_argnums=(1,), registry=registry)
+            fn(jnp.ones((4,)), True)
+            fn(jnp.ones((4,)), False)
+        finally:
+            os.environ.pop("DSTPU_AUDIT", None)
+        entry = json.load(open(registry.manifest_path))["programs"][
+            "t.branchy"]
+        assert len(entry["variants"]) == 2
+        # and check mode accepts both static variants
+        os.environ["DSTPU_AUDIT"] = "1"
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.branchy", branchy, max_traces=2,
+                         static_argnums=(1,), registry=reg2)
+        fn(jnp.ones((4,)), True)
+        fn(jnp.ones((4,)), False)
+
+    def test_numpy_args_and_kwargs_audit_cleanly(self, registry, check):
+        def masked(x, mask):
+            return jnp.where(mask, x, 0.0).sum()
+
+        pin(registry, "t.masked", lambda p, x: masked(x, p > 0))
+        reg2 = ProgramRegistry(registry.manifest_path)
+        fn = audited_jit("t.masked", lambda p, x: masked(x, p > 0),
+                         registry=reg2)
+        fn(np.eye(4, dtype=np.float32), np.ones((4, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# manifest-backed trace bounds (the `*_cache_size <= N` replacement)
+# ---------------------------------------------------------------------------
+
+class _FakeEngine:
+    ragged_cache_size = 3
+    fused_cache_size = 1
+    verify_cache_size = 0
+
+
+def _bounds_manifest(tmp_path, ragged_max=4):
+    reg = ProgramRegistry(str(tmp_path / "programs.json"))
+    man = {"version": 1, "jax": jax.__version__, "programs": {
+        name: {"max_traces": ragged_max if name == "engine_v2.ragged" else 1,
+               "sites": [], "variants": [{"digest": "d"}]}
+        for name in ENGINE_TRACE_PROPS}}
+    with open(reg.manifest_path, "w") as fh:
+        json.dump(man, fh)
+    return reg
+
+
+class TestAssertTraceBounds:
+    def test_within_bounds_returns_observations(self, tmp_path):
+        reg = _bounds_manifest(tmp_path)
+        rows = assert_trace_bounds(_FakeEngine(), registry=reg)
+        assert ("engine_v2.ragged", 3, 4) in rows
+        assert ("engine_v2.verify", 0, 1) in rows
+
+    def test_over_bound_raises(self, tmp_path):
+        reg = _bounds_manifest(tmp_path, ragged_max=2)
+        with pytest.raises(ProgramAuditError, match="ragged_cache_size = 3"):
+            assert_trace_bounds(_FakeEngine(), registry=reg)
+
+    def test_missing_pin_raises(self, tmp_path):
+        reg = ProgramRegistry(str(tmp_path / "programs.json"))
+        with pytest.raises(ProgramAuditError, match="missing"):
+            assert_trace_bounds(_FakeEngine(), registry=reg)
+
+    def test_names_filter(self, tmp_path):
+        reg = _bounds_manifest(tmp_path, ragged_max=2)
+        rows = assert_trace_bounds(_FakeEngine(),
+                                   names=["engine_v2.verify"], registry=reg)
+        assert rows == [("engine_v2.verify", 0, 1)]
+
+    def test_repo_engine_programs_are_pinned(self):
+        """The shipped manifest pins every step program the trace-bound
+        helper keys on (ISSUE 20 acceptance)."""
+        from deepspeed_tpu.analysis.program_audit import GLOBAL_REGISTRY
+
+        programs = GLOBAL_REGISTRY.manifest().get("programs", {})
+        for name in ENGINE_TRACE_PROPS:
+            assert name in programs, name
+            assert programs[name]["variants"], name
+
+
+# ---------------------------------------------------------------------------
+# dry mode: manifest <-> source consistency
+# ---------------------------------------------------------------------------
+
+class TestCheckManifest:
+    def test_repo_tree_is_consistent(self):
+        """THE pre-commit gate: every in-tree ``audited_jit`` registration
+        is pinned in the shipped manifest and no pin is stale."""
+        import deepspeed_tpu
+
+        pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+        assert check_manifest([pkg]) == []
+
+    def test_registration_scan_finds_engine_sites(self):
+        import deepspeed_tpu
+
+        pkg = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+        names = registered_program_names([pkg])
+        assert "engine_v2.ragged" in names and "engine.fwd_bwd" in names
+        assert any("engine_v2.py" in s for s in names["engine_v2.ragged"])
+
+    def test_detects_unpinned_and_stale(self, tmp_path):
+        src = tmp_path / "mod.py"
+        src.write_text("fn = audited_jit('a.new', f)\n")
+        man = tmp_path / "programs.json"
+        man.write_text(json.dumps({"version": 1, "programs": {
+            "a.old": {"max_traces": 1,
+                      "variants": [{"digest": "d"}]}}}))
+        problems = check_manifest([str(tmp_path)], str(man))
+        text = "\n".join(problems)
+        assert "a.new" in text and "mod.py:1" in text
+        assert "a.old" in text and "stale" in text
+
+    def test_malformed_entries_are_reported(self, tmp_path):
+        man = tmp_path / "programs.json"
+        man.write_text(json.dumps({"version": 1, "programs": {
+            "a.bad": {"max_traces": 0, "variants": []}}}))
+        problems = check_manifest([str(tmp_path)], str(man))
+        assert any("max_traces" in p for p in problems)
+        assert any("no pinned digest" in p for p in problems)

@@ -24,6 +24,7 @@ from deepspeed_tpu.resilience import (BreakerState, CircuitBreaker,
 from deepspeed_tpu.serve import (ContinuousBatchScheduler,
                                  PromptLookupProposer, Request, RequestState,
                                  SamplingParams)
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -48,8 +49,7 @@ def _engine(m, params, **kw):
 def _assert_pool_restored(eng):
     assert not eng.state.seqs
     assert eng.block_mgr.free_blocks == eng.block_mgr.num_blocks - 1
-    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
-    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+    assert_trace_bounds(eng)
     eng.block_mgr.check_invariants([])
 
 
@@ -355,7 +355,7 @@ class TestSchedulerRecovery:
         assert inj.deaths == 1
         assert all(r.state is RequestState.DONE for r in reqs)
         assert [r.tokens for r in reqs] == [r.tokens for r in ref]
-        assert eng.verify_cache_size <= 1
+        assert_trace_bounds(eng)
         _assert_pool_restored(eng)
 
     def test_preempted_and_queued_ride_through(self, setup):

@@ -19,6 +19,7 @@ from deepspeed_tpu.resilience import (FaultInjector, RetryPolicy,
                                       TransientEngineError)
 from deepspeed_tpu.serve import (ContinuousBatchScheduler,
                                  PromptLookupProposer, RequestState)
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 pytestmark = [pytest.mark.slow, pytest.mark.chaos]
 
@@ -98,8 +99,7 @@ def _check_soak(sched, eng, inj, reqs, ref, min_deaths):
     assert not eng.state.seqs
     assert eng.block_mgr.free_blocks == eng.block_mgr.num_blocks - 1
     eng.block_mgr.check_invariants([])
-    assert eng.ragged_cache_size <= 4
-    assert eng.fused_cache_size <= 1 and eng.verify_cache_size <= 1
+    assert_trace_bounds(eng)
 
 
 def test_engine_death_soak_fused(setup):

@@ -19,6 +19,7 @@ from deepspeed_tpu.resilience import (BreakerState, CircuitBreaker,
                                       SheddingError, StepWatchdog,
                                       TransientEngineError)
 from deepspeed_tpu.serve import ContinuousBatchScheduler, RequestState
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 NO_SLEEP = staticmethod(lambda s: None)
 
@@ -51,7 +52,7 @@ def _assert_pool_restored(eng):
                            min(eng.max_seq_len,
                                eng.block_mgr.free_blocks
                                * eng.block_mgr.block_size))
-    assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+    assert_trace_bounds(eng)
     eng.block_mgr.check_invariants([])
 
 
@@ -539,5 +540,5 @@ def test_randomized_soak_speculative_site_mix(setup):
     assert all(r.state is RequestState.DONE for r in reqs)
     assert [r.tokens for r in reqs] == [r.tokens for r in ref]
     assert inj.fired["transient"] > 0
-    assert eng.verify_cache_size <= 1 and eng.fused_cache_size <= 1
+    assert_trace_bounds(eng)
     _assert_pool_restored(eng)

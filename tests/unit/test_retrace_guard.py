@@ -10,6 +10,7 @@ import numpy as np
 import deepspeed_tpu
 from deepspeed_tpu.comm import topology as topo_mod
 from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 def _mk_engine(extra=None):
@@ -99,7 +100,7 @@ class TestRetraceGuards:
                 0, 128, (3 + 2 * i,)).tolist()]))
             toks = {u: int(np.argmax(v)) for u, v in out.items()}
             out = eng.decode_step(toks)
-        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert_trace_bounds(eng)
 
     def test_gas_change_is_config_not_retrace(self):
         """Two engines at different GAS don't share traces, but a SINGLE

@@ -14,6 +14,7 @@ from deepspeed_tpu.models import build_model
 from deepspeed_tpu.serve import (ContinuousBatchScheduler, QueueFullError,
                                  RequestState, SamplingParams,
                                  SchedulerClosedError)
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -170,7 +171,7 @@ class TestPreemption:
         sched.run_until_complete()
         assert all(r.state is RequestState.DONE for r in reqs)
         assert sched.metrics.preemptions > 0  # the pool really was tight
-        assert eng.ragged_cache_size <= 4, eng.ragged_cache_size
+        assert_trace_bounds(eng)
         assert not eng.state.seqs
         eng.block_mgr.check_invariants([])
 
@@ -298,6 +299,6 @@ def test_priority_mix_load_mirrors_bench():
     assert out["preemptions"] > 0
     assert out["generated_tokens"] > 0 and out["p50_token_ms"] >= 0
     assert out["ttft_p95_ms"] >= out["ttft_p50_ms"] >= 0
-    assert eng.ragged_cache_size <= 4
+    assert_trace_bounds(eng)
     assert not eng.state.seqs
     eng.block_mgr.check_invariants([])

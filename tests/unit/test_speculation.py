@@ -21,6 +21,7 @@ from deepspeed_tpu.serve import (ContinuousBatchScheduler, DraftModelProposer,
                                  DraftProposer, FaultInjector,
                                  PromptLookupProposer, RequestState,
                                  SamplingParams, SpecPolicy)
+from deepspeed_tpu.analysis import assert_trace_bounds
 
 
 @pytest.fixture(scope="module")
@@ -241,7 +242,7 @@ class TestVerifyEngine:
             eng.rollback(uid, 0)
         assert eng.verify_cache_size == 1
         assert eng.fused_cache_size == 1
-        assert eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
 
     def test_drafts_never_reach_prefix_index(self, setup):
         """After verify + rollback, a fresh lookup of the history maps only
@@ -316,8 +317,7 @@ class TestSpecScheduler:
         assert ss.metrics.spec["steps"] > 0
         assert ss.metrics.spec["accepted_tokens"] > 0
         assert 0.0 < ss.metrics.spec["acceptance_rate"] <= 1.0
-        assert eng.verify_cache_size <= 1
-        assert eng.fused_cache_size <= 1 and eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
         ev = {k: v for k, v, _ in ss.monitor_events(step=3)}
         assert ev["serve/spec/steps"] > 0
         assert "serve/spec/acceptance_rate" in ev
@@ -342,8 +342,7 @@ class TestSpecScheduler:
         greedy = [r.tokens for r in _run_sched(m, params, prompts)[2]]
         assert [r.tokens for r in rs] != greedy
         assert ss.metrics.spec["steps"] > 0  # verification really ran
-        assert eng.verify_cache_size <= 1
-        assert eng.fused_cache_size <= 1 and eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
         assert not eng.state.seqs
 
     def test_eos_inside_accepted_draft_prefix(self, setup):
@@ -377,7 +376,7 @@ class TestSpecScheduler:
             priorities=[2, 1, 0], proposer=PromptLookupProposer())
         assert sched.metrics.preemptions > 0
         assert [r.tokens for r in reqs] == refs
-        assert eng.verify_cache_size <= 1 and eng.ragged_cache_size <= 4
+        assert_trace_bounds(eng)
         eng.block_mgr.check_invariants([])
 
     def test_fault_during_verify_retries_step_verbatim(self, setup):
